@@ -1,0 +1,95 @@
+"""Benchmark: flagship GPT compiled train-step throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline: the reference publishes no numbers (BASELINE.md); 1.0 = the
+recorded target placeholder until an A100 reference measurement exists.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import rng
+    from paddle_tpu.core.functional import state_dict_arrays
+    from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    paddle.seed(0)
+    # GPT-small-ish sized to fit one chip comfortably in bf16
+    cfg = GPTConfig(
+        vocab_size=32768,
+        hidden_size=1024,
+        num_layers=12,
+        num_heads=16,
+        max_seq_len=1024,
+        attn_impl="flash" if on_tpu else "xla",
+        dtype="bfloat16",
+    )
+    batch, seq = (8, 1024) if on_tpu else (2, 128)
+    if not on_tpu:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                        num_heads=8, max_seq_len=seq, attn_impl="xla")
+    model = GPT(cfg)
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    params, buffers = state_dict_arrays(model)
+    opt_state = opt.init_state_arrays(params)
+
+    from paddle_tpu.core.functional import functional_call
+
+    def step(params, buffers, opt_state, lr, key, ids, labels):
+        def loss_fn(p):
+            out, new_buf = functional_call(
+                model, p, buffers, args=(ids,), rng_key=key, training=True
+            )
+            return gpt_loss_fn(out, labels), new_buf
+
+        (loss, new_buf), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt = opt.apply_gradients_arrays(params, grads, opt_state, lr)
+        return loss, new_params, new_buf, new_opt
+
+    jstep = jax.jit(step, donate_argnums=(0, 2))
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
+    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
+    lr = jnp.asarray(1e-4, jnp.float32)
+
+    # warmup / compile
+    loss, params, buffers, opt_state = jstep(params, buffers, opt_state, lr, rng.next_key(), ids, labels)
+    float(np.asarray(loss))
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, buffers, opt_state = jstep(
+            params, buffers, opt_state, lr, rng.next_key(), ids, labels
+        )
+    float(np.asarray(loss))  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "gpt_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+"""paddle_tpu — a TPU-native deep learning framework with the capabilities of
+PaddlePaddle (reference: /root/reference, see SURVEY.md).
+
+Architecture: JAX/XLA is the compiler+kernel library; eager mode is a dynamic
+tape over jax.vjp; the performance path compiles whole train steps to one XLA
+executable (SURVEY.md §7). Public API mirrors `paddle.*`.
+"""
+from __future__ import annotations
+
+# --- core ------------------------------------------------------------------
+from .core.dtypes import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.tensor import Parameter, Tensor, is_tensor, to_tensor  # noqa: F401
+from .core.autograd import enable_grad, no_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .core.rng import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core import device as _device_mod
+
+# bind Tensor methods before anything imports them
+from .ops import _bind as _bind_mod
+
+_bind_mod.bind()
+
+# --- functional op surface (paddle.* level) --------------------------------
+from .ops.creation import (  # noqa: F401
+    arange, as_complex, as_real, assign, bernoulli, clone, complex, diag,
+    diag_embed, diagflat, empty, empty_like, eye, full, full_like, linspace,
+    logspace, meshgrid, multinomial, normal, numel, ones, ones_like, poisson,
+    rand, randint, randint_like, randn, randperm, standard_normal, tril, triu,
+    uniform, zeros, zeros_like,
+)
+from .ops.math import *  # noqa: F401,F403
+from .ops.linalg import (  # noqa: F401
+    bmm, cholesky, cholesky_solve, cond, corrcoef, cov, cross, det, dist, dot,
+    eig, eigh, eigvals, eigvalsh, einsum, householder_product,
+    inverse, lstsq, lu, matmul, matrix_power, matrix_rank, mm, multi_dot, mv,
+    norm, pinv, qr, slogdet, solve, svd, triangular_solve,
+)
+from .ops.search import histogram  # noqa: F401
+from .ops.manipulation import *  # noqa: F401,F403
+from .ops.logic import *  # noqa: F401,F403
+from .ops.search import (  # noqa: F401
+    argmax, argmin, argsort, bincount, bucketize, kthvalue, mode, searchsorted,
+    sort, topk,
+)
+from .ops.common_nn import one_hot  # noqa: F401
+
+# --- subsystems ------------------------------------------------------------
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
+from . import distributed  # noqa: F401
+from . import distribution  # noqa: F401
+from . import framework  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import static  # noqa: F401
+from . import vision  # noqa: F401
+
+from .device import get_device, set_device  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+from .jit.api import to_static  # noqa: F401
+
+# paddle.grad
+from .autograd.functional import grad  # noqa: F401
+
+# paddle.flops / summary
+from .hapi.summary import flops, summary  # noqa: F401
+
+disable_static = lambda *a, **k: None  # dygraph is the default; parity no-op
+enable_static = lambda *a, **k: None
+
+in_dynamic_mode = lambda: True
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from .auto_cast import amp_guard, auto_cast, decorate, is_bf16_supported, is_float16_supported  # noqa: F401
+from .grad_scaler import GradScaler  # noqa: F401

@@ -1,0 +1,87 @@
+"""Automatic mixed precision.
+
+Reference parity: python/paddle/amp/auto_cast.py:668 (auto_cast), :730
+(decorate) and the C++ dtype lists in imperative/amp_auto_cast.cc in
+/root/reference.
+
+TPU-first: the native low-precision dtype is bfloat16 (no loss scaling needed
+— GradScaler defaults to a pass-through). autocast works by dtype-casting op
+*inputs* at the framework boundary: a thread-local flag consulted by the
+matmul/conv wrappers (white list) mirrors the reference's autocast insertion.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..core.dtypes import convert_dtype
+
+# ops cast to low precision (matmul/conv class); mirrors amp white list
+WHITE_LIST = {"matmul", "conv2d", "conv1d", "conv3d", "linear", "bmm", "mm", "einsum"}
+# ops kept in fp32 (reductions prone to overflow); mirrors black list
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm", "batch_norm", "mean", "sum", "norm"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def is_bf16_supported():
+    return True
+
+
+def is_float16_supported():
+    return True  # supported but bf16 preferred on TPU
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def amp_dtype_for(op_name):
+    """Called by op wrappers: returns target dtype or None."""
+    if not _state.enabled:
+        return None
+    if op_name in _state.custom_black or op_name in BLACK_LIST:
+        return convert_dtype("float32")
+    if _state.level == "O2" or op_name in WHITE_LIST or op_name in _state.custom_white:
+        return convert_dtype(_state.dtype)
+    return None
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """O2: cast model params to low precision (master weights stay fp32 in the
+    optimizer's fp32 slots — Adam already keeps fp32 moments+update)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

@@ -1,0 +1,8 @@
+"""paddle.autograd parity (reference python/paddle/autograd/)."""
+from ..core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .functional import grad, hessian, jacobian, vjp, jvp  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+
+class backward_mode:
+    pass

@@ -1,0 +1,83 @@
+"""PyLayer: user-defined autograd functions.
+
+Reference parity: paddle/fluid/pybind/eager_py_layer.cc +
+python/paddle/autograd/py_layer.py in /root/reference.
+"""
+from __future__ import annotations
+
+from ..core import autograd as eng
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with eng.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs = eng.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        if not needs:
+            return outputs
+
+        out_avals = [(o._array.shape, o._array.dtype) for o in outs]
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            grad_tensors = cls.backward(
+                ctx, *[Tensor._from_op(c) for c in cts]
+            )
+            gts = grad_tensors if isinstance(grad_tensors, (list, tuple)) else [grad_tensors]
+            out = []
+            gi = iter(gts)
+            for a in tensor_inputs:
+                g = next(gi, None)
+                out.append(
+                    g._array if isinstance(g, Tensor) else (g if g is not None else None)
+                )
+            import jax.numpy as jnp
+
+            return tuple(
+                jnp.zeros(t._array.shape, t._array.dtype) if g is None else g
+                for g, t in zip(out, tensor_inputs)
+            )
+
+        node = eng.GradNode(vjp_fn, tuple(tensor_inputs), out_avals, not single, cls.__name__)
+        wrapped = [Tensor._from_op(o._array, node, i) for i, o in enumerate(outs)]
+        return wrapped[0] if single else tuple(wrapped)
+
+
+LegacyPyLayer = PyLayer

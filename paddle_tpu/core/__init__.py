@@ -1,0 +1,2 @@
+from . import autograd, device, dtypes, rng  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

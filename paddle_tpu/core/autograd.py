@@ -1,0 +1,256 @@
+"""Eager autograd engine: a dynamic tape over jax.vjp.
+
+Reference parity: the dygraph autograd engine — GradNodeBase
+(/root/reference/paddle/fluid/eager/grad_node_info.h:168), TensorWrapper input
+capture, queue-driven reverse traversal in egr::Backward
+(/root/reference/paddle/fluid/eager/backward.cc:380), GradTensorHolder fan-in
+accumulation.
+
+TPU-native design: instead of per-op handwritten GradNode classes (codegen'd
+from yaml in the reference), every op application calls `jax.vjp` on its
+jnp-level implementation, which yields the backward closure for free — XLA
+differentiates the op graph. The tape is a list of GradNodes processed in
+reverse creation order (a valid topological order for a tape). The compiled
+training path bypasses this tape entirely: `jax.grad` over `functional_call`
+differentiates the whole step as one XLA program (SURVEY.md §7 step 3-4).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.trace_mode = False  # True inside functional_call: tape off, pure trace
+
+
+_tls = _TLS()
+_node_ids = itertools.count()
+
+
+def is_grad_enabled() -> bool:
+    return _tls.grad_enabled and not _tls.trace_mode
+
+
+def set_grad_enabled(mode: bool):
+    _tls.grad_enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager + decorator, paddle.no_grad parity."""
+
+    def __enter__(self):
+        self._prev = _tls.grad_enabled
+        _tls.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _tls.grad_enabled
+        _tls.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+
+@contextlib.contextmanager
+def trace_mode():
+    """Inside functional_call: ops compute without recording the tape so the
+    surrounding jax transformation (grad/jit/vmap) owns differentiation."""
+    prev = _tls.trace_mode
+    _tls.trace_mode = True
+    try:
+        yield
+    finally:
+        _tls.trace_mode = prev
+
+
+def in_trace_mode() -> bool:
+    return _tls.trace_mode
+
+
+class GradNode:
+    """One tape entry. vjp_fn maps output cotangents -> input cotangents.
+
+    Edges snapshot each input's (tensor, producer node, output index) at
+    record time, so later in-place rebinding of a tensor's _node (e.g.
+    differentiable __setitem__) cannot re-route cotangents of consumers that
+    were recorded earlier."""
+
+    __slots__ = ("id", "vjp_fn", "inputs", "edges", "out_avals", "multi_output", "name", "hooks")
+
+    def __init__(self, vjp_fn, inputs, out_avals, multi_output, name):
+        self.id = next(_node_ids)
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # tuple[Tensor]
+        self.edges = tuple((t, t._node, t._out_index) for t in inputs)
+        self.out_avals = out_avals  # list[(shape, dtype)]
+        self.multi_output = multi_output
+        self.name = name
+        self.hooks = None
+
+    def __repr__(self):
+        return f"<GradNode {self.name}#{self.id}>"
+
+
+def apply(fn, *tensors, name=None, num_outputs=None):
+    """Run `fn` (a jnp-level function over arrays, differentiable in all
+    positional args) on the arrays inside `tensors`, recording a tape node if
+    gradients are required. Returns raw output arrays plus the node and the
+    stop_gradient flag for outputs; Tensor wrapping happens in tensor.py."""
+    arrays = tuple(t._array for t in tensors)
+    record = (
+        _tls.grad_enabled
+        and not _tls.trace_mode
+        and any(not t.stop_gradient for t in tensors)
+    )
+    if not record:
+        out = fn(*arrays)
+        return out, None
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    if isinstance(out, (tuple, list)):
+        avals = [(o.shape, o.dtype) for o in out]
+        multi = True
+    else:
+        avals = [(out.shape, out.dtype)]
+        multi = False
+    node = GradNode(vjp_fn, tensors, avals, multi, name or getattr(fn, "__name__", "op"))
+    return out, node
+
+
+def _is_float0(x):
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def backward(root, grad=None, retain_graph=False, accumulate_filter=None):
+    """Reverse-accumulate gradients from `root` into leaf Tensors' .grad.
+
+    Mirrors egr::Backward's queue traversal (backward.cc:380): nodes are
+    processed in reverse creation order, cotangents accumulated per node
+    output (GradTensorHolder role) and written into leaf tensors by the
+    accumulation step. `accumulate_filter`, when given, restricts which
+    tensors receive .grad (the paddle.grad no-side-effects contract)."""
+    from .tensor import Tensor  # local import to avoid cycle
+
+    if grad is None:
+        if root.size != 1:
+            raise RuntimeError(
+                "backward() without explicit grad requires a scalar tensor "
+                f"(got shape {root.shape})"
+            )
+        grad = jnp.ones(root._array.shape, root._array.dtype)
+    elif isinstance(grad, Tensor):
+        grad = grad._array
+
+    def may_accumulate(t):
+        return accumulate_filter is None or id(t) in accumulate_filter
+
+    if root._node is None:
+        if not root.stop_gradient and may_accumulate(root):
+            root._accumulate_grad(grad)
+        return
+
+    # node id -> list of accumulated output cotangents (None = zero)
+    pending = {}
+
+    def seed(node, out_index, ct):
+        slots = pending.setdefault(node.id, [None] * len(node.out_avals))
+        slots[out_index] = ct if slots[out_index] is None else slots[out_index] + ct
+
+    seed(root._node, root._out_index, grad)
+
+    # Collect reachable nodes (DFS over recorded edges, not live _node).
+    nodes = {root._node.id: root._node}
+    stack = [root._node]
+    while stack:
+        n = stack.pop()
+        for _, pn, _idx in n.edges:
+            if pn is not None and pn.id not in nodes:
+                nodes[pn.id] = pn
+                stack.append(pn)
+
+    for nid in sorted(nodes, reverse=True):
+        node = nodes[nid]
+        slots = pending.pop(nid, None)
+        if slots is None:
+            continue  # unreachable from root's cotangent flow
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time; "
+                "pass retain_graph=True if needed."
+            )
+        cts = [
+            s
+            if s is not None
+            else jnp.zeros(shape, dtype)
+            for s, (shape, dtype) in zip(slots, node.out_avals)
+        ]
+        out_ct = tuple(cts) if node.multi_output else cts[0]
+        in_cts = node.vjp_fn(out_ct)
+        if node.hooks:
+            in_cts = tuple(
+                h(ct) if h is not None else ct for h, ct in zip(node.hooks, in_cts)
+            )
+        if not retain_graph:
+            node.vjp_fn = None
+        for (t, pnode, pidx), ct in zip(node.edges, in_cts):
+            if t.stop_gradient or _is_float0(ct):
+                continue
+            if pnode is not None:
+                seed(pnode, pidx, ct)
+                if t._retain_grads and may_accumulate(t):
+                    t._accumulate_grad(ct)
+            else:
+                if may_accumulate(t):
+                    t._accumulate_grad(ct)
+
+
+def grad_fn_tensors(outputs, inputs, grad_outputs=None, retain_graph=False):
+    """paddle.grad-style: return grads of outputs w.r.t. inputs without
+    touching .grad of other leaves. Implemented by running backward with
+    temporary accumulation redirection."""
+    from .tensor import Tensor
+
+    saved = [(t, t._grad, t.stop_gradient, t._retain_grads) for t in inputs]
+    for t in inputs:
+        t._grad = None
+        t.stop_gradient = False
+        t._retain_grads = True
+    only = {id(t) for t in inputs}
+    try:
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        gouts = grad_outputs or [None] * len(outs)
+        for o, g in zip(outs, gouts):
+            backward(o, g, retain_graph=True, accumulate_filter=only)
+        results = [
+            Tensor(t._grad) if t._grad is not None else None for t in inputs
+        ]
+    finally:
+        for t, g, sg, rg in saved:
+            t._grad = g
+            t.stop_gradient = sg
+            t._retain_grads = rg
+    return results

@@ -1,0 +1,106 @@
+"""Device management.
+
+Reference parity: paddle.device.set_device/get_device
+(/root/reference/python/paddle/device/__init__.py:355,382) parse strings like
+"gpu:0" and flip a global Place. Here, devices are JAX devices; 'tpu' is the
+first-class accelerator. The current device is a process-global used by tensor
+creation ops (jax.device_put target); compute follows its inputs, which is the
+XLA model rather than a DeviceContextPool.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+_current_device = None  # lazily resolved jax.Device
+
+
+@functools.lru_cache(maxsize=None)
+def _platform_devices(platform: str):
+    try:
+        return tuple(jax.devices(platform))
+    except RuntimeError:
+        return ()
+
+
+def _default_device():
+    return jax.devices()[0]
+
+
+def set_device(device: str):
+    """Accepts 'tpu', 'tpu:0', 'cpu', 'gpu:0' (alias of accelerator), 'custom_dev'."""
+    global _current_device
+    if device is None:
+        _current_device = None
+        return None
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    name = name.lower()
+    if name in ("tpu", "gpu", "xpu", "npu", "mlu", "ipu", "custom_dev", "axon"):
+        # Any accelerator alias maps to the default (accelerator) backend.
+        devs = jax.devices()
+        if devs[0].platform == "cpu" and name == "tpu":
+            # No TPU attached; fall back to CPU silently (tests / CI).
+            devs = _platform_devices("cpu")
+    elif name == "cpu":
+        devs = _platform_devices("cpu")
+    else:
+        raise ValueError(f"Unknown device string: {device!r}")
+    if not devs:
+        raise RuntimeError(f"No devices for platform {name!r}")
+    _current_device = devs[min(idx, len(devs) - 1)]
+    return _current_device
+
+
+def current_device():
+    return _current_device if _current_device is not None else _default_device()
+
+
+def get_device() -> str:
+    d = current_device()
+    plat = "tpu" if d.platform in ("tpu", "axon") else d.platform
+    return f"{plat}:{d.id}"
+
+
+def device_count(platform=None) -> int:
+    if platform is None:
+        return len(jax.devices())
+    return len(_platform_devices(platform))
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_mkldnn() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def synchronize():
+    """Block until all dispatched work on the current device finishes."""
+    (jax.device_put(0, current_device()) + 0).block_until_ready()

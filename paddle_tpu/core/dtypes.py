@@ -1,0 +1,107 @@
+"""Dtype system for paddle_tpu.
+
+Reference parity: paddle exposes dtype enums (paddle.float32, ...) defined in
+paddle/phi/common/data_type.h and python/paddle/framework/dtype.py. Here dtypes
+are numpy/jax dtypes directly — idiomatic for a JAX-backed framework — with
+string aliases matching the reference's accepted names ('float32', 'bf16', ...).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtypes (mirrors paddle/phi/common/data_type.h enum members).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (string / np dtype / jnp dtype) to a numpy dtype type."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _ALIASES[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+    if isinstance(dtype, np.dtype):
+        return dtype.type
+    if isinstance(dtype, type) and issubclass(dtype, np.generic):
+        return dtype
+    # jnp dtypes like jnp.float32 are numpy scalar types already; handle
+    # objects exposing .dtype (arrays, Tensors)
+    if hasattr(dtype, "dtype"):
+        return np.dtype(dtype.dtype).type
+    return np.dtype(dtype).type
+
+
+def dtype_name(dtype) -> str:
+    d = np.dtype(convert_dtype(dtype))
+    return d.name
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING or convert_dtype(dtype) in (
+        complex64,
+        complex128,
+    ) and False
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_bool(dtype) -> bool:
+    return convert_dtype(dtype) is bool_
+
+
+# Default dtype handling (reference: paddle.get_default_dtype /
+# python/paddle/framework/framework.py).
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in _FLOATING:
+        raise TypeError("set_default_dtype only accepts floating dtypes")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,107 @@
+"""functional_call: run a stateful nn.Layer as a pure function of its state.
+
+This is the bridge between the imperative paddle-style API and JAX transforms
+— the TPU-native replacement for the reference's dual dygraph/static engines
+(SURVEY.md §1 "dual execution model"). A Layer's parameters/buffers are
+temporarily swapped for traced arrays, forward runs with the tape disabled,
+and mutated buffers (e.g. BatchNorm running stats) are collected as explicit
+outputs. jax.jit/grad/vmap over functional_call gives one compiled XLA program
+for the whole step — the role of InterpreterCore + ProgramDesc
+(/root/reference/paddle/fluid/framework/new_executor/interpretercore.cc:181)
+without an interpreter.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd, rng
+from .tensor import Tensor
+
+
+def state_dict_arrays(layer):
+    """(params, buffers) as flat {qualified_name: jax.Array} dicts."""
+    params = {k: p._array for k, p in layer.named_parameters_dict().items()}
+    buffers = {k: b._array for k, b in layer.named_buffers_dict().items()}
+    return params, buffers
+
+
+@contextlib.contextmanager
+def swap_state(layer, params: Dict[str, Any] = None, buffers: Dict[str, Any] = None):
+    """Temporarily replace parameter/buffer arrays; restore on exit.
+
+    Yields the dict of buffer Tensor objects so the caller can read mutated
+    arrays after forward.
+    """
+    pmap = layer.named_parameters_dict()
+    bmap = layer.named_buffers_dict()
+    saved = {}
+    try:
+        if params:
+            for k, arr in params.items():
+                t = pmap[k]
+                saved[id(t)] = (t, t._array)
+                t._array = arr
+        if buffers:
+            for k, arr in buffers.items():
+                t = bmap[k]
+                if id(t) not in saved:
+                    saved[id(t)] = (t, t._array)
+                t._array = arr
+        yield bmap
+    finally:
+        for t, arr in saved.values():
+            t._array = arr
+
+
+def functional_call(layer, params, buffers, args=(), kwargs=None, rng_key=None, training=None):
+    """Pure forward: (params, buffers, inputs, key) -> (outputs, new_buffers).
+
+    Traceable by jit/grad. Inputs in `args` may be jax arrays or Tensors.
+    """
+    kwargs = kwargs or {}
+    args = tuple(Tensor._from_op(a) if isinstance(a, jax.Array) else a for a in args)
+    kwargs = {
+        k: Tensor._from_op(v) if isinstance(v, jax.Array) else v
+        for k, v in kwargs.items()
+    }
+
+    prev_training = layer.training
+    if training is not None:
+        layer.train() if training else layer.eval()
+    try:
+        with autograd.trace_mode(), swap_state(layer, params, buffers) as bmap:
+            ctx = rng.key_scope(rng_key) if rng_key is not None else contextlib.nullcontext()
+            with ctx:
+                out = layer(*args, **kwargs)
+            new_buffers = {k: t._array for k, t in bmap.items()}
+    finally:
+        if training is not None:
+            layer.train() if prev_training else layer.eval()
+    out_arrays = jax.tree_util.tree_map(
+        lambda x: x._array if isinstance(x, Tensor) else x,
+        out,
+        is_leaf=lambda x: isinstance(x, Tensor),
+    )
+    return out_arrays, new_buffers
+
+
+def tree_to_tensors(tree):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor._from_op(x) if isinstance(x, jax.Array) else x, tree
+    )
+
+
+def load_state_arrays(layer, params=None, buffers=None):
+    """Permanently install arrays (e.g. after a compiled optimizer step)."""
+    pmap = layer.named_parameters_dict()
+    bmap = layer.named_buffers_dict()
+    if params:
+        for k, arr in params.items():
+            pmap[k]._array = arr
+    if buffers:
+        for k, arr in buffers.items():
+            bmap[k]._array = arr

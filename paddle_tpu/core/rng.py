@@ -1,0 +1,121 @@
+"""Random state management.
+
+Reference parity: paddle.seed + per-generator state
+(/root/reference/python/paddle/framework/random.py) and the tensor-parallel
+RNGStatesTracker (/root/reference/python/paddle/distributed/fleet/layers/mpu/random.py:35).
+
+Design (TPU-first): a process-global PRNG key + monotone counter. Eager ops
+fold the counter into the key (cheap, traceable). Under `jax.jit` tracing the
+framework swaps in an explicit traced key via `key_scope`, so compiled train
+steps are deterministic functions of (params, batch, seed) — the functional
+JAX discipline — while user code keeps the stateful paddle API.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class _KeyState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.counter = 0
+        self.override = None  # (key, counter_box) inside key_scope
+
+
+_state = _KeyState()
+
+
+def seed(s: int):
+    """paddle.seed parity."""
+    _state.key = jax.random.PRNGKey(int(s))
+    _state.counter = 0
+    return s
+
+
+def get_rng_state():
+    return (_state.key, _state.counter)
+
+
+def set_rng_state(st):
+    _state.key, _state.counter = st
+
+
+def next_key():
+    """Return a fresh PRNG key; works both eagerly and under tracing."""
+    if _state.override is not None:
+        base, box = _state.override
+        box[0] += 1
+        return jax.random.fold_in(base, box[0])
+    _state.counter += 1
+    return jax.random.fold_in(_state.key, _state.counter)
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    """Route next_key() through `key` (possibly a tracer) for the duration.
+
+    Used by functional_call / compiled train steps so randomness is an
+    explicit input of the XLA program.
+    """
+    prev = _state.override
+    _state.override = (key, [0])
+    try:
+        yield
+    finally:
+        _state.override = prev
+
+
+class RNGStatesTracker:
+    """Named RNG states: tensor-parallel dropout needs same-seed inside an mp
+    group for some ops and different-seed for others (reference
+    mpu/random.py:35). Tracks independent key states by name."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def add(self, name, seed_):
+        if name in self.states_:
+            raise ValueError(f"rng state {name} already exists")
+        self.states_[name] = [jax.random.PRNGKey(int(seed_)), 0]
+
+    def reset(self):
+        self.states_.clear()
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            raise ValueError(f"rng state {name} not added")
+        st = self.states_[name]
+        prev = _state.override
+        box = [st[1]]
+        _state.override = (st[0], box)
+        try:
+            yield
+        finally:
+            st[1] = box[0]
+            _state.override = prev
+
+
+_GLOBAL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _GLOBAL_TRACKER
+
+
+def model_parallel_random_seed(seed_: int, mp_rank: int = 0):
+    """Reference mpu/random.py:89 — global seed shared, mp seed offset by rank."""
+    global_seed = 100 + seed_
+    local_seed = seed_ + 1024 + mp_rank
+    _GLOBAL_TRACKER.reset()
+    seed(global_seed)
+    _GLOBAL_TRACKER.add("model_parallel_rng", local_seed)
+
+
+def normal_np(shape, mean=0.0, std=1.0, dtype=np.float32, rs=None):
+    rs = rs or np.random
+    return rs.normal(mean, std, size=shape).astype(dtype)

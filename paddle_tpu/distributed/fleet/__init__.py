@@ -1,0 +1,15 @@
+"""Fleet: the unified distributed facade.
+
+Reference parity: python/paddle/distributed/fleet/fleet.py:100 (Fleet, init:168,
+distributed_optimizer:1044) + DistributedStrategy
+(fleet/base/distributed_strategy.py:117 over distributed_strategy.proto).
+"""
+from .fleet import Fleet, fleet, init, distributed_model, distributed_optimizer  # noqa: F401
+from .strategy import DistributedStrategy  # noqa: F401
+from ..mesh import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+)
+from . import meta_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401

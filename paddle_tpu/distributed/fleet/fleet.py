@@ -1,0 +1,109 @@
+"""Fleet facade.
+
+Reference parity: fleet/fleet.py:100 (init:168 builds HybridCommunicateGroup;
+distributed_model wraps with TensorParallel/PipelineParallel/DataParallel;
+distributed_optimizer:1044 -> HybridParallelOptimizer).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...nn.layer import Layer
+from ..mesh import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    set_hybrid_communicate_group,
+)
+from ..parallel import DataParallel, init_parallel_env
+from .strategy import DistributedStrategy
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        topo = CommunicateTopology(
+            hybrid_group_names=("data", "pipe", "sharding", "model"),
+            dims=(
+                hc["dp_degree"],
+                hc["pp_degree"],
+                hc["sharding_degree"],
+                hc["mp_degree"],
+            ),
+        )
+        self._hcg = HybridCommunicateGroup(topo, self._strategy)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_num(self):
+        return jax.process_count()
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def distributed_model(self, model):
+        from .meta_parallel import PipelineParallel, TensorParallel
+
+        hcg = self._hcg
+        if hcg is None:
+            self.init()
+            hcg = self._hcg
+        if hcg.get_pipe_parallel_world_size() > 1:
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .meta_parallel import HybridParallelOptimizer
+
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+    def state_dict(self):
+        return {}
+
+    def minimize(self, optimizer, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        return optimizer.minimize(loss)
+
+    def stop_worker(self):
+        pass
+
+    def save_persistables(self, executor=None, dirname=None, main_program=None):
+        pass
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    return fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)

@@ -1,0 +1,19 @@
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .parallel_wrappers import (  # noqa: F401
+    HybridParallelOptimizer,
+    PipelineParallel,
+    TensorParallel,
+)
+from .sharding import (  # noqa: F401
+    DygraphShardingOptimizer,
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+    group_sharded_parallel,
+)

@@ -1,0 +1,127 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference parity: fleet/layers/mpu/mp_layers.py in /root/reference
+(VocabParallelEmbedding:35, ColumnParallelLinear:173, RowParallelLinear:332,
+ParallelCrossEntropy:498) and the comm prims in mp_ops.py.
+
+TPU-native design: instead of per-rank shards + explicit c_allreduce ops, each
+layer holds the FULL logical weight annotated with a GSPMD sharding over the
+'mp' mesh axis (Parameter.sharding_axes) and applies sharding constraints in
+forward. Under jit on a mesh, XLA partitions the matmuls and inserts the
+identity/allreduce collectives of mp_ops automatically; eagerly on one device
+the layers behave like their dense counterparts (degree-1 semantics).
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.tensor import Tensor
+from ....nn import initializer as I
+from ....nn.layer import Layer
+from ....ops import common_nn as F
+from ....ops.loss_ops import cross_entropy
+from ...mesh import get_mesh
+
+
+def _constraint(x, *spec):
+    """with_sharding_constraint when tracing on a mesh; no-op eagerly."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        arr = jax.lax.with_sharding_constraint(
+            x._array, NamedSharding(mesh, PartitionSpec(*spec))
+        )
+        out = Tensor._from_op(arr, x._node, x._out_index)
+        out.stop_gradient = x.stop_gradient
+        return out
+    except Exception:
+        return x
+
+
+class VocabParallelEmbedding(Layer):
+    """Weight sharded over vocab dim on 'mp' (reference mp_layers.py:35)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim],
+            attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.sharding_axes = ("mp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constraint(out, "dp")
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out dim over 'mp' (reference :173)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True, gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.sharding_axes = (None, "mp")
+        self.bias = (
+            self.create_parameter([out_features], is_bias=True) if has_bias else None
+        )
+        if self.bias is not None:
+            self.bias.sharding_axes = ("mp",)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constraint(out, "dp")  # gathered (replicated over mp)
+        return _constraint(out, "dp", None, "mp")
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in dim over 'mp'; output is the psum —
+    inserted by GSPMD (reference :332 does explicit mp_allreduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True, input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.sharding_axes = ("mp", None)
+        self.bias = (
+            self.create_parameter([out_features], is_bias=True) if has_bias else None
+        )
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = _constraint(x, "dp", None, "mp")
+        out = F.linear(x, self.weight, self.bias)
+        return _constraint(out, "dp")
+
+
+class ParallelCrossEntropy(Layer):
+    """Reference :498 (c_softmax_with_cross_entropy over the mp-sharded vocab
+    dim). GSPMD computes the sharded log-softmax reduction when logits carry an
+    'mp' sharding on the class dim."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        logits = _constraint(input, "dp", None, "mp")
+        return cross_entropy(
+            logits, label, reduction="none", ignore_index=self.ignore_index
+        )

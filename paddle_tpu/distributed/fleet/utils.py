@@ -1,0 +1,52 @@
+"""fleet.utils: recompute (activation checkpointing).
+
+Reference parity: fleet/recompute/recompute.py:69,330 in /root/reference.
+TPU-native: jax.checkpoint (rematerialization) — XLA re-executes the segment
+in backward, the compiler-native form of the reference's PyLayer replay. When
+`function` is a Layer, its parameters join the differentiable inputs so their
+gradients flow through the checkpointed segment.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def recompute(function, *args, **kwargs):
+    from ...core.autograd import apply, trace_mode
+    from ...core.functional import swap_state
+    from ...core.tensor import Tensor
+    from ...nn.layer import Layer
+
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+
+    arg_tensors = [a for a in args if isinstance(a, Tensor)]
+    if isinstance(function, Layer):
+        param_items = list(function.named_parameters_dict().items())
+    else:
+        param_items = []
+    n_args = len(arg_tensors)
+    all_inputs = arg_tensors + [p for _, p in param_items]
+
+    def fn(*arrs):
+        arg_arrays = arrs[:n_args]
+        param_arrays = dict(zip((k for k, _ in param_items), arrs[n_args:]))
+        it = iter(arg_arrays)
+        call_args = [
+            Tensor._from_op(next(it)) if isinstance(a, Tensor) else a for a in args
+        ]
+        with trace_mode():
+            if param_items:
+                with swap_state(function, params=param_arrays):
+                    out = function(*call_args, **kwargs)
+            else:
+                out = function(*call_args, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._array if isinstance(o, Tensor) else o for o in out)
+        return out._array if isinstance(out, Tensor) else out
+
+    ck = jax.checkpoint(fn)
+    out, node = apply(ck, *all_inputs, name="recompute")
+    if isinstance(out, tuple):
+        return tuple(Tensor._from_op(o, node, i) for i, o in enumerate(out))
+    return Tensor._from_op(out, node)

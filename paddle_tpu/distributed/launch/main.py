@@ -1,0 +1,69 @@
+"""python -m paddle_tpu.distributed.launch — multi-host job launcher.
+
+Reference parity: python/paddle/distributed/launch/main.py:18 +
+controllers/collective.py build_pod:32 (per-rank env PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_MASTER:154-161), job/container.py per-rank
+log files, watcher.
+
+TPU-native design: ONE process per host drives all local chips (SPMD), so the
+launcher spawns one training process per host entry instead of one per
+device; rank env maps to jax.distributed coordination (process_id/
+coordinator_address). On a single host it simply execs the script with rank 0
+after exporting the coordination env. Elastic restart: watches the child and
+relaunches up to --max_restarts on nonzero exit (the ElasticManager role at
+epoch/checkpoint granularity — slice failures restart the whole program from
+the latest checkpoint, the TPU failure model).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+
+def launch_main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--master", default=None, help="coordinator host:port")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--rank", type=int, default=int(os.getenv("NODE_RANK", "0")))
+    parser.add_argument("--log_dir", default="log")
+    parser.add_argument("--max_restarts", type=int, default=0)
+    parser.add_argument("--devices", default=None, help="unused on TPU (SPMD)")
+    parser.add_argument("script", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if not args.script:
+        parser.error("no training script given")
+    script = args.script
+    if script and script[0] == "--":
+        script = script[1:]
+
+    env = dict(os.environ)
+    env["PADDLE_TRAINER_ID"] = str(args.rank)
+    env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    restarts = 0
+    while True:
+        log_path = os.path.join(args.log_dir, f"workerlog.{args.rank}")
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable] + script, env=env, stdout=logf, stderr=subprocess.STDOUT
+            )
+            code = proc.wait()
+        if code == 0:
+            return 0
+        if restarts >= args.max_restarts:
+            print(f"worker exited with {code}; giving up after {restarts} restarts")
+            return code
+        restarts += 1
+        print(f"worker exited with {code}; restart {restarts}/{args.max_restarts}")
+        time.sleep(3)
+
+
+if __name__ == "__main__":
+    sys.exit(launch_main())

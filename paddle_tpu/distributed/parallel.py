@@ -1,0 +1,153 @@
+"""Process bootstrap + DataParallel.
+
+Reference parity: python/paddle/distributed/parallel.py:919 (init_parallel_env
+creating TCPStore + default ProcessGroup from PADDLE_TRAINER_* env) and :200
+(paddle.DataParallel -> EagerReducer bucketed allreduce).
+
+TPU-native design: coordination is jax.distributed (the coordination-service
+replacement for TCPStore, SURVEY.md §5); there is no NCCL-id exchange. Within
+one process, data parallelism is SPMD over the mesh's dp axis — gradient
+all-reduce is *compiled into* the train step by GSPMD when batches are
+sharded, so DataParallel is a thin marker wrapper (the EagerReducer's
+bucketing job is XLA's).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..nn.layer import Layer
+from . import mesh as mesh_mod
+
+
+class ParallelEnv:
+    """Reference parallel.py ParallelEnv: rank/world/device info from env."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", jax.process_index()))
+        self._world_size = int(
+            os.getenv("PADDLE_TRAINERS_NUM", jax.process_count())
+        )
+        self._device_id = 0
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def device_type(self):
+        return "tpu"
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        return eps[self._rank] if self._rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Initialize multi-host coordination if PADDLE_* / JAX coordination env is
+    present; always installs a default mesh over local devices."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.getenv("PADDLE_MASTER") or os.getenv("MASTER_ADDR")
+    nprocs = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1:
+        port = os.getenv("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=nprocs, process_id=pid
+        )
+    if mesh_mod.get_mesh() is None:
+        mesh_mod.init_mesh({"dp": len(jax.devices())})
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    return int(os.getenv("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size(group=None):
+    return int(os.getenv("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+
+class DataParallel(Layer):
+    """Wraps a layer for data-parallel training.
+
+    Under the compiled train step with a dp-sharded batch, XLA inserts the
+    gradient all-reduce (GSPMD) — comm_buffer_size/bucketing knobs are
+    accepted for API parity but moot. `no_sync` matches the reference API
+    (parallel.py:502); in SPMD it means 'skip psum', honored by the sharded
+    step builder via the _sync flag."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._sync = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._sync = False
+            try:
+                yield
+            finally:
+                self._sync = True
+
+        return ctx()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference spawn.py. Multi-process per-device spawn is not the TPU model
+    (one process drives all local chips via SPMD); run func once."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    raise NotImplementedError(
+        "multi-process spawn is replaced by SPMD over the local mesh; "
+        "use paddle_tpu.distributed.launch for multi-host"
+    )

@@ -1,0 +1,337 @@
+"""Probability distributions.
+
+Reference parity: python/paddle/distribution/ in /root/reference (~15
+distributions + kl_divergence registry). Implemented over
+jax.scipy/jax.random.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.tensor import Tensor
+from ..ops._helpers import T
+
+
+def _arr(x):
+    return T(x)._array if not isinstance(x, (int, float)) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor._from_op(jnp.exp(self.log_prob(value)._array))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self._batch_shape
+        z = jax.random.normal(rng.next_key(), shp)
+        return Tensor._from_op(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale**2
+        return Tensor._from_op(
+            -((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+        )
+
+    def entropy(self):
+        return Tensor._from_op(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale) * jnp.ones(self._batch_shape)
+        )
+
+    def cdf(self, value):
+        return Tensor._from_op(jax.scipy.stats.norm.cdf(_arr(value), self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return Tensor._from_op(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor._from_op(jnp.broadcast_to(self.scale**2, self._batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(rng.next_key(), shp)
+        return Tensor._from_op(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor._from_op(
+            jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        )
+
+    def entropy(self):
+        return Tensor._from_op(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(
+            jax.random.bernoulli(rng.next_key(), self.probs, shp).astype(jnp.float32)
+        )
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor._from_op(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor._from_op(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(
+            jax.random.categorical(rng.next_key(), self.logits, shape=shp)
+        )
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor._from_op(jnp.take_along_axis(logp, v[..., None], -1)[..., 0])
+
+    def probs(self, value):
+        return Tensor._from_op(jnp.exp(self.log_prob(value)._array))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor._from_op(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs, 1e-30))
+        shp = tuple(shape) + self._batch_shape
+        draws = jax.random.categorical(
+            rng.next_key(), logits, shape=(self.total_count,) + shp
+        )
+        n = self.probs.shape[-1]
+        return Tensor._from_op(
+            jnp.sum(jax.nn.one_hot(draws, n), axis=0).astype(jnp.float32)
+        )
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = jnp.log(jnp.maximum(self.probs, 1e-30))
+        return Tensor._from_op(
+            jax.scipy.special.gammaln(self.total_count + 1)
+            - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+            + jnp.sum(v * logp, -1)
+        )
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(jax.random.beta(rng.next_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        from jax.scipy.special import betaln
+
+        return Tensor._from_op(
+            (self.alpha - 1) * jnp.log(v)
+            + (self.beta - 1) * jnp.log1p(-v)
+            - betaln(self.alpha, self.beta)
+        )
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(
+            jnp.broadcast_shapes(self.concentration.shape, self.rate.shape)
+        )
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(
+            jax.random.gamma(rng.next_key(), self.concentration, shp) / self.rate
+        )
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor._from_op(
+            a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jax.scipy.special.gammaln(a)
+        )
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(
+            jax.random.dirichlet(rng.next_key(), self.concentration, shp)
+        )
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a = self.concentration
+        return Tensor._from_op(
+            jnp.sum((a - 1) * jnp.log(v), -1)
+            + jax.scipy.special.gammaln(jnp.sum(a, -1))
+            - jnp.sum(jax.scipy.special.gammaln(a), -1)
+        )
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(jax.random.exponential(rng.next_key(), shp) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor._from_op(jnp.log(self.rate) - self.rate * v)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(
+            self.loc + self.scale * jax.random.laplace(rng.next_key(), shp)
+        )
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor._from_op(
+            -jnp.log(2 * self.scale) - jnp.abs(v - self.loc) / self.scale
+        )
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(
+            self.loc + self.scale * jax.random.gumbel(rng.next_key(), shp)
+        )
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor._from_op(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor._from_op(
+            jnp.exp(self.loc + self.scale * jax.random.normal(rng.next_key(), shp))
+        )
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logv = jnp.log(v)
+        return Tensor._from_op(
+            -((logv - self.loc) ** 2) / (2 * self.scale**2)
+            - logv
+            - jnp.log(self.scale)
+            - 0.5 * math.log(2 * math.pi)
+        )
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor._from_op(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        logp = jax.nn.log_softmax(p.logits, -1)
+        logq = jax.nn.log_softmax(q.logits, -1)
+        return Tensor._from_op(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+        return Tensor._from_op(
+            pp * (jnp.log(pp) - jnp.log(qq)) + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq))
+        )
+    raise NotImplementedError(f"kl_divergence({type(p).__name__}, {type(q).__name__})")

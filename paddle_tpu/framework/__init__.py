@@ -1,0 +1,2 @@
+from . import io  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401

@@ -1,0 +1,68 @@
+"""paddle.save / paddle.load.
+
+Reference parity: python/paddle/framework/io.py:639,881 in /root/reference —
+pickled nested state structures with tensor payloads. Tensors serialize as
+numpy arrays (portable across hosts/devices); bfloat16 is round-tripped via a
+uint16 view + dtype tag since pickle of ml_dtypes arrays is avoided.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+class _TensorPayload:
+    def __init__(self, arr):
+        a = np.asarray(arr)
+        if a.dtype.name == "bfloat16":
+            self.raw = a.view(np.uint16)
+            self.dtype = "bfloat16"
+        else:
+            self.raw = a
+            self.dtype = a.dtype.name
+
+    def restore(self):
+        if self.dtype == "bfloat16":
+            import ml_dtypes
+
+            return self.raw.view(ml_dtypes.bfloat16)
+        return self.raw
+
+
+def _pack(obj):
+    if isinstance(obj, (Tensor, Parameter)):
+        return _TensorPayload(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        arr = obj.restore()
+        return arr if return_numpy else Tensor(arr)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

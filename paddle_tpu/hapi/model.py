@@ -1,0 +1,409 @@
+"""paddle.Model: the high-level train/eval/predict API.
+
+Reference parity: python/paddle/hapi/model.py:1037 (Model), fit:1732,
+train_batch:1178, DynamicGraphAdapter:763 vs StaticGraphAdapter:286.
+
+TPU-native design: there is ONE adapter — the compiled-step adapter. Each
+train/eval batch executes a single cached XLA program (forward + loss + grads
++ optimizer update, buffers donated) built from functional_call — this is the
+whole-program-XLA north star of BASELINE.json applied at the hapi level.
+Eager fallback (`compiled=False`) runs the tape for debugging.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.functional import (
+    functional_call,
+    load_state_arrays,
+    state_dict_arrays,
+    tree_to_tensors,
+)
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset, DistributedBatchSampler
+from ..metric import Metric
+from ..optimizer.lr import LRScheduler
+from . import callbacks as cbks_mod
+
+
+def to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = to_list(inputs)
+        self._labels = to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._compiled_steps = {}
+        self._opt_state = None
+        self.stop_training = False
+        self._compiled = True
+        self.mode = "train"
+
+    # ---- preparation -------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None, compiled=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be paddle_tpu.metric.Metric, got {type(m)}")
+        self._compiled = compiled
+        self._compiled_steps = {}
+
+    # ---- compiled step construction ----------------------------------------
+    def _apply_loss(self, outputs, labels):
+        outs = to_list(outputs)
+        labs = to_list(labels)
+        losses = self._loss(*(outs + labs))
+        losses = to_list(losses)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        from ..ops.math import mean as _mean
+
+        if total.size != 1:
+            total = _mean(total)
+        return total
+
+    def _make_train_step(self, n_inputs, n_labels):
+        net = self.network
+        optimizer = self._optimizer
+
+        def step(params, buffers, opt_state, lr, key, *arrays):
+            in_arrays = arrays[:n_inputs]
+            lab_arrays = arrays[n_inputs:]
+
+            def loss_fn(p):
+                outs, new_buf = functional_call(
+                    net, p, buffers, args=in_arrays, rng_key=key, training=True
+                )
+                from ..core import autograd
+
+                with autograd.trace_mode():
+                    total = self._apply_loss(
+                        tree_to_tensors(outs), [Tensor._from_op(a) for a in lab_arrays]
+                    )
+                return total._array, (outs, new_buf)
+
+            (loss, (outs, new_buf)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            new_params, new_opt = optimizer.apply_gradients_arrays(
+                params, grads, opt_state, lr
+            )
+            return loss, outs, new_buf, new_params, new_opt
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _make_eval_step(self, n_inputs, n_labels, with_loss):
+        net = self.network
+
+        def step(params, buffers, key, *arrays):
+            in_arrays = arrays[:n_inputs]
+            lab_arrays = arrays[n_inputs:]
+            outs, _ = functional_call(
+                net, params, buffers, args=in_arrays, rng_key=key, training=False
+            )
+            if with_loss:
+                from ..core import autograd
+
+                with autograd.trace_mode():
+                    total = self._apply_loss(
+                        tree_to_tensors(outs), [Tensor._from_op(a) for a in lab_arrays]
+                    )
+                return outs, total._array
+            return outs, None
+
+        return jax.jit(step)
+
+    def _shapes_key(self, mode, arrays):
+        return (mode,) + tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+    @staticmethod
+    def _as_arrays(xs):
+        out = []
+        for x in to_list(xs):
+            if isinstance(x, Tensor):
+                out.append(x._array)
+            else:
+                a = np.asarray(x)
+                if a.dtype == np.float64:
+                    a = a.astype(np.float32)
+                out.append(jnp.asarray(a))
+        return out
+
+    # ---- batch-level API ----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        ins = self._as_arrays(inputs)
+        labs = self._as_arrays(labels)
+        if not self._compiled:
+            return self._train_batch_eager(ins, labs)
+        params, buffers = state_dict_arrays(self.network)
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init_state_arrays(params)
+        key = self._shapes_key("train", ins + labs)
+        if key not in self._compiled_steps:
+            self._compiled_steps[key] = self._make_train_step(len(ins), len(labs))
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        loss, outs, new_buf, new_params, new_opt = self._compiled_steps[key](
+            params, buffers, self._opt_state, lr, rng.next_key(), *ins, *labs
+        )
+        load_state_arrays(self.network, params=new_params, buffers=new_buf)
+        self._opt_state = new_opt
+        self._optimizer._step_count += 1
+        metrics = self._update_metrics(outs, labs)
+        loss_val = [float(np.asarray(loss))]
+        if metrics:
+            return loss_val, metrics
+        return loss_val
+
+    def _train_batch_eager(self, ins, labs):
+        outs = self.network(*[Tensor._from_op(a) for a in ins])
+        total = self._apply_loss(outs, [Tensor._from_op(a) for a in labs])
+        total.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = self._update_metrics(
+            jax.tree_util.tree_map(
+                lambda t: t._array if isinstance(t, Tensor) else t,
+                outs,
+                is_leaf=lambda t: isinstance(t, Tensor),
+            ),
+            labs,
+        )
+        loss_val = [float(np.asarray(total._array))]
+        return (loss_val, metrics) if metrics else loss_val
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = self._as_arrays(inputs)
+        labs = self._as_arrays(labels)
+        params, buffers = state_dict_arrays(self.network)
+        with_loss = self._loss is not None and len(labs) > 0
+        key = self._shapes_key(("eval", with_loss), ins + labs)
+        if key not in self._compiled_steps:
+            self._compiled_steps[key] = self._make_eval_step(len(ins), len(labs), with_loss)
+        outs, loss = self._compiled_steps[key](params, buffers, rng.next_key(), *ins, *labs)
+        metrics = self._update_metrics(outs, labs)
+        if with_loss:
+            return [float(np.asarray(loss))], metrics
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = self._as_arrays(inputs)
+        params, buffers = state_dict_arrays(self.network)
+        key = self._shapes_key("predict", ins)
+        if key not in self._compiled_steps:
+            self._compiled_steps[key] = self._make_eval_step(len(ins), 0, False)
+        outs, _ = self._compiled_steps[key](params, buffers, rng.next_key(), *ins)
+        return to_list(jax.tree_util.tree_map(np.asarray, outs))
+
+    def _update_metrics(self, outs, labs):
+        if not self._metrics:
+            return []
+        out_tensors = to_list(tree_to_tensors(outs))
+        lab_tensors = [Tensor._from_op(a) for a in labs]
+        results = []
+        for m in self._metrics:
+            state = m.compute(*(out_tensors + lab_tensors))
+            r = m.update(*to_list(state))
+            results.append(r)
+        return results
+
+    # ---- loop API -----------------------------------------------------------
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size=1,
+        epochs=1,
+        eval_freq=1,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        verbose=2,
+        drop_last=False,
+        shuffle=True,
+        num_workers=0,
+        callbacks=None,
+        accumulate_grad_batches=1,
+        num_iters=None,
+    ):
+        train_loader = self._to_loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, False, num_workers) if eval_data is not None else None
+
+        do_eval = eval_loader is not None
+        steps = self._len_or_none(train_loader)
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps, log_freq=log_freq,
+            save_freq=save_freq, save_dir=save_dir, verbose=verbose,
+            metrics=self._metrics_name(),
+        )
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train", num_iters)
+            cbks.on_epoch_end(epoch, logs)
+            if do_eval and (epoch % eval_freq == 0 or epoch == epochs - 1):
+                eval_steps = self._len_or_none(eval_loader)
+                cbks.on_begin("eval", {"steps": eval_steps, "metrics": self._metrics_name()})
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                cbks.on_end("eval", eval_logs)
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None, num_iters=None):
+        loader = self._to_loader(eval_data, batch_size, False, False, num_workers)
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, steps=self._len_or_none(loader),
+            log_freq=log_freq, verbose=verbose, metrics=self._metrics_name(),
+        )
+        cbks.on_begin("eval")
+        logs = self._run_one_epoch(loader, cbks, "eval", num_iters)
+        cbks.on_end("eval", logs)
+        result = {}
+        if self._loss is not None:
+            result["loss"] = logs.get("loss")
+        for m in self._metrics:
+            for name, val in zip(to_list(m.name()), to_list(m.accumulate())):
+                result[name] = val
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        cbks = cbks_mod.config_callbacks(
+            callbacks, model=self, steps=self._len_or_none(loader), verbose=verbose
+        )
+        cbks.on_begin("predict")
+        for step, data in enumerate(loader):
+            data = to_list(data)
+            n_in = len(self._inputs) or (len(data) - 1 if len(data) > 1 else 1)
+            outs = self.predict_batch(data[:n_in])
+            outputs.append(outs)
+            cbks.on_batch_end("predict", step, {"step": step})
+        cbks.on_end("predict")
+        # transpose list-of-batches to per-output lists
+        outputs = list(zip(*outputs))
+        if stack_outputs:
+            outputs = [np.concatenate(o, axis=0) for o in outputs]
+        else:
+            outputs = [list(o) for o in outputs]
+        return outputs
+
+    def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
+        metrics_names = self._metrics_name()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, data in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_batch_begin(mode, step, logs)
+            data = to_list(data)
+            n_in = len(self._inputs) or (len(data) - len(self._labels) if self._labels else len(data) - 1)
+            if n_in <= 0:
+                n_in = len(data) - 1 if len(data) > 1 else len(data)
+            ins, labs = data[:n_in], data[n_in:]
+            if mode == "train":
+                result = self.train_batch(ins, labs)
+                if isinstance(self._optimizer._learning_rate, LRScheduler):
+                    self._optimizer._learning_rate.step()
+            else:
+                result = self.eval_batch(ins, labs)
+            logs = self._merge_logs(result, metrics_names, step, len(to_list(ins)[0]) if ins else 0)
+            cbks.on_batch_end(mode, step, logs)
+        self._reset_nothing = None
+        return logs
+
+    def _merge_logs(self, result, metrics_names, step, batch_size):
+        logs = {"step": step, "batch_size": batch_size}
+        if isinstance(result, tuple):
+            losses, metrics = result
+            logs["loss"] = losses[0] if isinstance(losses, list) else losses
+        elif isinstance(result, list) and self._loss is not None:
+            # train/eval path without metrics: the list is the loss values
+            logs["loss"] = result[0]
+        for m in self._metrics:
+            for name, val in zip(to_list(m.name()), to_list(m.accumulate())):
+                logs[name] = val
+        return logs
+
+    def _metrics_name(self):
+        names = ["loss"] if self._loss else []
+        for m in self._metrics:
+            names.extend(to_list(m.name()))
+        return names
+
+    def _len_or_none(self, loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            try:
+                from ..distributed import get_world_size
+
+                dist = get_world_size() > 1
+            except Exception:
+                dist = False
+            if dist:
+                sampler = DistributedBatchSampler(
+                    data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last
+                )
+                return DataLoader(
+                    data, batch_sampler=sampler, num_workers=num_workers
+                )
+            return DataLoader(
+                data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last, num_workers=num_workers,
+            )
+        raise TypeError(f"unsupported data type {type(data)}")
+
+    # ---- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+        self._opt_state = None  # re-seeded from optimizer accumulators lazily
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtype)

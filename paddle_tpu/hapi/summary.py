@@ -1,0 +1,47 @@
+"""Model summary + flops estimate.
+
+Reference parity: python/paddle/hapi/model_summary.py and hapi flops in
+/root/reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total_params = 0
+    trainable = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = sum(int(np.prod(p.shape)) for p in layer._parameters.values() if p is not None)
+        for p in layer._parameters.values():
+            if p is None:
+                continue
+            total_params_local = int(np.prod(p.shape))
+            total_params += total_params_local
+            if not p.stop_gradient:
+                trainable += total_params_local
+        rows.append((name or type(net).__name__, type(layer).__name__, n_params))
+    width = max((len(r[0]) for r in rows), default=10) + 2
+    lines = [f"{'Layer':<{width}}{'Type':<24}{'Params':>12}", "-" * (width + 36)]
+    for name, tname, n in rows:
+        lines.append(f"{name:<{width}}{tname:<24}{n:>12,}")
+    lines.append("-" * (width + 36))
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total_params - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough flops: 2 * params * batch for dense nets (exact per-op counting
+    via XLA cost analysis is exposed by jit(f).lower().cost_analysis())."""
+    if isinstance(net, Layer):
+        total_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+        batch = input_size[0] if input_size else 1
+        return 2 * total_params * batch
+    return 0

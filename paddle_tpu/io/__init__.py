@@ -1,0 +1,26 @@
+"""paddle.io parity: Dataset / Sampler / DataLoader.
+
+Reference parity: python/paddle/io/__init__.py re-exporting
+python/paddle/fluid/reader.py:311 (DataLoader) and fluid/dataloader/ in
+/root/reference.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

@@ -1,0 +1,242 @@
+"""DataLoader: batching + multiprocess workers + device prefetch.
+
+Reference parity: python/paddle/fluid/reader.py:311 (DataLoader),
+fluid/dataloader/dataloader_iter.py:162 (single-process) and :370
+(multiprocess workers over shared-memory queues), and the C++ BufferedReader
+H2D double-buffering (paddle/fluid/operators/reader/buffered_reader.h:48).
+
+TPU design: workers produce numpy batches (multiprocessing.Pool-style worker
+loop); a prefetch thread stages the next `prefetch_factor` batches onto the
+device with jax.device_put while the current step computes — the
+BufferedReader role. Returned batches are framework Tensors.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as pyqueue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler, DistributedBatchSampler
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        arr = np.stack(batch)
+    elif isinstance(sample, Tensor):
+        arr = np.stack([s.numpy() for s in batch])
+    elif isinstance(sample, (int, np.integer)):
+        arr = np.asarray(batch, np.int64)
+    elif isinstance(sample, (float, np.floating)):
+        arr = np.asarray(batch, np.float32)
+    else:
+        return batch
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers, seed):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed((seed + worker_id) % (2**31))
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data_queue.put((batch_id, collate_fn(samples), None))
+        except Exception as e:  # propagate worker errors
+            data_queue.put((batch_id, None, e))
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler=None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn=None,
+        num_workers=0,
+        use_buffer_reader=True,
+        prefetch_factor=2,
+        use_shared_memory=True,
+        timeout=0,
+        worker_init_fn=None,
+        persistent_workers=False,
+    ):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset loader is unknown")
+        return len(self.batch_sampler)
+
+    def _batches_numpy(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                chunk = list(itertools.islice(it, self.batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(chunk)
+        elif self.num_workers == 0:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+        else:
+            yield from self._batches_multiprocess()
+
+    def _batches_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_queue = ctx.Queue()
+        data_queue = ctx.Queue()
+        seed = np.random.randint(0, 2**31)
+        workers = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_queue, data_queue, self.collate_fn, i, self.num_workers, seed),
+                daemon=True,
+            )
+            for i in range(self.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            n_sent = 0
+            for batch_id, indices in enumerate(self.batch_sampler):
+                index_queue.put((batch_id, indices))
+                n_sent += 1
+            reorder = {}
+            next_id = 0
+            for _ in range(n_sent):
+                bid, data, err = data_queue.get()
+                if err is not None:
+                    raise err
+                reorder[bid] = data
+                while next_id in reorder:
+                    yield reorder.pop(next_id)
+                    next_id += 1
+        finally:
+            for _ in workers:
+                index_queue.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+
+    def __iter__(self):
+        gen = self._batches_numpy()
+        if not self.use_buffer_reader:
+            try:
+                for b in gen:
+                    yield _to_tensor_tree(b)
+            finally:
+                gen.close()  # triggers worker shutdown in _batches_multiprocess
+            return
+        # prefetch thread: host->device staging overlaps compute. The stop
+        # event + timed puts guarantee the producer exits (and closes the
+        # underlying generator, shutting down worker processes) even when the
+        # consumer abandons the iterator mid-epoch.
+        q = pyqueue.Queue(maxsize=self.prefetch_factor)
+        SENTINEL = object()
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for b in gen:
+                    item = _to_tensor_tree(b)
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except pyqueue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except Exception as e:
+                if not stop.is_set():
+                    try:
+                        q.put(e, timeout=1.0)
+                    except pyqueue.Full:
+                        pass
+            finally:
+                gen.close()
+                while True:
+                    try:
+                        q.put(SENTINEL, timeout=0.1)
+                        break
+                    except pyqueue.Full:
+                        if stop.is_set():
+                            break
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is SENTINEL:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=5)

@@ -1,0 +1,1 @@
+from .api import TracedProgram, load, not_to_static, save, to_static  # noqa: F401

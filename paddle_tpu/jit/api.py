@@ -1,0 +1,193 @@
+"""jit.to_static: trace + compile a Layer/function to one XLA executable.
+
+Reference parity: python/paddle/jit/api.py:222 (@to_static),
+dy2static/program_translator.py:299 (StaticFunction, per-input-spec concrete
+program cache), partial_program.py:148 (execute captured program).
+
+TPU-native design (SURVEY.md §7 step 4): *tracing*, not AST rewriting — the
+function runs once under jax tracing via functional_call; XLA compiles and
+caches one executable per (input shapes, dtypes, training flag). Data-
+dependent Python control flow must use lax-style ops (paddle's 20 AST
+transformers are replaced by the compiler contract).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from ..core import rng
+from ..core.functional import functional_call, state_dict_arrays
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..static import InputSpec
+
+
+class TracedProgram:
+    """The 'ConcreteProgram' equivalent: a jitted callable + its state."""
+
+    def __init__(self, fn, layer=None):
+        self.layer = layer
+        self.fn = fn
+
+
+class StaticFunction:
+    def __init__(self, function, input_spec=None, layer=None):
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache = {}
+        functools.update_wrapper(self, function)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(
+            self._function.__get__(instance, owner), self._input_spec, layer=instance
+        )
+        return bound
+
+    def _key(self, args):
+        key = []
+        for a in args:
+            if isinstance(a, Tensor):
+                key.append((tuple(a.shape), str(np.dtype(a.dtype))))
+            else:
+                key.append(repr(a))
+        layer = self._layer
+        if isinstance(layer, Layer):
+            key.append(layer.training)
+        return tuple(key)
+
+    def __call__(self, *args, **kwargs):
+        from ..core import autograd as _autograd
+
+        if _autograd.in_trace_mode():
+            # already inside a trace (functional_call) — run the original
+            # forward body; the outer jit owns compilation
+            return self._function(*args, **kwargs)
+        layer = self._layer
+        if not isinstance(layer, Layer):
+            # plain function: jit over arrays directly
+            return self._call_function(*args, **kwargs)
+        key = self._key(args)
+        entry = self._cache.get(key)
+        if entry is None:
+            training = layer.training
+
+            @jax.jit
+            def compiled(params, buffers, key_, *arrays):
+                out, new_buf = functional_call(
+                    layer, params, buffers,
+                    args=tuple(arrays), kwargs=kwargs,
+                    rng_key=key_, training=training,
+                )
+                return out, new_buf
+
+            entry = compiled
+            self._cache[key] = entry
+        params, buffers = state_dict_arrays(layer)
+        arrays = tuple(a._array if isinstance(a, Tensor) else a for a in args)
+        out, new_buf = entry(params, buffers, rng.next_key(), *arrays)
+        from ..core.functional import load_state_arrays, tree_to_tensors
+
+        load_state_arrays(layer, buffers=new_buf)
+        return tree_to_tensors(out)
+
+    def _call_function(self, *args, **kwargs):
+        fn = self._function
+
+        key = self._key(args)
+        entry = self._cache.get(key)
+        if entry is None:
+            from ..core import autograd
+
+            @jax.jit
+            def compiled(key_, *arrays):
+                tensors = tuple(
+                    Tensor._from_op(a) if isinstance(a, jax.Array) else a for a in arrays
+                )
+                with autograd.trace_mode(), rng.key_scope(key_):
+                    out = fn(*tensors, **kwargs)
+                return jax.tree_util.tree_map(
+                    lambda x: x._array if isinstance(x, Tensor) else x,
+                    out,
+                    is_leaf=lambda x: isinstance(x, Tensor),
+                )
+
+            entry = compiled
+            self._cache[key] = entry
+        arrays = tuple(a._array if isinstance(a, Tensor) else a for a in args)
+        out = entry(rng.next_key(), *arrays)
+        from ..core.functional import tree_to_tensors
+
+        return tree_to_tensors(out)
+
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._function)
+
+    def concrete_program_specify_input_spec(self, input_spec=None):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec, layer=fn)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save parity: persist state_dict + class info + input spec.
+
+    The reference serializes a ProgramDesc (jit/translated_layer.py); here the
+    program is re-traced from the layer class on load (weights + config are
+    the durable artifact; XLA recompiles for the target hardware — stronger
+    portability than a serialized graph)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from ..framework.io import save as fsave
+
+    state = layer.state_dict() if isinstance(layer, Layer) else {}
+    fsave(state, path + ".pdparams")
+    meta = {
+        "class_module": type(layer).__module__,
+        "class_name": type(layer).__name__,
+        "input_spec": [
+            (s.shape, np.dtype(s.dtype).name) if isinstance(s, InputSpec) else None
+            for s in (input_spec or [])
+        ],
+    }
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    import importlib
+
+    from ..framework.io import load as fload
+
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    mod = importlib.import_module(meta["class_module"])
+    cls = getattr(mod, meta["class_name"])
+    layer = cls.__new__(cls)
+    raise NotImplementedError(
+        "jit.load requires reconstructable layers; use paddle_tpu.load + "
+        "set_state_dict for weights, or the inference predictor."
+    )

@@ -1,0 +1,2 @@
+from .gpt import GPT, GPTConfig, gpt_tiny, gpt_small, gpt_1p3b  # noqa: F401
+from .bert import Bert, BertConfig, bert_base, ernie_base  # noqa: F401

@@ -1,0 +1,180 @@
+"""GPT: the flagship decoder-only LM (BASELINE.json config 4: GPT-1.3B TP+PP).
+
+Built from the framework's own TP layers (ColumnParallelLinear /
+RowParallelLinear / VocabParallelEmbedding — the Megatron partitioning of the
+reference's fleet/layers/mpu/mp_layers.py) with flash attention on the
+Pallas kernel and activation remat. Sequence-parallel activations are
+annotated on the 'sp' axis; ring attention (context parallel) is selected by
+`attn_impl='ring'`.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import nn
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    _constraint,
+)
+from ..nn import initializer as I
+from ..ops import common_nn as F
+from ..ops import manipulation as M
+
+
+class GPTConfig:
+    def __init__(
+        self,
+        vocab_size=50304,
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        max_seq_len=1024,
+        intermediate_size=None,
+        dropout=0.0,
+        attn_impl="flash",  # flash | ring | xla
+        remat=False,
+        dtype="float32",
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_seq_len = max_seq_len
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.dropout = dropout
+        self.attn_impl = attn_impl
+        self.remat = remat
+        self.dtype = dtype
+
+
+class CausalSelfAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.qkv = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False
+        )
+        self.proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, input_is_parallel=True
+        )
+        self.dropout = cfg.dropout
+
+    def forward(self, x):
+        b, s, _ = x.shape
+        qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded on last dim)
+        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q = M.squeeze(M.slice(qkv, [2], [0], [1]), 2)
+        k = M.squeeze(M.slice(qkv, [2], [1], [2]), 2)
+        v = M.squeeze(M.slice(qkv, [2], [2], [3]), 2)
+        if self.cfg.attn_impl == "ring":
+            from ..parallel.ring_attention import ring_attention
+
+            out, node = autograd.apply(
+                lambda qa, ka, va: ring_attention(qa, ka, va, causal=True),
+                q, k, v, name="ring_attention",
+            )
+            out = Tensor._from_op(out, node)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, dropout_p=self.dropout, is_causal=True,
+                training=self.training,
+            )
+        out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.proj(out)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = CausalSelfAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.fc1 = ColumnParallelLinear(
+            cfg.hidden_size, cfg.intermediate_size, gather_output=False
+        )
+        self.fc2 = RowParallelLinear(
+            cfg.intermediate_size, cfg.hidden_size, input_is_parallel=True
+        )
+        self.act = nn.GELU(approximate=True)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self._cfg = cfg
+
+    def _inner(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = _constraint(x, "dp", "sp", None)
+        x = x + self.dropout(self.fc2(self.act(self.fc1(self.ln2(x)))))
+        x = _constraint(x, "dp", "sp", None)
+        return x
+
+    def forward(self, x):
+        if self._cfg.remat:
+            from ..distributed.fleet.utils import recompute
+
+            return recompute(self._inner, x)
+        return self._inner(x)
+
+
+class GPT(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        # LM head is weight-tied to wte (standard GPT; the reference ties via
+        # SharedLayerDesc in pp_layers)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = M.reshape(Tensor(np.arange(s, dtype=np.int64)), [1, s])
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        x = _constraint(x, "dp", "sp", None)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        # logits = x @ wte.T  (vocab-parallel output)
+        logits = M.reshape(
+            F.linear(x, M.t(self.wte.weight)), [b, s, self.cfg.vocab_size]
+        )
+        logits = _constraint(logits, "dp", "sp", "mp")
+        return logits
+
+
+def gpt_loss_fn(logits_arrays, labels_array):
+    """Functional loss for the compiled sharded step (next-token CE)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = logits_arrays if not isinstance(logits_arrays, (tuple, list)) else logits_arrays[0]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, labels_array[..., None].astype(jnp.int32), axis=-1)
+    return -jnp.mean(picked)
+
+
+def gpt_tiny(**kw):
+    return GPT(GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8, max_seq_len=256, **kw))
+
+
+def gpt_small(**kw):
+    return GPT(GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12, max_seq_len=1024, **kw))
+
+
+def gpt_1p3b(**kw):
+    """GPT-3 1.3B shape (BASELINE config 4)."""
+    return GPT(
+        GPTConfig(
+            vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+            max_seq_len=2048, **kw,
+        )
+    )

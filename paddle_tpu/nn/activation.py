@@ -1,0 +1,76 @@
+"""Activation layers. Reference parity: python/paddle/nn/layer/activation.py."""
+from __future__ import annotations
+
+from ..ops import activation as F
+from . import initializer as I
+from .layer import Layer
+
+
+def _simple(fname, **defaults):
+    fn = getattr(F, fname)
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(defaults)
+            names = list(defaults.keys())
+            for i, a in enumerate(args):
+                merged[names[i]] = a
+            merged.update({k: v for k, v in kwargs.items() if k != "name"})
+            self._kw = merged
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+
+    _Act.__name__ = fname
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+GELU = _simple("gelu", approximate=False)
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+Silu = _simple("silu")
+Mish = _simple("mish")
+Swish = _simple("swish")
+LeakyReLU = _simple("leaky_relu", negative_slope=0.01)
+ELU = _simple("elu", alpha=1.0)
+SELU = _simple("selu")
+CELU = _simple("celu", alpha=1.0)
+Hardtanh = _simple("hardtanh", min=-1.0, max=1.0)
+Hardshrink = _simple("hardshrink", threshold=0.5)
+Softshrink = _simple("softshrink", threshold=0.5)
+Tanhshrink = _simple("tanhshrink")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Softplus = _simple("softplus", beta=1, threshold=20)
+Softsign = _simple("softsign")
+ThresholdedReLU = _simple("thresholded_relu", threshold=1.0)
+LogSigmoid = _simple("log_sigmoid")
+Softmax = _simple("softmax", axis=-1)
+LogSoftmax = _simple("log_softmax", axis=-1)
+GLU = _simple("glu", axis=-1)
+RReLU = _simple("rrelu", lower=0.125, upper=0.3333333333333333)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr, default_initializer=I.Constant(init)
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,110 @@
+"""Gradient clipping.
+
+Reference parity: python/paddle/nn/clip.py in /root/reference
+(ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm:560).
+Operate on (param, grad) Tensor lists eagerly; the compiled train-step path
+uses the functional `clip_grads_arrays` on pytrees.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor._from_op(jnp.clip(g._array, self.min, self.max))))
+        return out
+
+    def clip_arrays(self, grads):
+        return [jnp.clip(g, self.min, self.max) if g is not None else None for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._array)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor._from_op(g._array * scale)))
+        return out
+
+    def clip_arrays(self, grads):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append(g * scale)
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        grads = [g._array for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor._from_op((g._array * scale).astype(g._array.dtype))))
+        return out
+
+    def clip_arrays(self, grads):
+        live = [g for g in grads if g is not None]
+        if not live:
+            return grads
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in live))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [None if g is None else (g * scale).astype(g.dtype) for g in grads]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in parameters if p._grad is not None]
+    if not params:
+        return Tensor(0.0)
+    total = jnp.power(
+        sum(jnp.sum(jnp.power(jnp.abs(p._grad), norm_type)) for p in params),
+        1.0 / norm_type,
+    )
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p._grad = p._grad * scale
+    return Tensor._from_op(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)

@@ -1,0 +1,60 @@
+"""paddle.nn.functional surface — aggregates the functional op modules.
+
+Reference parity: python/paddle/nn/functional/__init__.py in /root/reference.
+"""
+from ...ops.activation import *  # noqa: F401,F403
+from ...ops.common_nn import (  # noqa: F401
+    alpha_dropout,
+    bilinear,
+    dropout,
+    dropout2d,
+    dropout3d,
+    embedding,
+    flash_attention,
+    fold,
+    interpolate,
+    label_smooth,
+    linear,
+    one_hot,
+    pad,
+    scaled_dot_product_attention,
+    sequence_mask,
+    sparse_attention,
+    temporal_shift,
+    upsample,
+    zeropad2d,
+)
+from ...ops.conv_pool import (  # noqa: F401
+    adaptive_avg_pool1d,
+    adaptive_avg_pool2d,
+    adaptive_avg_pool3d,
+    adaptive_max_pool1d,
+    adaptive_max_pool2d,
+    adaptive_max_pool3d,
+    avg_pool1d,
+    avg_pool2d,
+    avg_pool3d,
+    conv1d,
+    conv1d_transpose,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+    conv3d_transpose,
+    max_pool1d,
+    max_pool2d,
+    max_pool3d,
+    pixel_shuffle,
+    pixel_unshuffle,
+    unfold,
+)
+from ...ops.loss_ops import *  # noqa: F401,F403
+from ...ops.norm_ops import (  # noqa: F401
+    batch_norm,
+    group_norm,
+    instance_norm,
+    layer_norm,
+    local_response_norm,
+    normalize,
+    rms_norm,
+)
+from ...ops.math import sigmoid  # noqa: F401

@@ -1,0 +1,275 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU cells + scan-based drivers.
+
+Reference parity: python/paddle/nn/layer/rnn.py in /root/reference. The
+reference's C++ cudnn RNN kernels are replaced by `lax.scan` over time — the
+XLA-idiomatic form: static trip count, fused cell body, differentiable for
+free (SURVEY.md §7 "compiler-friendly control flow").
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from . import initializer as I
+from .layer import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        return Tensor(jnp.full((batch, self.hidden_size), init_value, jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    def cell_fn(self, x, h, params):
+        wi, wh, bi, bh = params
+        pre = x @ wi.T + bi + h @ wh.T + bh
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        return act(pre)
+
+    def _params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        args = (inputs, states) + self._params()
+
+        def f(x, h, wi, wh, bi, bh):
+            return self.cell_fn(x, h, (wi, wh, bi, bh))
+
+        out, node = autograd.apply(f, *args, name="simple_rnn_cell")
+        t = Tensor._from_op(out, node)
+        return t, t
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,),)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    @staticmethod
+    def cell_fn(x, h, c, wi, wh, bi, bh):
+        gates = x @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        args = (inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        out, node = autograd.apply(
+            lambda *a: LSTMCell.cell_fn(*a), *args, name="lstm_cell"
+        )
+        ht = Tensor._from_op(out[0], node, 0)
+        ct = Tensor._from_op(out[1], node, 1)
+        return ht, (ht, ct)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    @staticmethod
+    def cell_fn(x, h, wi, wh, bi, bh):
+        gi = x @ wi.T + bi
+        gh = h @ wh.T + bh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        return (1.0 - z) * n + z * h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        args = (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        out, node = autograd.apply(lambda *a: GRUCell.cell_fn(*a), *args, name="gru_cell")
+        t = Tensor._from_op(out, node)
+        return t, t
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,),)
+
+
+class RNN(Layer):
+    """Runs a cell over time with lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self.cell
+        is_lstm = isinstance(cell, LSTMCell)
+        xt = inputs
+        batch_axis = 1 if self.time_major else 0
+        batch = xt.shape[batch_axis]
+        hs = cell.hidden_size
+        if initial_states is None:
+            z = jnp.zeros((batch, hs), jnp.float32)
+            init = (z, z) if is_lstm else z
+        else:
+            if is_lstm:
+                init = (initial_states[0]._array, initial_states[1]._array)
+            else:
+                st = initial_states[0] if isinstance(initial_states, (list, tuple)) else initial_states
+                init = st._array
+
+        params = [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+        reverse = self.is_reverse
+        time_major = self.time_major
+
+        def f(x, *ps):
+            wi, wh, bi, bh = ps[:4]
+            seq = x if time_major else jnp.swapaxes(x, 0, 1)
+            if reverse:
+                seq = jnp.flip(seq, 0)
+
+            if is_lstm:
+                def step(carry, xt_):
+                    h, c = carry
+                    h2, c2 = LSTMCell.cell_fn(xt_, h, c, wi, wh, bi, bh)
+                    return (h2, c2), h2
+            elif isinstance(cell, GRUCell):
+                def step(carry, xt_):
+                    h2 = GRUCell.cell_fn(xt_, carry, wi, wh, bi, bh)
+                    return h2, h2
+            else:
+                def step(carry, xt_):
+                    h2 = cell.cell_fn(xt_, carry, (wi, wh, bi, bh))
+                    return h2, h2
+
+            final, outs = jax.lax.scan(step, init, seq)
+            if reverse:
+                outs = jnp.flip(outs, 0)
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            if is_lstm:
+                return outs, final[0], final[1]
+            return outs, final
+
+        out, node = autograd.apply(f, xt, *params, name="rnn_scan")
+        if is_lstm:
+            o = Tensor._from_op(out[0], node, 0)
+            h = Tensor._from_op(out[1], node, 1)
+            c = Tensor._from_op(out[2], node, 2)
+            return o, (h, c)
+        o = Tensor._from_op(out[0], node, 0)
+        h = Tensor._from_op(out[1], node, 1)
+        return o, h
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, False, time_major)
+        self.bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+
+        of, sf = self.fw(inputs, initial_states[0] if initial_states else None)
+        ob, sb = self.bw(inputs, initial_states[1] if initial_states else None)
+        return concat([of, ob], axis=-1), (sf, sb)
+
+
+class _RNNBase(Layer):
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, activation=None, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.dropout = dropout
+        from .container import LayerList
+
+        self.layers = LayerList()
+        num_dir = 2 if self.bidirectional else 1
+        for l in range(num_layers):
+            isz = input_size if l == 0 else hidden_size * num_dir
+            kw = {}
+            if activation is not None:
+                kw["activation"] = activation
+            if self.bidirectional:
+                self.layers.append(
+                    BiRNN(self.CELL(isz, hidden_size, **kw), self.CELL(isz, hidden_size, **kw), time_major)
+                )
+            else:
+                self.layers.append(RNN(self.CELL(isz, hidden_size, **kw), False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.common_nn import dropout as drop_fn
+
+        x = inputs
+        finals = []
+        for i, rnn in enumerate(self.layers):
+            x, st = rnn(x)
+            finals.append(st)
+            if self.dropout and i < len(self.layers) - 1:
+                x = drop_fn(x, self.dropout, training=self.training)
+        return x, finals
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

@@ -1,0 +1,25 @@
+"""Functional op library (the PHI-kernel-library role, SURVEY.md §2.1).
+
+Every op is a thin differentiable wrapper over jnp/lax — XLA is the kernel
+library; this package is the registry + dispatch layer
+(reference: paddle/phi/kernels + paddle/phi/api).
+"""
+from . import (  # noqa: F401
+    activation,
+    common_nn,
+    conv_pool,
+    creation,
+    linalg,
+    logic,
+    loss_ops,
+    manipulation,
+    math,
+    norm_ops,
+    search,
+)
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403

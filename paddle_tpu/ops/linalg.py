@@ -1,0 +1,260 @@
+"""Linear algebra ops.
+
+Reference parity: python/paddle/tensor/linalg.py in /root/reference (matmul at
+:233, norm, decomposition suite). matmul is the MXU hot path: kept as a single
+dot_general so XLA tiles it onto the systolic array; bf16 inputs stay bf16.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import T, binop, nondiff, op, op_multi
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return binop(f, x, y, name="matmul")
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return binop(f, x, y, name="dot")
+
+
+def bmm(x, y, name=None):
+    return binop(jnp.matmul, x, y, name="bmm")
+
+
+def mv(x, vec, name=None):
+    return binop(jnp.matmul, x, vec, name="mv")
+
+
+def matmul_with_flatten(x, y, x_num_col_dims=1, name=None):
+    def f(a, b):
+        lead = int(np.prod(a.shape[:x_num_col_dims])) if x_num_col_dims else 1
+        return jnp.matmul(a.reshape(lead, -1), b.reshape(b.shape[0], -1) if b.ndim > 2 else b)
+
+    return binop(f, x, y, name="mul")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == "fro" and (axis is None or isinstance(axis, (list, tuple))):
+            ax = tuple(axis) if axis is not None else None
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p in ("nuc",):
+            return jnp.sum(jnp.linalg.svd(a, compute_uv=False), axis=-1)
+        pv = float(p)
+        ax = axis if not isinstance(axis, (list, tuple)) else tuple(axis)
+        if pv == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pv == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pv == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), pv), axis=ax, keepdims=keepdim), 1.0 / pv
+        )
+
+    return op(f, T(x), name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(binop(jnp.subtract, x, y, name="sub"), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return binop(f, x, y, name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return op(f, T(x), name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return binop(f, x, y, name="cholesky_solve")
+
+
+def inverse(x, name=None):
+    return op(jnp.linalg.inv, T(x), name="inverse")
+
+
+inv = inverse
+
+
+def det(x, name=None):
+    return op(jnp.linalg.det, T(x), name="det")
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return op(f, T(x), name="slogdet")
+
+
+def svd(x, full_matrices=False, name=None):
+    return op_multi(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        T(x),
+        name="svd",
+    )
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    u, s, vh = svd(x)
+    from .manipulation import slice as slice_op
+
+    return u, s, vh
+
+
+def qr(x, mode="reduced", name=None):
+    return op_multi(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), T(x), name="qr")
+
+
+def eig(x, name=None):
+    a = np.asarray(T(x)._array)
+    w, v = np.linalg.eig(a)
+    return Tensor._from_op(jnp.asarray(w)), Tensor._from_op(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return op_multi(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), T(x), name="eigh")
+
+
+def eigvals(x, name=None):
+    a = np.asarray(T(x)._array)
+    return Tensor._from_op(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return op(lambda a: jnp.linalg.eigvalsh(a), T(x), name="eigvalsh")
+
+
+def solve(x, y, name=None):
+    return binop(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return binop(f, x, y, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol
+
+    return binop(f, x, y, name="lstsq")
+
+
+def matrix_power(x, n, name=None):
+    return op(lambda a: jnp.linalg.matrix_power(a, int(n)), T(x), name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return nondiff(
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol), T(x), name="matrix_rank"
+    )
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return op(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), T(x), name="pinv")
+
+
+def multi_dot(tensors, name=None):
+    from ..core import autograd
+
+    ts = tuple(T(t) for t in tensors)
+    out, node = autograd.apply(
+        lambda *arrs: jnp.linalg.multi_dot(arrs), *ts, name="multi_dot"
+    )
+    return Tensor._from_op(out, node)
+
+
+def cond(x, p=None, name=None):
+    return nondiff(lambda a: jnp.linalg.cond(a, p=p), T(x), name="cond")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv
+
+    xt = T(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(xt._array)
+    outs = (
+        Tensor._from_op(lu_),
+        Tensor._from_op((piv + 1).astype(np.int32)),
+    )
+    if get_infos:
+        return outs + (Tensor._from_op(jnp.zeros((), np.int32)),)
+    return outs
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return op(lambda a: jnp.corrcoef(a, rowvar=rowvar), T(x), name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return op(
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), T(x), name="cov"
+    )
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1 :, i]])
+            q = q - t[i] * (q @ v[:, None]) @ v[None, :]
+        return q
+
+    return binop(f, x, tau, name="householder_product")
+
+
+def einsum(equation, *operands, name=None):
+    from ..core import autograd
+
+    ts = tuple(T(t) for t in operands)
+    out, node = autograd.apply(
+        lambda *arrs: jnp.einsum(equation, *arrs), *ts, name="einsum"
+    )
+    return Tensor._from_op(out, node)

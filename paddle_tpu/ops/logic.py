@@ -1,0 +1,73 @@
+"""Comparison / logical / bitwise ops.
+
+Reference parity: python/paddle/tensor/logic.py in /root/reference.
+All outputs are bool/int → non-differentiable by construction.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import T, nondiff
+
+
+def _cmp(jfn, name):
+    def f(x, y, name_=None):
+        yt = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+        return nondiff(jfn, T(x), yt, name=name)
+
+    f.__name__ = name
+    return f
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return nondiff(jnp.logical_not, T(x), name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return nondiff(jnp.bitwise_not, T(x), name="bitwise_not")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return nondiff(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        T(x),
+        T(y),
+        name="isclose",
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return nondiff(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        T(x),
+        T(y),
+        name="allclose",
+    )
+
+
+def equal_all(x, y, name=None):
+    return nondiff(lambda a, b: jnp.array_equal(a, b), T(x), T(y), name="equal_all")
+
+
+def is_empty(x, name=None):
+    return Tensor._from_op(jnp.asarray(T(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

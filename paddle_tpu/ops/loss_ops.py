@@ -1,0 +1,295 @@
+"""Loss functional ops.
+
+Reference parity: python/paddle/nn/functional/loss.py in /root/reference
+(cross_entropy, softmax_with_cross_entropy, bce, mse, l1, nll, smooth_l1,
+kl_div, margin/cosine losses, ctc subset omitted).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ._helpers import T, binop, op
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    it, lt = T(input), T(label)
+    larr = lt._array
+    has_w = weight is not None
+    args = [it] + ([T(weight)] if has_w else [])
+
+    def f(logits, *w):
+        lg = jnp.moveaxis(logits, axis, -1) if axis not in (-1, logits.ndim - 1) else logits
+        n_classes = lg.shape[-1]
+        logp = jax.nn.log_softmax(lg, axis=-1) if use_softmax else jnp.log(
+            jnp.maximum(lg, 1e-30)
+        )
+        if soft_label:
+            lab = larr.astype(logp.dtype)
+            if label_smoothing > 0:
+                lab = lab * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(lab * logp, axis=-1)
+            valid = jnp.ones_like(loss, dtype=bool)
+        else:
+            lab = larr
+            if lab.ndim == logp.ndim:  # trailing 1 dim
+                lab = lab.reshape(lab.shape[:-1])
+            lab = lab.astype(jnp.int32)
+            valid = lab != ignore_index
+            safe = jnp.where(valid, lab, 0)
+            picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=-1)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = -jnp.where(valid, picked, 0.0)
+            if has_w:
+                wv = w[0][safe]
+                loss = loss * jnp.where(valid, wv, 0.0)
+        if reduction == "mean":
+            if has_w and not soft_label:
+                denom = jnp.sum(jnp.where(valid, w[0][jnp.where(valid, lab, 0)], 0.0))
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    out, node = autograd.apply(f, *args, name="cross_entropy")
+    return Tensor._from_op(out, node)
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True,
+    return_softmax=False, axis=-1, name=None
+):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    lt = T(label)
+    if not soft_label and lt.ndim == T(logits).ndim:
+        from .manipulation import unsqueeze
+
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    it, lt = T(input), T(label)
+    larr = lt._array.astype(jnp.int32)
+    has_w = weight is not None
+    args = [it] + ([T(weight)] if has_w else [])
+
+    def f(logp, *w):
+        valid = larr != ignore_index
+        safe = jnp.where(valid, larr, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        loss = -jnp.where(valid, picked, 0.0)
+        if has_w:
+            loss = loss * w[0][safe]
+        if reduction == "mean":
+            denom = jnp.sum(w[0][safe] * valid) if has_w else jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    out, node = autograd.apply(f, *args, name="nll_loss")
+    return Tensor._from_op(out, node)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return binop(
+        lambda a, b: _reduce(jnp.square(a - b), reduction), input, label, name="mse_loss"
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return binop(
+        lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label, name="l1_loss"
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return binop(f, input, label, name="smooth_l1_loss")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    return smooth_l1_loss(input, label, reduction, delta)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    it, lt = T(input), T(label)
+    has_w = weight is not None
+    args = [it, lt] + ([T(weight)] if has_w else [])
+
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    out, node = autograd.apply(f, *args, name="bce")
+    return Tensor._from_op(out, node)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    it, lt = T(logit), T(label)
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    args = [it, lt] + ([T(weight)] if has_w else []) + ([T(pos_weight)] if has_pw else [])
+
+    def f(x, y, *rest):
+        i = 0
+        w = rest[i] if has_w else None
+        if has_w:
+            i += 1
+        pw = rest[i] if has_pw else None
+        max_val = jnp.maximum(-x, 0.0)
+        if has_pw:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * x + log_w * (jnp.log(jnp.exp(-max_val) + jnp.exp(-x - max_val)) + max_val)
+        else:
+            loss = (1 - y) * x + max_val + jnp.log(jnp.exp(-max_val) + jnp.exp(-x - max_val))
+        if has_w:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    out, node = autograd.apply(f, *args, name="bce_with_logits")
+    return Tensor._from_op(out, node)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return binop(f, input, label, name="kl_div")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return binop(f, input, label, name="log_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    it, ot, lt = T(input), T(other), T(label)
+
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    out, node = autograd.apply(f, it, ot, lt, name="margin_ranking_loss")
+    return Tensor._from_op(out, node)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return binop(f, input, label, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    i1, i2, lt = T(input1), T(input2), T(label)
+
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    out, node = autograd.apply(f, i1, i2, lt, name="cosine_embedding_loss")
+    return Tensor._from_op(out, node)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    it, pt, nt = T(input), T(positive), T(negative)
+
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p), -1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p), -1), 1 / p)
+        if swap:
+            dpn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p), -1), 1 / p)
+            dn = jnp.minimum(dn, dpn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    out, node = autograd.apply(f, it, pt, nt, name="triplet_margin_loss")
+    return Tensor._from_op(out, node)
+
+
+def square_error_cost(input, label, name=None):
+    return binop(lambda a, b: jnp.square(a - b), input, label, name="square_error_cost")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    lt = T(logit)
+    yt = T(label)
+    norm = T(normalizer)._array if normalizer is not None else None
+
+    def f(x, y):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if norm is not None:
+            loss = loss / norm
+        return _reduce(loss, reduction)
+
+    out, node = autograd.apply(f, lt, yt, name="sigmoid_focal_loss")
+    return Tensor._from_op(out, node)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot_ = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot_ / jnp.maximum(na * nb, eps)
+
+    return binop(f, x1, x2, name="cosine_similarity")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss: planned (lax.scan forward algorithm)")

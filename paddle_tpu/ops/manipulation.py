@@ -1,0 +1,495 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: python/paddle/tensor/manipulation.py in /root/reference
+(reshape, transpose, squeeze, concat, split, gather, scatter, tile, expand,
+flip, roll, unique, pad, ...). All static-shape friendly — sizes resolved in
+Python so XLA sees fixed shapes (SURVEY.md §7 hard part 2).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ._helpers import T, nondiff, op, op_multi
+
+
+def _resolve_shape(shape, x):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return shape
+
+
+def reshape(x, shape, name=None):
+    shp = _resolve_shape(shape, x)
+    return op(lambda a: jnp.reshape(a, shp), T(x), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    t = reshape(x, shape)
+    x._array, x._node, x._out_index = t._array, t._node, t._out_index
+    x.stop_gradient = t.stop_gradient
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    xt = T(x)
+    nd = xt.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shape = xt.shape[:s] + [-1] + xt.shape[e + 1 :]
+    return reshape(xt, shape)
+
+
+def transpose(x, perm=None, name=None):
+    p = None if perm is None else tuple(int(i) for i in perm)
+    return op(lambda a: jnp.transpose(a, p), T(x), name="transpose")
+
+
+def t(x, name=None):
+    xt = T(x)
+    if xt.ndim < 2:
+        return xt.clone()
+    return transpose(xt, list(range(xt.ndim - 2)) + [xt.ndim - 1, xt.ndim - 2])
+
+
+def moveaxis(x, source, destination, name=None):
+    return op(lambda a: jnp.moveaxis(a, source, destination), T(x), name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return op(lambda a: jnp.swapaxes(a, axis0, axis1), T(x), name="swapaxes")
+
+
+transpose_ = transpose
+
+
+def squeeze(x, axis=None, name=None):
+    xt = T(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % xt.ndim for a in axes if xt.shape[a % xt.ndim] == 1)
+    return op(lambda a: jnp.squeeze(a, ax), xt, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes]
+    return op(lambda a: jnp.expand_dims(a, tuple(axes)), T(x), name="unsqueeze")
+
+
+unsqueeze_ = unsqueeze
+squeeze_ = squeeze
+
+
+def concat(x, axis=0, name=None):
+    tensors = tuple(T(t) for t in x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    out, node = autograd.apply(
+        lambda *arrs: jnp.concatenate(arrs, axis=int(axis)), *tensors, name="concat"
+    )
+    return Tensor._from_op(out, node)
+
+
+def stack(x, axis=0, name=None):
+    tensors = tuple(T(t) for t in x)
+    out, node = autograd.apply(
+        lambda *arrs: jnp.stack(arrs, axis=int(axis)), *tensors, name="stack"
+    )
+    return Tensor._from_op(out, node)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    xt = T(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ax = ax % xt.ndim
+    dim = xt.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if builtins.any(s == -1 for s in sizes):
+            rem = dim - builtins.sum(s for s in sizes if s != -1)
+            sizes = [rem if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    outs = []
+    for off, sz in zip(offsets, sizes):
+        outs.append(
+            op(
+                lambda a, off=off, sz=sz: jax.lax.slice_in_dim(a, off, off + sz, axis=ax),
+                xt,
+                name="split",
+            )
+        )
+    return outs
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    xt = T(x)
+    ax = axis % xt.ndim
+    return [squeeze(s, ax) for s in split(xt, xt.shape[ax], ax)]
+
+
+def tile(x, repeat_times, name=None):
+    reps = _resolve_shape(repeat_times, x)
+    return op(lambda a: jnp.tile(a, reps), T(x), name="tile")
+
+
+def expand(x, shape, name=None):
+    xt = T(x)
+    shp = _resolve_shape(shape, x)
+    shp = [xt.shape[i - (len(shp) - xt.ndim)] if s in (-1,) else s for i, s in enumerate(shp)]
+    return op(lambda a: jnp.broadcast_to(a, shp), xt, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, T(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = tuple(T(t) for t in inputs)
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in tensors])
+    return [expand(t, list(shape)) for t in tensors]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return op(lambda a: jnp.flip(a, ax), T(x), name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return op(lambda a: jnp.rot90(a, k, axes), T(x), name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return op(lambda a: jnp.roll(a, shifts, axis), T(x), name="roll")
+
+
+def slice(x, axes, starts, ends, name=None):
+    xt = T(x)
+    idx = [builtins.slice(None)] * xt.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        e = int(e.item()) if isinstance(e, Tensor) else int(e)
+        idx[ax] = builtins.slice(s, e)
+    idx = tuple(idx)
+    return op(lambda a: a[idx], xt, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    xt = T(x)
+    idx = [builtins.slice(None)] * xt.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(s), int(e), int(st))
+    idx = tuple(idx)
+    return op(lambda a: a[idx], xt, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    xt = T(x)
+    shp = _resolve_shape(shape, x)
+    offs = offsets or [0] * xt.ndim
+    offs = [int(o.item()) if isinstance(o, Tensor) else int(o) for o in offs]
+    shp = [xt.shape[i] - offs[i] if s == -1 else s for i, s in enumerate(shp)]
+    idx = tuple(builtins.slice(o, o + s) for o, s in zip(offs, shp))
+    return op(lambda a: a[idx], xt, name="crop")
+
+
+# ---- gather / scatter -----------------------------------------------------
+
+def gather(x, index, axis=0, name=None):
+    xt, it = T(x), T(index)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    idx = it._array.reshape(-1)
+    return op(lambda a: jnp.take(a, idx, axis=ax), xt, name="gather")
+
+
+def gather_nd(x, index, name=None):
+    xt, it = T(x), T(index)
+    idx = it._array
+
+    def f(a):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return op(f, xt, name="gather_nd")
+
+
+def take(x, index, mode="raise", name=None):
+    xt, it = T(x), T(index)
+    idx = it._array
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return op(lambda a: jnp.take(a.reshape(-1), idx, mode=m), xt, name="take")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    xt, it = T(arr), T(indices)
+    idx = it._array
+    return op(lambda a: jnp.take_along_axis(a, idx, axis=axis), xt, name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    xt, it = T(arr), T(indices)
+    vt = T(values)
+    idx = it._array
+
+    def f(a, v):
+        v = jnp.broadcast_to(v.astype(a.dtype), idx.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, idx, v, axis=axis, inplace=False)
+        ii = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(a.ndim)])
+              for d, s in enumerate(idx.shape)]
+        ii[axis] = idx
+        if reduce == "add":
+            return a.at[tuple(ii)].add(v)
+        if reduce in ("multiply", "mul"):
+            return a.at[tuple(ii)].multiply(v)
+        raise ValueError(reduce)
+
+    out, node = autograd.apply(f, xt, vt, name="put_along_axis")
+    return Tensor._from_op(out, node)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    xt, it, ut = T(x), T(index), T(updates)
+    idx = it._array.reshape(-1)
+
+    def f(a, u):
+        if overwrite:
+            return a.at[idx].set(u.astype(a.dtype))
+        return a.at[idx].add(u.astype(a.dtype))
+
+    out, node = autograd.apply(f, xt, ut, name="scatter")
+    return Tensor._from_op(out, node)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    xt, it, ut = T(x), T(index), T(updates)
+    idx = it._array
+
+    def f(a, u):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u.astype(a.dtype))
+
+    out, node = autograd.apply(f, xt, ut, name="scatter_nd_add")
+    return Tensor._from_op(out, node)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    z = zeros(shape, dtype=T(updates).dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    xt, it = T(x), T(index)
+    idx = it._array
+
+    def f(a):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, idx]
+
+    return op(f, xt, name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    xt, it, vt = T(x), T(index), T(value)
+    idx = it._array.reshape(-1)
+
+    def f(a, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v.astype(a.dtype), axis, 0)
+        return jnp.moveaxis(am.at[idx].add(vm), 0, axis)
+
+    out, node = autograd.apply(f, xt, vt, name="index_add")
+    return Tensor._from_op(out, node)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    xt = T(x)
+    vt = T(value)
+    idx = tuple(T(i)._array for i in indices)
+
+    def f(a, v):
+        if accumulate:
+            return a.at[idx].add(v.astype(a.dtype))
+        return a.at[idx].set(jnp.broadcast_to(v.astype(a.dtype), a[idx].shape))
+
+    out, node = autograd.apply(f, xt, vt, name="index_put")
+    return Tensor._from_op(out, node)
+
+
+def masked_select(x, mask, name=None):
+    xt, mt = T(x), T(mask)
+    # dynamic output shape: resolve eagerly (not jittable — documented)
+    out = xt._array[np.asarray(mt._array)]
+    return Tensor._from_op(out)
+
+
+def masked_fill(x, mask, value, name=None):
+    xt, mt = T(x), T(mask)
+    m = mt._array
+    v = value._array if isinstance(value, Tensor) else value
+    return op(lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a), xt, name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    ct = T(condition)
+    if x is None and y is None:
+        return nonzero(ct, as_tuple=True)
+    xt, yt = T(x), T(y)
+    cond = ct._array
+    out, node = autograd.apply(
+        lambda a, b: jnp.where(cond, a, b), xt, yt, name="where"
+    )
+    return Tensor._from_op(out, node)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    xt = T(x)
+    nz = np.nonzero(np.asarray(xt._array))
+    if as_tuple:
+        return tuple(Tensor._from_op(jnp.asarray(i)) for i in nz)
+    return Tensor._from_op(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    xt = T(x)
+    res = np.unique(
+        np.asarray(xt._array),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor._from_op(jnp.asarray(res))
+    return tuple(Tensor._from_op(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    xt = np.asarray(T(x)._array)
+    if axis is not None:
+        raise NotImplementedError
+    flat = xt.reshape(-1)
+    keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+    out = flat[keep]
+    rets = [Tensor._from_op(jnp.asarray(out))]
+    if return_inverse:
+        rets.append(Tensor._from_op(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.concatenate([idx, [flat.size]]))
+        rets.append(Tensor._from_op(jnp.asarray(counts)))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._array
+    return op(lambda a: jnp.repeat(a, repeats, axis=axis), T(x), name="repeat_interleave")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    xt = T(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = xt.ndim
+    if len(pad) == 2 * nd:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pad applies to last len(pad)//2 spatial dims,
+        # ordered from the last dim backward, honoring data_format
+        widths = [(0, 0)] * nd
+        npairs = len(pad) // 2
+        if data_format.endswith("C") and nd >= 3:  # NHWC / NLC / NDHWC
+            dims = list(range(1, 1 + npairs))
+        else:  # NCHW / NCL / NCDHW
+            dims = list(range(nd - npairs, nd))
+        for i, d in enumerate(dims):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    kw = {"constant_values": value} if jmode == "constant" else {}
+    return op(lambda a: jnp.pad(a, widths, mode=jmode, **kw), xt, name="pad")
+
+
+def cast(x, dtype):
+    return T(x).astype(dtype)
+
+
+def tensordot(x, y, axes=2, name=None):
+    from ._helpers import binop
+
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return binop(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y, name="tensordot")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError("as_strided is not supported on TPU (no strided views)")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return T(x).astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, T(other).shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(T(x), [-1]) if T(x).ndim == 0 else T(x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        xt = T(x)
+        outs.append(op(jnp.atleast_2d, xt, name="atleast_2d"))
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        xt = T(x)
+        outs.append(op(jnp.atleast_3d, xt, name="atleast_3d"))
+    return outs[0] if len(outs) == 1 else outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    it = T(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        in_shard = (a // shard_size) == shard_id
+        return jnp.where(in_shard, a % shard_size, ignore_value)
+
+    return nondiff(f, it, name="shard_index")

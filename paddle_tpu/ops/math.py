@@ -1,0 +1,418 @@
+"""Elementwise & reduction math ops.
+
+Reference parity: python/paddle/tensor/math.py in /root/reference (~380 public
+functions; this implements the used surface). Each op is a jnp lambda run
+through the autograd helper — XLA supplies fused kernels and gradients.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import T, axes_arg, binop, nondiff, op, op_multi
+
+# ---- binary elementwise ---------------------------------------------------
+
+def add(x, y, name=None):
+    return binop(jnp.add, x, y, name="add")
+
+
+def subtract(x, y, name=None):
+    return binop(jnp.subtract, x, y, name="subtract")
+
+
+def multiply(x, y, name=None):
+    return binop(jnp.multiply, x, y, name="multiply")
+
+
+def divide(x, y, name=None):
+    return binop(jnp.divide, x, y, name="divide")
+
+
+def floor_divide(x, y, name=None):
+    return binop(jnp.floor_divide, x, y, name="floor_divide")
+
+
+def remainder(x, y, name=None):
+    return binop(jnp.remainder, x, y, name="remainder")
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):
+    return binop(jnp.power, x, y, name="pow")
+
+
+def maximum(x, y, name=None):
+    return binop(jnp.maximum, x, y, name="maximum")
+
+
+def minimum(x, y, name=None):
+    return binop(jnp.minimum, x, y, name="minimum")
+
+
+def fmax(x, y, name=None):
+    return binop(jnp.fmax, x, y, name="fmax")
+
+
+def fmin(x, y, name=None):
+    return binop(jnp.fmin, x, y, name="fmin")
+
+
+def atan2(x, y, name=None):
+    return binop(jnp.arctan2, x, y, name="atan2")
+
+
+def logaddexp(x, y, name=None):
+    return binop(jnp.logaddexp, x, y, name="logaddexp")
+
+
+def heaviside(x, y, name=None):
+    return binop(jnp.heaviside, x, y, name="heaviside")
+
+
+def hypot(x, y, name=None):
+    return binop(jnp.hypot, x, y, name="hypot")
+
+
+def nextafter(x, y, name=None):
+    return binop(jnp.nextafter, x, y, name="nextafter")
+
+
+def copysign(x, y, name=None):
+    return binop(jnp.copysign, x, y, name="copysign")
+
+
+def gcd(x, y, name=None):
+    return binop(jnp.gcd, x, y, name="gcd")
+
+
+def lcm(x, y, name=None):
+    return binop(jnp.lcm, x, y, name="lcm")
+
+
+# ---- unary elementwise ----------------------------------------------------
+
+def _unary(jfn, name):
+    def f(x, name_=None, **kw):
+        return op(jfn, T(x), name=name)
+
+    f.__name__ = name
+    return f
+
+
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+abs = _unary(jnp.abs, "abs")
+sign = _unary(jnp.sign, "sign")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda a: a - jnp.trunc(a), "frac")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+neg = _unary(jnp.negative, "neg")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+i0 = _unary(jax.scipy.special.i0, "i0")
+i0e = _unary(jax.scipy.special.i0e, "i0e")
+i1 = _unary(jax.scipy.special.i1, "i1")
+i1e = _unary(jax.scipy.special.i1e, "i1e")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+angle = _unary(jnp.angle, "angle")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+logit = _unary(jax.scipy.special.logit, "logit")
+
+
+def clip(x, min=None, max=None, name=None):
+    def val(v):
+        return v._array if isinstance(v, Tensor) else v
+
+    return op(lambda a: jnp.clip(a, val(min), val(max)), T(x), name="clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out
+
+    r = op(f, T(x), name="scale")
+    if act:
+        from . import activation as A
+
+        r = getattr(A, act)(r)
+    return r
+
+
+def lerp(x, y, weight, name=None):
+    xt, yt = T(x), T(y)
+    if isinstance(weight, Tensor):
+        from ..core import autograd
+
+        out, node = autograd.apply(
+            lambda a, b, w: a + w * (b - a), xt, yt, weight, name="lerp"
+        )
+        return Tensor._from_op(out, node)
+    return binop(lambda a, b: a + weight * (b - a), xt, yt, name="lerp")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return op(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        T(x),
+        name="nan_to_num",
+    )
+
+
+def isnan(x, name=None):
+    return nondiff(jnp.isnan, T(x), name="isnan")
+
+
+def isinf(x, name=None):
+    return nondiff(jnp.isinf, T(x), name="isinf")
+
+
+def isfinite(x, name=None):
+    return nondiff(jnp.isfinite, T(x), name="isfinite")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return op(lambda a: scale_b * jnp.tanh(scale_a * a), T(x), name="stanh")
+
+
+def increment(x, value=1.0, name=None):
+    x._array = x._array + value
+    return x
+
+
+# ---- reductions -----------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtypes import convert_dtype
+
+    ax = axes_arg(axis)
+    dt = convert_dtype(dtype) if dtype else None
+    return op(lambda a: jnp.sum(a, axis=ax, dtype=dt, keepdims=keepdim), T(x), name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), T(x), name="mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.prod(a, axis=ax, keepdims=keepdim), T(x), name="prod")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), T(x), name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), T(x), name="min")
+
+
+amax = max
+amin = min
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(
+        lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        T(x),
+        name="std",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(
+        lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim),
+        T(x),
+        name="var",
+    )
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), T(x), name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), T(x), name="nanmedian")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim), T(x), name="nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), T(x), name="nanmean")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(lambda a: jnp.quantile(a, q, axis=ax, keepdims=keepdim), T(x), name="quantile")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return op(
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        T(x),
+        name="logsumexp",
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return nondiff(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), T(x), name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return nondiff(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), T(x), name="any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = axes_arg(axis)
+    return nondiff(
+        lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim), T(x), name="count_nonzero"
+    )
+
+
+# ---- scans ----------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=int(axis))
+
+    return op(f, T(x), name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return op(lambda a: jnp.cumprod(a, axis=int(dim)), T(x), name="cumprod")
+
+
+def cummax(x, axis=None, dtype=None, name=None):
+    def f(a):
+        ax = -1 if axis is None else int(axis)
+        return jax.lax.cummax(a, axis=ax)
+
+    return op(f, T(x), name="cummax")
+
+
+def cummin(x, axis=None, dtype=None, name=None):
+    def f(a):
+        ax = -1 if axis is None else int(axis)
+        return jax.lax.cummin(a, axis=ax)
+
+    return op(f, T(x), name="cummin")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        m = jax.lax.cummax(a, axis=ax)
+        return jnp.log(jnp.cumsum(jnp.exp(a - m), axis=ax)) + m
+
+    return op(f, T(x), name="logcumsumexp")
+
+
+# ---- multi-input ----------------------------------------------------------
+
+def add_n(inputs, name=None):
+    from ..core import autograd
+
+    tensors = tuple(T(t) for t in (inputs if isinstance(inputs, (list, tuple)) else [inputs]))
+    out, node = autograd.apply(
+        lambda *arrs: jnp.sum(jnp.stack([a.astype(arrs[0].dtype) for a in arrs]), axis=0)
+        if len(arrs) > 1
+        else arrs[0],
+        *tensors,
+        name="add_n",
+    )
+    return Tensor._from_op(out, node)
+
+
+def inner(x, y, name=None):
+    return binop(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    return binop(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def kron(x, y, name=None):
+    return binop(jnp.kron, x, y, name="kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return op(lambda a: jnp.trace(a, offset, axis1, axis2), T(x), name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return op(lambda a: jnp.diagonal(a, offset, axis1, axis2), T(x), name="diagonal")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = T(prepend)._array if prepend is not None else None
+    app = T(append)._array if append is not None else None
+    return op(
+        lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), T(x), name="diff"
+    )
+
+
+def multiplex(inputs, index, name=None):
+    from ..core import autograd
+
+    tensors = tuple(T(t) for t in inputs)
+    idx = T(index)._array.reshape(-1)
+    out, node = autograd.apply(
+        lambda *arrs: jnp.stack(arrs)[idx, jnp.arange(arrs[0].shape[0])],
+        *tensors,
+        name="multiplex",
+    )
+    return Tensor._from_op(out, node)

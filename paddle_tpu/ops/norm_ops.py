@@ -1,0 +1,224 @@
+"""Normalization functional ops.
+
+Reference parity: python/paddle/nn/functional/norm.py in /root/reference;
+kernels paddle/phi/kernels/gpu/{batch_norm,layer_norm,group_norm}_kernel.cu.
+Running-stat updates are returned functionally (the layer assigns them), so
+the same code path works eagerly and under jit tracing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ._helpers import T, op
+
+
+def batch_norm_stats_update(x_arr, axes):
+    mean = jnp.mean(x_arr, axis=axes)
+    var = jnp.var(x_arr, axis=axes)
+    return mean, var
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    xt = T(x)
+    channel_last = data_format.endswith("C") and xt.ndim > 2 and len(data_format) == xt.ndim
+    caxis = xt.ndim - 1 if channel_last else (1 if xt.ndim > 1 else 0)
+    axes = tuple(i for i in range(xt.ndim) if i != caxis)
+    use_batch = training and not use_global_stats
+
+    rm = T(running_mean)
+    rv = T(running_var)
+
+    args = [xt]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(T(weight))
+    if has_b:
+        args.append(T(bias))
+
+    if use_batch:
+
+        def f(a, *wb):
+            m = jnp.mean(a, axis=axes)
+            v = jnp.var(a, axis=axes)
+            shape = [1] * a.ndim
+            shape[caxis] = -1
+            out = (a - m.reshape(shape)) * jax.lax.rsqrt(v.reshape(shape) + epsilon)
+            i = 0
+            if has_w:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if has_b:
+                out = out + wb[i].reshape(shape)
+            # stats returned as extra outputs so the forward value (not a
+            # leaked tracer) drives the running-stat update, both eagerly and
+            # under jit tracing (buffers collected by functional_call)
+            return out, jax.lax.stop_gradient(m), jax.lax.stop_gradient(v)
+
+        outs, node = autograd.apply(f, *args, name="batch_norm")
+        out, bm, bv = outs
+        n = 1
+        for ax in axes:
+            n *= xt._array.shape[ax]
+        unbiased = bv * (n / max(n - 1, 1))
+        rm._array = momentum * rm._array + (1.0 - momentum) * bm.astype(rm._array.dtype)
+        rv._array = momentum * rv._array + (1.0 - momentum) * unbiased.astype(rv._array.dtype)
+        return Tensor._from_op(out, node, 0)
+
+    m_arr, v_arr = rm._array, rv._array
+
+    def f(a, *wb):
+        shape = [1] * a.ndim
+        shape[caxis] = -1
+        out = (a - m_arr.reshape(shape).astype(a.dtype)) * jax.lax.rsqrt(
+            v_arr.reshape(shape).astype(a.dtype) + epsilon
+        )
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    out, node = autograd.apply(f, *args, name="batch_norm")
+    return Tensor._from_op(out, node)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    xt = T(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+    axes = tuple(range(xt.ndim - nd, xt.ndim))
+    has_w, has_b = weight is not None, bias is not None
+    args = [xt] + ([T(weight)] if has_w else []) + ([T(bias)] if has_b else [])
+
+    def f(a, *wb):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(a.dtype)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(a.dtype)
+        return out
+
+    out, node = autograd.apply(f, *args, name="layer_norm")
+    return Tensor._from_op(out, node)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    xt = T(x)
+    channel_last = data_format.endswith("C") and len(data_format) == xt.ndim
+    has_w, has_b = weight is not None, bias is not None
+    args = [xt] + ([T(weight)] if has_w else []) + ([T(bias)] if has_b else [])
+
+    def f(a, *wb):
+        if channel_last:
+            a_ = jnp.moveaxis(a, -1, 1)
+        else:
+            a_ = a
+        n, c = a_.shape[0], a_.shape[1]
+        g = num_groups
+        r = a_.reshape((n, g, c // g) + a_.shape[2:])
+        axes = tuple(range(2, r.ndim))
+        m = jnp.mean(r, axis=axes, keepdims=True)
+        v = jnp.var(r, axis=axes, keepdims=True)
+        r = (r - m) * jax.lax.rsqrt(v + epsilon)
+        out = r.reshape(a_.shape)
+        shape = (1, c) + (1,) * (a_.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    out, node = autograd.apply(f, *args, name="group_norm")
+    return Tensor._from_op(out, node)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    xt = T(x)
+    has_w, has_b = weight is not None, bias is not None
+    args = [xt] + ([T(weight)] if has_w else []) + ([T(bias)] if has_b else [])
+
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        shape = (1, a.shape[1]) + (1,) * (a.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    out, node = autograd.apply(f, *args, name="instance_norm")
+    return Tensor._from_op(out, node)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
+        padded = jnp.pad(sq, pads)
+        acc = sum(
+            jax.lax.slice_in_dim(padded, i, i + c, axis=1) for i in range(size)
+        )
+        return a / jnp.power(k + alpha * acc, beta)
+
+    return op(f, T(x), name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p
+        )
+        return a / jnp.maximum(n, epsilon)
+
+    return op(f, T(x), name="normalize")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (not in reference snapshot; standard for modern LLM stacks)."""
+    xt = T(x)
+    has_w = weight is not None
+    args = [xt] + ([T(weight)] if has_w else [])
+
+    def f(a, *w):
+        v = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(v + epsilon)).astype(a.dtype)
+        if has_w:
+            out = out * w[0].astype(a.dtype)
+        return out
+
+    out, node = autograd.apply(f, *args, name="rms_norm")
+    return Tensor._from_op(out, node)

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for hot ops (flash attention; more to come).
+
+Reference parity: the role of paddle/phi/kernels/gpu/flash_attn_kernel.cu +
+dynload/flashattn.cc in /root/reference — except the kernel is written in
+Pallas/Mosaic against VMEM/MXU instead of binding an external CUDA library.
+"""

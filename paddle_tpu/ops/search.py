@@ -1,0 +1,147 @@
+"""Search / sort ops.
+
+Reference parity: python/paddle/tensor/search.py in /root/reference
+(argmax, argmin, argsort, sort, topk, kthvalue, searchsorted, masked ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import T, axes_arg, nondiff, op, op_multi
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = axes_arg(axis)
+    return nondiff(
+        lambda a: jnp.argmax(a, axis=ax, keepdims=keepdim).astype(np.int64),
+        T(x),
+        name="argmax",
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = axes_arg(axis)
+    return nondiff(
+        lambda a: jnp.argmin(a, axis=ax, keepdims=keepdim).astype(np.int64),
+        T(x),
+        name="argmin",
+    )
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, descending=descending)
+        return idx.astype(np.int64)
+
+    return nondiff(f, T(x), name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return op(
+        lambda a: jnp.sort(a, axis=axis, descending=descending), T(x), name="sort"
+    )
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    xt = T(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = axis % xt.ndim
+
+    def fv(a):
+        am = jnp.moveaxis(a, ax, -1)
+        src = am if largest else -am
+        v, _ = jax.lax.top_k(src, k)
+        v = v if largest else -v
+        return jnp.moveaxis(v, -1, ax)
+
+    def fi(a):
+        am = jnp.moveaxis(a, ax, -1)
+        src = am if largest else -am
+        _, i = jax.lax.top_k(src, k)
+        return jnp.moveaxis(i, -1, ax).astype(np.int64)
+
+    values = op(fv, xt, name="topk")
+    indices = nondiff(fi, xt, name="topk_indices")
+    return values, indices
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    xt = T(x)
+    ax = axis % xt.ndim
+
+    def fv(a):
+        s = jnp.sort(a, axis=ax)
+        v = jnp.take(s, k - 1, axis=ax)
+        return jnp.expand_dims(v, ax) if keepdim else v
+
+    def fi(a):
+        s = jnp.argsort(a, axis=ax)
+        i = jnp.take(s, k - 1, axis=ax).astype(np.int64)
+        return jnp.expand_dims(i, ax) if keepdim else i
+
+    return op(fv, xt, name="kthvalue"), nondiff(fi, xt, name="kthvalue_idx")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    xt = np.asarray(T(x)._array)
+    ax = axis % xt.ndim
+
+    def _mode1(v):
+        vals, counts = np.unique(v, return_counts=True)
+        m = vals[np.argmax(counts)]
+        idx = np.where(v == m)[0][-1]
+        return m, idx
+
+    mv = np.apply_along_axis(lambda v: _mode1(v)[0], ax, xt)
+    mi = np.apply_along_axis(lambda v: _mode1(v)[1], ax, xt).astype(np.int64)
+    if keepdim:
+        mv, mi = np.expand_dims(mv, ax), np.expand_dims(mi, ax)
+    return Tensor._from_op(jnp.asarray(mv)), Tensor._from_op(jnp.asarray(mi))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    st, vt = T(sorted_sequence), T(values)
+    side = "right" if right else "left"
+
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side)
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape)
+
+    out = f(st._array, vt._array)
+    return Tensor._from_op(out.astype(np.int32 if out_int32 else np.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def index_of_first(x, value):
+    return nondiff(lambda a: jnp.argmax(a == value), T(x))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = np.asarray(T(input)._array)
+    if min == 0 and max == 0:
+        min, max = float(a.min()), float(a.max())
+    hist, _ = np.histogram(a, bins=bins, range=(min, max))
+    return Tensor._from_op(jnp.asarray(hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = T(x)._array
+    w = T(weights)._array if weights is not None else None
+    n = int(__import__("numpy").asarray(a).max()) + 1 if a.size else 0
+    length = builtins_max(n, minlength)
+    out = jnp.bincount(a.reshape(-1), w.reshape(-1) if w is not None else None, length=length)
+    return Tensor._from_op(out)
+
+
+def builtins_max(a, b):
+    return a if a > b else b

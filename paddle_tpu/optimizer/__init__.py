@@ -1,0 +1,6 @@
+"""paddle.optimizer parity (reference python/paddle/optimizer/__init__.py:15-25)."""
+from . import lr  # noqa: F401
+from .optimizer import L1Decay, L2Decay, Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp,
+)

@@ -1,0 +1,220 @@
+"""Optimizer base.
+
+Reference parity: python/paddle/optimizer/optimizer.py in /root/reference.
+Design: each optimizer defines a *pure* per-parameter update rule
+(`_update(param, grad, lr, state) -> (new_param, new_state)`) used both by
+eager `step()` (jitted per unique shape/dtype, buffer-donated) and by the
+compiled whole-train-step path (`apply_gradients_arrays` over pytrees) — the
+fused-kernel role of the reference's adam/sgd PHI kernels falls out of XLA
+fusion.
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    # subclasses define: _slots() -> list of slot names; _update rule
+    _slot_names = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (float, int)):
+            self._weight_decay = L2Decay(weight_decay)
+        else:
+            self._weight_decay = weight_decay
+        self._accumulators = {}  # id(param) -> {slot: jax array}
+        self._step_count = 0
+        self._name = name
+        self._jit_cache = {}  # per-instance jitted update fns
+        self._apply_decay_param_fun = None
+
+    # ---- lr ----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def _lr_value(self):
+        return jnp.asarray(self.get_lr(), jnp.float32)
+
+    # ---- state -------------------------------------------------------------
+    def _get_state(self, p):
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_slots(p._array)
+        return self._accumulators[key]
+
+    def _init_slots(self, arr):
+        return {}
+
+    # ---- the pure update rule (override) ------------------------------------
+    def _update(self, param, grad, lr, state, **hyper):
+        raise NotImplementedError
+
+    def _wd_coeff(self):
+        wd = self._weight_decay
+        if isinstance(wd, L2Decay):
+            return wd.coeff
+        if isinstance(wd, (float, int)):
+            return float(wd)
+        return 0.0
+
+    # decoupled (AdamW-style) vs coupled L2: default couples into grad
+    _decoupled_wd = False
+
+    def _should_decay(self, param):
+        fn = self._apply_decay_param_fun
+        if fn is None:
+            return True
+        return bool(fn(param.name))
+
+    def _jitted_update(self, apply_wd=True):
+        cached = self._jit_cache.get(bool(apply_wd))
+        if cached is not None:
+            return cached
+        update = self._update
+        wd = self._wd_coeff() if apply_wd else 0.0
+        decoupled = self._decoupled_wd
+
+        def f(param, grad, lr, state, hyper):
+            if wd and not decoupled:
+                grad = grad + wd * param.astype(grad.dtype)
+            new_p, new_s = update(param, grad, lr, state, **hyper)
+            if wd and decoupled:
+                new_p = new_p - (lr * wd * param.astype(jnp.float32)).astype(new_p.dtype)
+            return new_p.astype(param.dtype), new_s
+
+        jf = jax.jit(f, donate_argnums=(0, 3))
+        self._jit_cache[bool(apply_wd)] = jf
+        return jf
+
+    def _hyper(self):
+        """Per-step hyperparameters passed into the update rule."""
+        return {}
+
+    # ---- eager step ---------------------------------------------------------
+    @property
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters")
+        return self._parameter_list
+
+    def step(self):
+        self._step_count += 1
+        params_grads = [
+            (p, p.grad) for p in self._params
+            if (not p.stop_gradient) and p._grad is not None
+        ]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self._lr_value()
+        hyper = self._hyper()
+        for p, g in params_grads:
+            state = self._get_state(p)
+            base_lr = p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else 1.0
+            upd = self._jitted_update(apply_wd=self._should_decay(p))
+            new_p, new_s = upd(p._array, g._array.astype(p._array.dtype), lr * base_lr, state, hyper)
+            p._array = new_p
+            self._accumulators[id(p)] = new_s
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._params:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # ---- functional API (compiled train step) -------------------------------
+    def init_state_arrays(self, params: dict):
+        return {k: self._init_slots(a) for k, a in params.items()}
+
+    def apply_gradients_arrays(self, params: dict, grads: dict, state: dict, lr=None, grad_scale=None):
+        """Pure: returns (new_params, new_state). Used inside jit."""
+        lr = jnp.asarray(self.get_lr(), jnp.float32) if lr is None else lr
+        hyper = self._hyper_traced(state)
+        wd = self._wd_coeff()
+        if self._grad_clip is not None:
+            keys = list(grads.keys())
+            clipped = self._grad_clip.clip_arrays([grads[k] for k in keys])
+            grads = dict(zip(keys, clipped))
+        decay_fn = self._apply_decay_param_fun
+        new_params, new_state = {}, {}
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k] = p
+                new_state[k] = state.get(k, {})
+                continue
+            g = g.astype(p.dtype)
+            if grad_scale is not None:
+                g = g * grad_scale
+            wd_k = wd if (decay_fn is None or decay_fn(k)) else 0.0
+            if wd_k and not self._decoupled_wd:
+                g = g + wd_k * p
+            np_, ns = self._update(p, g, lr, state[k], **hyper)
+            if wd_k and self._decoupled_wd:
+                np_ = np_ - (lr * wd_k * p.astype(jnp.float32)).astype(np_.dtype)
+            new_params[k] = np_.astype(p.dtype)
+            new_state[k] = ns
+        return new_params, new_state
+
+    def _hyper_traced(self, state):
+        return self._hyper()
+
+    # ---- checkpointing ------------------------------------------------------
+    def state_dict(self):
+        sd = OrderedDict()
+        for i, p in enumerate(self._params):
+            st = self._accumulators.get(id(p))
+            if st:
+                for slot, arr in st.items():
+                    sd[f"{p.name}_{slot}"] = Tensor._from_op(arr)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._params:
+            slots = {}
+            for slot in self._slot_names:
+                k = f"{p.name}_{slot}"
+                if k in state_dict:
+                    v = state_dict[k]
+                    slots[slot] = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+            if slots:
+                st = self._init_slots(p._array)
+                st.update(slots)
+                self._accumulators[id(p)] = st
+
+    load_state_dict = set_state_dict

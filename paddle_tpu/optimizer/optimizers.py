@@ -1,0 +1,219 @@
+"""Concrete optimizers.
+
+Reference parity: python/paddle/optimizer/{sgd,momentum,adam,adamw,adamax,
+adagrad,adadelta,rmsprop,lamb}.py in /root/reference (listed at
+optimizer/__init__.py:15-25).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import L2Decay, Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, param, grad, lr, state):
+        return param - lr.astype(param.dtype) * grad, state
+
+
+class Momentum(Optimizer):
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_slots(self, arr):
+        return {"velocity": jnp.zeros_like(arr)}
+
+    def _update(self, param, grad, lr, state):
+        mu = self._momentum
+        v = mu * state["velocity"] + grad
+        if self._use_nesterov:
+            step = grad + mu * v
+        else:
+            step = v
+        return param - lr.astype(param.dtype) * step, {"velocity": v}
+
+
+class Adam(Optimizer):
+    _slot_names = ("moment1", "moment2", "beta1_pow", "beta2_pow")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, arr):
+        return {
+            "moment1": jnp.zeros_like(arr, jnp.float32),
+            "moment2": jnp.zeros_like(arr, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, param, grad, lr, state):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g = grad.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        step = lr * mhat / (jnp.sqrt(vhat) + eps)
+        new_p = (param.astype(jnp.float32) - step).astype(param.dtype)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _wd_coeff(self):
+        wd = self._weight_decay
+        if isinstance(wd, L2Decay):
+            return wd.coeff
+        return float(wd or 0.0)
+
+
+class Adamax(Optimizer):
+    _slot_names = ("moment", "inf_norm", "beta1_pow")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, arr):
+        return {
+            "moment": jnp.zeros_like(arr, jnp.float32),
+            "inf_norm": jnp.zeros_like(arr, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, param, grad, lr, state):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g = grad.astype(jnp.float32)
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g) + eps)
+        b1p = state["beta1_pow"] * b1
+        step = lr * m / ((1 - b1p) * u)
+        return (param.astype(jnp.float32) - step).astype(param.dtype), {
+            "moment": m, "inf_norm": u, "beta1_pow": b1p,
+        }
+
+
+class Adagrad(Optimizer):
+    _slot_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _init_slots(self, arr):
+        return {"moment": jnp.full_like(arr, self._init_value, jnp.float32)}
+
+    def _update(self, param, grad, lr, state):
+        g = grad.astype(jnp.float32)
+        mom = state["moment"] + g * g
+        step = lr * g / (jnp.sqrt(mom) + self._epsilon)
+        return (param.astype(jnp.float32) - step).astype(param.dtype), {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    _slot_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_slots(self, arr):
+        return {
+            "avg_squared_grad": jnp.zeros_like(arr, jnp.float32),
+            "avg_squared_update": jnp.zeros_like(arr, jnp.float32),
+        }
+
+    def _update(self, param, grad, lr, state):
+        rho, eps = self._rho, self._epsilon
+        g = grad.astype(jnp.float32)
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        update = g * jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * update * update
+        return (param.astype(jnp.float32) - lr * update).astype(param.dtype), {
+            "avg_squared_grad": asg, "avg_squared_update": asu,
+        }
+
+
+class RMSProp(Optimizer):
+    _slot_names = ("momentum", "mean_square", "mean_grad")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_slots(self, arr):
+        return {
+            "momentum": jnp.zeros_like(arr, jnp.float32),
+            "mean_square": jnp.zeros_like(arr, jnp.float32),
+            "mean_grad": jnp.zeros_like(arr, jnp.float32),
+        }
+
+    def _update(self, param, grad, lr, state):
+        rho, eps, mu = self._rho, self._epsilon, self._momentum
+        g = grad.astype(jnp.float32)
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        mom = mu * state["momentum"] + lr * g / denom
+        return (param.astype(jnp.float32) - mom).astype(param.dtype), {
+            "momentum": mom, "mean_square": ms, "mean_grad": mg,
+        }
+
+
+class Lamb(Optimizer):
+    _slot_names = ("moment1", "moment2", "beta1_pow", "beta2_pow")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, arr):
+        return {
+            "moment1": jnp.zeros_like(arr, jnp.float32),
+            "moment2": jnp.zeros_like(arr, jnp.float32),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, param, grad, lr, state):
+        b1, b2, eps, wd = self._beta1, self._beta2, self._epsilon, self._lamb_wd
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * ratio * r).astype(param.dtype), {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,
+        }

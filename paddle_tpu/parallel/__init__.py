@@ -1,0 +1,13 @@
+"""Low-level SPMD machinery: sharding specs, compiled sharded train steps,
+ring attention (context parallelism).
+
+This package is the TPU-native core that fleet/meta_parallel wrappers drive
+(SURVEY.md §7 step 7): mesh-first, GSPMD annotations, XLA collectives over
+ICI.
+"""
+from .spmd import (  # noqa: F401
+    make_sharded_train_step,
+    module_param_specs,
+    shard_params_to_mesh,
+)
+from .ring_attention import ring_attention  # noqa: F401

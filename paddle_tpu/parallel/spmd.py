@@ -1,0 +1,204 @@
+"""Sharded compiled train step (GSPMD).
+
+The TPU-native replacement for the reference's distributed optimizer stack:
+- DP grad allreduce (EagerReducer reducer.cc): falls out of jit-ing the grad
+  computation with a dp-sharded batch — XLA inserts the psum.
+- TP (mp_ops c_identity/c_allreduce): falls out of Parameter.sharding_axes
+  annotations on the mp axis.
+- ZeRO-1/2/3 (dygraph_sharding_optimizer / GroupShardedStage2/3): expressed
+  as shardings on optimizer state (stage>=1) and parameters (stage 3) over
+  the 'sharding' axis; XLA's weight-update sharding + just-in-time
+  all-gathers implement the runtime machinery.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import rng
+from ..core.functional import functional_call, state_dict_arrays
+from ..core.tensor import Tensor
+
+
+def _largest_divisible_dim(shape, degree):
+    best = None
+    for i, s in enumerate(shape):
+        if degree > 0 and s % degree == 0 and (best is None or s > shape[best]):
+            best = i
+    return best
+
+
+def param_pspec(param, mesh: Mesh, zero3=False) -> P:
+    axes = getattr(param, "sharding_axes", None)
+    if axes:
+        spec = [a if (a and mesh.shape.get(a, 1) > 1) else None for a in axes]
+        if any(spec):
+            return P(*spec)
+    if zero3 and mesh.shape.get("sharding", 1) > 1:
+        deg = mesh.shape["sharding"]
+        dim = _largest_divisible_dim(tuple(param.shape), deg)
+        if dim is not None and int(np.prod(param.shape)) >= deg * 128:
+            spec = [None] * len(param.shape)
+            spec[dim] = "sharding"
+            return P(*spec)
+    return P()
+
+
+def module_param_specs(layer, mesh: Mesh, zero_stage=0):
+    return {
+        name: param_pspec(p, mesh, zero3=(zero_stage >= 3))
+        for name, p in layer.named_parameters_dict().items()
+    }
+
+
+def _state_spec_like(pspec: P, param_shape, slot_arr, mesh, zero_stage):
+    """Optimizer slot sharding: follow the param's sharding; for ZeRO>=1 also
+    shard unsharded slots over 'sharding' when divisible."""
+    if slot_arr.ndim == 0 or slot_arr.shape != tuple(param_shape):
+        return P()
+    if any(pspec):
+        return pspec
+    if zero_stage >= 1 and mesh.shape.get("sharding", 1) > 1:
+        deg = mesh.shape["sharding"]
+        dim = _largest_divisible_dim(slot_arr.shape, deg)
+        if dim is not None and int(np.prod(slot_arr.shape)) >= deg * 128:
+            spec = [None] * slot_arr.ndim
+            spec[dim] = "sharding"
+            return P(*spec)
+    return P()
+
+
+class ShardedTrainStep:
+    """One compiled XLA program: forward + loss + grad + optimizer update,
+    with explicit in/out shardings over the mesh. Donates params/opt state."""
+
+    def __init__(self, model, loss_fn, optimizer, mesh, batch_specs, zero_stage=0, remat=False):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.batch_specs = batch_specs
+        self.zero_stage = zero_stage
+        self.remat = remat
+        self._compiled = None
+        self.param_specs = module_param_specs(model, mesh, zero_stage)
+
+    # ---- state placement ---------------------------------------------------
+    def init_state(self):
+        params, buffers = state_dict_arrays(self.model)
+        params = {
+            k: jax.device_put(v, NamedSharding(self.mesh, self.param_specs[k]))
+            for k, v in params.items()
+        }
+        buffers = {
+            k: jax.device_put(v, NamedSharding(self.mesh, P()))
+            for k, v in buffers.items()
+        }
+        opt_state = self.optimizer.init_state_arrays(params)
+        opt_state = {
+            k: {
+                s: jax.device_put(
+                    a,
+                    NamedSharding(
+                        self.mesh,
+                        _state_spec_like(
+                            self.param_specs[k], params[k].shape, a, self.mesh, self.zero_stage
+                        ),
+                    ),
+                )
+                for s, a in slots.items()
+            }
+            for k, slots in opt_state.items()
+        }
+        return params, buffers, opt_state
+
+    def shard_batch(self, *arrays):
+        out = []
+        for a, spec in zip(arrays, self.batch_specs):
+            out.append(jax.device_put(jnp.asarray(a), NamedSharding(self.mesh, spec)))
+        return tuple(out)
+
+    # ---- compile -----------------------------------------------------------
+    def _build(self, n_batch):
+        model = self.model
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+
+        def step(params, buffers, opt_state, lr, key, *batch):
+            def compute_loss(p):
+                def fwd(pp):
+                    return functional_call(
+                        model, pp, buffers, args=batch[: n_batch - 1],
+                        rng_key=key, training=True,
+                    )
+
+                if self.remat:
+                    out, new_buf = jax.checkpoint(fwd)(p)
+                else:
+                    out, new_buf = fwd(p)
+                loss = loss_fn(out, batch[n_batch - 1])
+                return loss, (out, new_buf)
+
+            (loss, (out, new_buf)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(params)
+            new_params, new_opt = optimizer.apply_gradients_arrays(
+                params, grads, opt_state, lr
+            )
+            return loss, new_params, new_buf, new_opt
+
+        ns = lambda spec: NamedSharding(self.mesh, spec)
+        pspecs = {k: ns(s) for k, s in self.param_specs.items()}
+        _, buffers = state_dict_arrays(self.model)
+        bspecs = {k: ns(P()) for k in buffers}
+        opt_template = self.optimizer.init_state_arrays(
+            {k: p._array for k, p in self.model.named_parameters_dict().items()}
+        )
+        ospecs = {
+            k: {
+                s: ns(
+                    _state_spec_like(
+                        self.param_specs[k],
+                        self.model.named_parameters_dict()[k].shape,
+                        a,
+                        self.mesh,
+                        self.zero_stage,
+                    )
+                )
+                for s, a in slots.items()
+            }
+            for k, slots in opt_template.items()
+        }
+        batch_in = tuple(ns(s) for s in self.batch_specs)
+        in_shardings = (pspecs, bspecs, ospecs, ns(P()), ns(P())) + batch_in
+        out_shardings = (ns(P()), pspecs, bspecs, ospecs)
+        return jax.jit(
+            step,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=(0, 2),
+        )
+
+    def __call__(self, params, buffers, opt_state, lr, key, *batch):
+        if self._compiled is None:
+            self._compiled = self._build(len(batch))
+        return self._compiled(params, buffers, opt_state, lr, key, *batch)
+
+
+def make_sharded_train_step(model, loss_fn, optimizer, mesh, batch_specs=None, zero_stage=0, remat=False):
+    """loss_fn(outputs_arrays, labels_array) -> scalar array, in trace mode."""
+    if batch_specs is None:
+        batch_specs = (P("dp"), P("dp"))
+    return ShardedTrainStep(model, loss_fn, optimizer, mesh, batch_specs, zero_stage, remat)
+
+
+def shard_params_to_mesh(model, mesh, zero_stage=0):
+    """Physically place eager parameters according to their specs."""
+    specs = module_param_specs(model, mesh, zero_stage)
+    for name, p in model.named_parameters_dict().items():
+        p._array = jax.device_put(p._array, NamedSharding(mesh, specs[name]))
+    return specs

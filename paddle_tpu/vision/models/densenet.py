@@ -1,0 +1,108 @@
+"""DenseNet. Reference parity: python/paddle/vision/models/densenet.py."""
+from ... import nn
+from ...ops.manipulation import concat
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class DenseBlock(nn.Layer):
+    def __init__(self, num_layers, in_c, bn_size, growth_rate, dropout):
+        super().__init__()
+        layers = []
+        for i in range(num_layers):
+            layers.append(DenseLayer(in_c + i * growth_rate, growth_rate, bn_size, dropout))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return self.block(x)
+
+
+class Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = {
+            121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+            169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+        }[layers]
+        growth = 48 if layers == 161 else 32
+        init_c = 96 if layers == 161 else 64
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(init_c)
+        self.relu = nn.ReLU()
+        self.pool1 = nn.MaxPool2D(3, 2, 1)
+        blocks = []
+        c = init_c
+        for i, n in enumerate(cfg):
+            blocks.append(DenseBlock(n, c, bn_size, growth, dropout))
+            c = c + n * growth
+            if i != len(cfg) - 1:
+                blocks.append(Transition(c, c // 2))
+                c = c // 2
+        self.blocks = nn.Sequential(*blocks)
+        self.bn2 = nn.BatchNorm2D(c)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.relu(self.bn1(self.conv1(x))))
+        x = self.relu(self.bn2(self.blocks(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def _densenet(layers, pretrained, **kw):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled (no egress)")
+    return DenseNet(layers=layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _densenet(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _densenet(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _densenet(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _densenet(201, pretrained, **kw)

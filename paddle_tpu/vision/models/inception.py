@@ -1,0 +1,65 @@
+"""InceptionV3 (condensed). Reference parity:
+python/paddle/vision/models/inceptionv3.py."""
+from ... import nn
+from ...ops.manipulation import concat
+
+
+class ConvBN(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = ConvBN(in_c, 64, 1)
+        self.b5 = nn.Sequential(ConvBN(in_c, 48, 1), ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(ConvBN(in_c, 64, 1), ConvBN(64, 96, 3, padding=1), ConvBN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, 1), ConvBN(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvBN(3, 32, 3, stride=2), ConvBN(32, 32, 3), ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2), ConvBN(64, 80, 1), ConvBN(80, 192, 3), nn.MaxPool2D(3, 2),
+        )
+        self.mixed = nn.Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+        )
+        self.reduce = nn.Sequential(
+            ConvBN(288, 384, 3, stride=2),
+        )
+        self.tail = nn.Sequential(ConvBN(384, 1024, 3, padding=1))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.reduce(self.mixed(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x.flatten(1))
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled (no egress)")
+    return InceptionV3(**kwargs)

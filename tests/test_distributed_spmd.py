@@ -1,0 +1,271 @@
+"""Distributed/SPMD tests on the virtual 8-device CPU mesh.
+
+Modeled on the reference's no-GPU distributed test strategy (SURVEY.md §4:
+test_dist_base.py gloo path) — here the 'fake backend' is the forced
+8-device host platform; shardings and collectives are real XLA SPMD.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _mesh(**degrees):
+    from paddle_tpu.distributed.mesh import init_mesh
+
+    return init_mesh(degrees)
+
+
+def teardown_module():
+    from paddle_tpu.distributed.mesh import set_mesh
+
+    set_mesh(None)
+
+
+def test_build_mesh_axes():
+    mesh = _mesh(dp=2, mp=2, sp=2)
+    assert mesh.shape["dp"] == 2 and mesh.shape["mp"] == 2
+    assert mesh.shape["pp"] == 1
+
+
+def test_topology_coords():
+    from paddle_tpu.distributed import CommunicateTopology
+
+    topo = CommunicateTopology(dims=(2, 2, 1, 2))
+    assert topo.world_size() == 8
+    assert topo.get_rank(data=1, pipe=0, sharding=0, model=1) == 5
+    assert topo.get_coord(5) == (1, 0, 0, 1)
+    comm = topo.get_comm_list("model")
+    assert all(len(g) == 2 for g in comm)
+
+
+def test_hybrid_communicate_group():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs.update(dict(dp_degree=2, mp_degree=2, pp_degree=1, sharding_degree=2))
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.fleet.get_hybrid_communicate_group()
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_sharding_parallel_world_size() == 2
+    assert hcg.get_parallel_mode() == "sharding_parallel"
+
+
+def test_tp_layers_match_dense():
+    """Column/Row parallel layers must equal dense math (degree-1 path)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+    from paddle_tpu.distributed.mesh import set_mesh
+
+    set_mesh(None)
+    paddle.seed(0)
+    col = ColumnParallelLinear(8, 16, gather_output=True)
+    x = paddle.randn([2, 8])
+    ref = x.numpy() @ col.weight.numpy() + col.bias.numpy()
+    assert np.allclose(col(x).numpy(), ref, atol=1e-5)
+
+    row = RowParallelLinear(16, 8)
+    y = paddle.randn([2, 16])
+    ref2 = y.numpy() @ row.weight.numpy() + row.bias.numpy()
+    assert np.allclose(row(y).numpy(), ref2, atol=1e-5)
+
+    emb = VocabParallelEmbedding(32, 8)
+    ids = paddle.to_tensor(np.array([[1, 5]]))
+    assert np.allclose(emb(ids).numpy()[0, 0], emb.weight.numpy()[1])
+    assert emb.weight.sharding_axes == ("mp", None)
+    assert col.weight.sharding_axes == (None, "mp")
+
+
+def test_sharded_train_step_dp_matches_single():
+    """DP over the mesh must produce the same loss trajectory as 1 device."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.mesh import init_mesh, set_mesh
+    from paddle_tpu.parallel.spmd import make_sharded_train_step
+
+    def build():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        return net, opt
+
+    def loss_fn(out, labels):
+        import jax.numpy as jnp
+
+        logits = out if not isinstance(out, (tuple, list)) else out[0]
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None].astype("int32"), -1))
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 16).astype(np.float32)
+    y = rs.randint(0, 4, (8,))
+
+    losses = {}
+    for degrees in ({"dp": 1}, {"dp": 8}):
+        mesh = init_mesh(degrees)
+        net, opt = build()
+        step = make_sharded_train_step(net, loss_fn, opt, mesh, batch_specs=(P("dp"), P("dp")))
+        params, buffers, opt_state = step.init_state()
+        from paddle_tpu.core import rng
+
+        ls = []
+        key = jax.random.PRNGKey(0)
+        for _ in range(3):
+            xs, ys = step.shard_batch(x, y)
+            loss, params, buffers, opt_state = step(
+                params, buffers, opt_state, np.float32(0.1), key, xs, ys
+            )
+            ls.append(float(np.asarray(loss)))
+        losses[degrees["dp"]] = ls
+    set_mesh(None)
+    assert np.allclose(losses[1], losses[8], atol=1e-5), losses
+
+
+def test_sharded_train_step_tp_zero_matches():
+    """TP (mp=2) + ZeRO-1 over sharding=2 matches the single-device loss."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+    )
+    from paddle_tpu.distributed.mesh import init_mesh, set_mesh
+    from paddle_tpu.parallel.spmd import make_sharded_train_step
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = ColumnParallelLinear(16, 32, gather_output=False)
+            self.fc2 = RowParallelLinear(32, 4, input_is_parallel=True)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    def loss_fn(out, labels):
+        import jax.numpy as jnp
+
+        logits = out if not isinstance(out, (tuple, list)) else out[0]
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None].astype("int32"), -1))
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(4, 16).astype(np.float32)
+    y = rs.randint(0, 4, (4,))
+    key = jax.random.PRNGKey(0)
+
+    results = {}
+    for degrees, zs in (({"dp": 1}, 0), ({"dp": 2, "mp": 2, "sharding": 2}, 1)):
+        mesh = init_mesh(degrees)
+        paddle.seed(0)
+        net = MLP()
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+        step = make_sharded_train_step(
+            net, loss_fn, opt, mesh, batch_specs=(P("dp"), P("dp")), zero_stage=zs
+        )
+        params, buffers, opt_state = step.init_state()
+        ls = []
+        for _ in range(3):
+            xs, ys = step.shard_batch(x, y)
+            loss, params, buffers, opt_state = step(
+                params, buffers, opt_state, np.float32(0.01), key, xs, ys
+            )
+            ls.append(float(np.asarray(loss)))
+        results[zs] = ls
+    set_mesh(None)
+    assert np.allclose(results[0], results[1], atol=1e-4), results
+
+
+def test_ring_attention_matches_reference():
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.mesh import init_mesh, set_mesh
+    from paddle_tpu.ops.pallas.flash_attention import _attention_xla
+    from paddle_tpu.parallel.ring_attention import ring_attention
+
+    mesh = init_mesh({"sp": 8})
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.rand(2, 64, 2, 16).astype(np.float32))
+    k = jnp.asarray(rs.rand(2, 64, 2, 16).astype(np.float32))
+    v = jnp.asarray(rs.rand(2, 64, 2, 16).astype(np.float32))
+    for causal in (False, True):
+        out = ring_attention(q, k, v, mesh=mesh, causal=causal)
+        ref = _attention_xla(q, k, v, causal=causal)
+        assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4), causal
+    set_mesh(None)
+
+
+def test_collective_api_single_rank_semantics():
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    out = dist.all_reduce(t)
+    assert np.allclose(out.numpy(), 1.0)
+    assert dist.get_world_size() == 1
+    assert dist.get_rank() == 0
+    dist.barrier()
+
+
+def test_group_sharded_parallel_api():
+    from paddle_tpu.distributed import group_sharded_parallel
+    from paddle_tpu.distributed.mesh import init_mesh, set_mesh
+
+    init_mesh({"sharding": 8})
+    net = nn.Sequential(nn.Linear(16, 32), nn.Linear(32, 8))
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    model, opt2, _ = group_sharded_parallel(net, opt, "p_g_os")
+    sharded = [
+        p.sharding_axes for p in net.parameters() if p.sharding_axes and any(p.sharding_axes)
+    ]
+    assert len(sharded) >= 2  # weights got ZeRO-3 annotations
+    set_mesh(None)
+
+
+def test_pipeline_layer_partitioning():
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(8)]
+    pipe = PipelineLayer(descs, num_stages=4, loss_fn=nn.MSELoss())
+    assert pipe.get_stage_from_index(0) == 0
+    assert pipe.get_stage_from_index(7) == 3
+    x = paddle.randn([2, 8])
+    out = pipe(x)
+    assert out.shape == [2, 8]
+
+
+def test_data_parallel_wrapper():
+    net = nn.Linear(4, 4)
+    dp = paddle.DataParallel(net)
+    x = paddle.randn([2, 4])
+    assert np.allclose(dp(x).numpy(), net(x).numpy())
+    with dp.no_sync():
+        assert not dp._sync
+    assert dp._sync
+
+
+def test_gpt_tiny_forward_and_loss():
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    from paddle_tpu.distributed.mesh import set_mesh
+
+    set_mesh(None)
+    paddle.seed(0)
+    model = gpt_tiny()
+    ids = paddle.to_tensor(np.random.randint(0, 1024, (2, 64)))
+    logits = model(ids)
+    assert logits.shape == [2, 64, 1024]
+    loss = nn.CrossEntropyLoss()(
+        logits.reshape([-1, 1024]), ids.reshape([-1])
+    )
+    loss.backward()
+    assert model.wte.weight.grad is not None
+    assert np.isfinite(loss.item())

@@ -1,0 +1,117 @@
+"""End-to-end hapi Model tests (the reference's north-star config 1:
+LeNet/MNIST via Model.fit — BASELINE.json)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def _fit_lenet(epochs=3, compiled=True):
+    paddle.seed(0)
+    net = LeNet()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy(), compiled=compiled)
+    train = MNIST(mode="train")
+    train.n = 256
+    model.fit(train, epochs=epochs, batch_size=64, verbose=0)
+    test = MNIST(mode="test")
+    test.n = 128
+    return model, model.evaluate(test, batch_size=64, verbose=0)
+
+
+def test_lenet_mnist_convergence():
+    model, res = _fit_lenet(epochs=4)
+    assert res["acc"] > 0.9, res
+    assert res["loss"] < 0.5
+
+
+def test_eager_adapter_matches():
+    model, res = _fit_lenet(epochs=2, compiled=False)
+    assert res["acc"] > 0.6, res
+
+
+def test_train_batch_api():
+    net = LeNet()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    x = np.random.rand(8, 1, 28, 28).astype(np.float32)
+    y = np.random.randint(0, 10, (8, 1))
+    loss1 = model.train_batch([x], [y])
+    loss2 = model.train_batch([x], [y])
+    assert loss2[0] < loss1[0]  # learning on a fixed batch
+
+
+def test_predict():
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare()
+    test = MNIST(mode="test")
+    test.n = 32
+    out = model.predict(test, batch_size=16, verbose=0)
+    assert len(out) == 1
+    assert out[0][0].shape == (16, 10)
+
+
+def test_save_load(tmp_path):
+    model, res = _fit_lenet(epochs=1)
+    path = str(tmp_path / "ck" / "model")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+
+    net2 = LeNet()
+    model2 = paddle.Model(net2)
+    opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+    model2.prepare(opt2, nn.CrossEntropyLoss(), Accuracy())
+    model2.load(path)
+    for (k1, v1), (k2, v2) in zip(
+        model.network.state_dict().items(), net2.state_dict().items()
+    ):
+        assert np.allclose(v1.numpy(), v2.numpy(), atol=1e-6)
+
+
+def test_paddle_save_load_tensors(tmp_path):
+    obj = {"a": paddle.to_tensor(np.random.rand(3, 3).astype(np.float32)), "b": [1, 2]}
+    p = str(tmp_path / "obj.pdt")
+    paddle.save(obj, p)
+    back = paddle.load(p)
+    assert np.allclose(back["a"].numpy(), obj["a"].numpy())
+    assert back["b"] == [1, 2]
+
+
+def test_bf16_save_load(tmp_path):
+    t = paddle.to_tensor(np.random.rand(4).astype(np.float32)).astype("bfloat16")
+    p = str(tmp_path / "bf16.pdt")
+    paddle.save({"t": t}, p)
+    back = paddle.load(p)
+    assert np.dtype(back["t"].dtype).name == "bfloat16"
+
+
+def test_callbacks_early_stopping():
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+
+    paddle.seed(0)
+    net = LeNet()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    train = MNIST(mode="train")
+    train.n = 128
+    es = EarlyStopping(monitor="acc", mode="max", patience=0)
+    model.fit(train, eval_data=train, epochs=3, batch_size=64, verbose=0, callbacks=[es])
+    # just ensure it ran and the flag machinery works
+    assert isinstance(model.stop_training, bool)
+
+
+def test_summary():
+    from paddle_tpu.hapi.summary import summary
+
+    info = summary(LeNet())
+    assert info["total_params"] > 40000

@@ -1,0 +1,223 @@
+"""nn layer tests: shapes, numerics vs manual computation, state_dict."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_forward():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    assert np.allclose(y.numpy(), ref, atol=1e-5)
+
+
+def test_conv2d_shapes():
+    layer = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = layer(x)
+    assert y.shape == [2, 8, 8, 8]
+
+
+def test_conv2d_matches_reference_math():
+    import jax
+
+    w = np.random.rand(2, 1, 3, 3).astype(np.float32)
+    x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=0)
+    # direct correlation
+    ref = np.zeros((1, 2, 3, 3), np.float32)
+    for o in range(2):
+        for i in range(3):
+            for j in range(3):
+                ref[0, o, i, j] = (x[0, 0, i : i + 3, j : j + 3] * w[o, 0]).sum()
+    assert np.allclose(out.numpy(), ref, atol=1e-4)
+
+
+def test_conv_grad_flows():
+    layer = nn.Conv2D(1, 2, 3)
+    x = paddle.randn([1, 1, 8, 8])
+    y = layer(x).sum()
+    y.backward()
+    assert layer.weight.grad is not None
+    assert layer.bias.grad is not None
+
+
+def test_conv2d_transpose_shape():
+    layer = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1, output_padding=1)
+    x = paddle.randn([1, 4, 8, 8])
+    assert layer(x).shape == [1, 2, 16, 16]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5])
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    assert np.allclose(m, 0, atol=1e-4)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8])
+    y = ln(x)
+    assert np.allclose(y.numpy().mean(-1), 0, atol=1e-4)
+    assert np.allclose(y.numpy().std(-1), 1, atol=1e-2)
+
+
+def test_groupnorm_instancenorm():
+    gn = nn.GroupNorm(2, 4)
+    x = paddle.randn([2, 4, 6, 6])
+    assert gn(x).shape == [2, 4, 6, 6]
+    inn = nn.InstanceNorm2D(4)
+    assert inn(x).shape == [2, 4, 6, 6]
+
+
+def test_pooling():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+    a = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    mp = F.max_pool2d(paddle.to_tensor(a), 2, 2).numpy()
+    assert np.allclose(mp[0, 0], [[5, 7], [13, 15]])
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    assert np.allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x)
+    frac = (y.numpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    assert abs(y.numpy().mean() - 1.0) < 0.2  # upscale_in_train
+    d.eval()
+    assert np.allclose(d(x).numpy(), 1.0)
+
+
+def test_activations():
+    x = paddle.to_tensor(np.array([-2.0, 0.0, 2.0], np.float32))
+    assert np.allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+    assert np.allclose(nn.Sigmoid()(x).numpy(), 1 / (1 + np.exp([2.0, 0, -2.0])), atol=1e-5)
+    assert nn.GELU()(x).shape == [3]
+    s = nn.Softmax()(x).numpy()
+    assert abs(s.sum() - 1) < 1e-5
+
+
+def test_losses():
+    logits = paddle.to_tensor(np.array([[2.0, 1.0, 0.1]], np.float32))
+    label = paddle.to_tensor(np.array([0]))
+    loss = nn.CrossEntropyLoss()(logits, label)
+    p = np.exp([2.0, 1.0, 0.1])
+    ref = -np.log(p[0] / p.sum())
+    assert abs(loss.item() - ref) < 1e-5
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([1.5, 2.5], np.float32))
+    assert abs(nn.MSELoss()(a, b).item() - 0.25) < 1e-6
+    assert abs(nn.L1Loss()(a, b).item() - 0.5) < 1e-6
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 5])
+    label = paddle.to_tensor(np.array([0, 1, -100, 2]))
+    loss = nn.CrossEntropyLoss(ignore_index=-100)(logits, label)
+    assert np.isfinite(loss.item())
+
+
+def test_sequential_and_containers():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert seq(x).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+
+
+def test_state_dict_roundtrip():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    assert len(sd) == 4
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict(sd)
+    for (k1, v1), (k2, v2) in zip(net.state_dict().items(), net2.state_dict().items()):
+        assert np.allclose(v1.numpy(), v2.numpy())
+
+
+def test_named_parameters_unique():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    names = [n for n, _ in net.named_parameters()]
+    assert len(names) == len(set(names)) == 4
+
+
+def test_rnn_lstm_gru():
+    x = paddle.randn([2, 5, 4])  # [batch, time, feat]
+    for cls in (nn.SimpleRNN, nn.LSTM, nn.GRU):
+        rnn = cls(4, 8)
+        out, state = rnn(x)
+        assert out.shape == [2, 5, 8]
+
+
+def test_lstm_grad():
+    rnn = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    out, _ = rnn(x)
+    out.sum().backward()
+    cell = rnn.layers[0].cell
+    assert cell.weight_ih.grad is not None
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 6, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    assert enc(x).shape == [2, 6, 16]
+
+
+def test_clip_grad_global_norm():
+    p = paddle.Parameter(np.ones(4, np.float32) * 3)
+    g = paddle.to_tensor(np.ones(4, np.float32) * 10)
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p, g)])
+    norm = np.linalg.norm(out[0][1].numpy())
+    assert abs(norm - 1.0) < 1e-4
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+
+    w = I.XavierUniform()([64, 64])
+    assert abs(np.asarray(w).std() - np.sqrt(2.0 / 128)) < 0.02
+    k = I.KaimingNormal()([100, 100])
+    assert abs(np.asarray(k).std() - np.sqrt(2.0 / 100)) < 0.02
+    c = I.Constant(3.0)([5])
+    assert np.allclose(np.asarray(c), 3.0)
+    o = np.asarray(I.Orthogonal()([8, 8]))
+    assert np.allclose(o @ o.T, np.eye(8), atol=1e-4)

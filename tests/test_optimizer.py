@@ -1,0 +1,136 @@
+"""Optimizer + LR scheduler tests (numeric update rules vs manual refs)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quad_problem(opt_cls, steps=50, **kw):
+    paddle.seed(0)
+    w = paddle.Parameter(np.array([5.0], np.float32))
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(w.numpy()[0])
+
+
+def test_sgd_converges():
+    assert abs(_quad_problem(optimizer.SGD, learning_rate=0.1)) < 0.1
+
+
+def test_sgd_exact_step():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(learning_rate=0.5, parameters=[w])
+    (w * 3.0).sum().backward()
+    opt.step()
+    assert abs(w.numpy()[0] - (1.0 - 0.5 * 3.0)) < 1e-6
+
+
+def test_momentum_converges():
+    assert abs(_quad_problem(optimizer.Momentum, learning_rate=0.05, momentum=0.9, steps=80)) < 0.2
+
+
+def test_adam_bias_correction_first_step():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+    (w * 2.0).sum().backward()
+    opt.step()
+    # first adam step moves by ~lr regardless of grad scale
+    assert abs(w.numpy()[0] - 0.9) < 1e-3
+
+
+def test_adam_converges():
+    assert abs(_quad_problem(optimizer.Adam, learning_rate=0.2, steps=100)) < 0.1
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=[w], weight_decay=0.5)
+    w._grad = None
+    (w * 0.0).sum().backward()
+    opt.step()
+    # grad is 0 -> update is pure decay: w -= lr*wd*w
+    assert abs(w.numpy()[0] - (1.0 - 0.1 * 0.5)) < 1e-4
+
+
+def test_all_optimizers_step():
+    for cls, kw in [
+        (optimizer.Adamax, {}),
+        (optimizer.Adagrad, {"learning_rate": 0.1}),
+        (optimizer.Adadelta, {}),
+        (optimizer.RMSProp, {"learning_rate": 0.01}),
+        (optimizer.Lamb, {}),
+    ]:
+        w = paddle.Parameter(np.ones(3, np.float32))
+        opt = cls(parameters=[w], **kw)
+        (w * w).sum().backward()
+        opt.step()
+        assert np.abs(w.numpy() - 1.0).max() > 1e-7, cls.__name__
+
+
+def test_grad_clip_in_optimizer():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(
+        learning_rate=1.0, parameters=[w], grad_clip=nn.ClipGradByGlobalNorm(0.1)
+    )
+    (w * 100.0).sum().backward()
+    opt.step()
+    assert abs(w.numpy()[0] - 0.9) < 1e-4  # clipped grad = 0.1
+
+
+def test_weight_decay_coupled():
+    w = paddle.Parameter(np.array([2.0], np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w], weight_decay=0.5)
+    (w * 0.0).sum().backward()
+    opt.step()
+    assert abs(w.numpy()[0] - (2.0 - 0.1 * 0.5 * 2.0)) < 1e-5
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.Parameter(np.ones(3, np.float32), name="w0")
+    opt = optimizer.Adam(parameters=[w])
+    (w * w).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    w2 = paddle.Parameter(np.ones(3, np.float32), name="w0")
+    opt2 = optimizer.Adam(parameters=[w2])
+    opt2.set_state_dict(sd)
+    st = opt2._get_state(w2)
+    ref = opt._get_state(w)
+    assert np.allclose(np.asarray(st["moment1"]), np.asarray(ref["moment1"]))
+
+
+def test_lr_schedulers():
+    lr = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(lr())
+        lr.step()
+    assert np.allclose(vals[:2], 0.1) and np.allclose(vals[2:4], 0.05)
+
+    cos = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos() - 1.0) < 1e-6
+
+    warm = optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    v0 = warm()
+    for _ in range(10):
+        warm.step()
+    assert v0 < 0.02 and abs(warm() - 0.1) < 1e-6
+
+    pw = optimizer.lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+    for i in range(8):
+        expected = 0.1 if i < 3 else (0.01 if i < 6 else 0.001)
+        assert abs(pw() - expected) < 1e-9
+        pw.step()
+
+
+def test_scheduler_in_optimizer():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    sched = optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+    opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+    sched.step()
+    assert abs(opt.get_lr() - 0.01) < 1e-9

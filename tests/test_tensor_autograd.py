@@ -1,0 +1,217 @@
+"""Tensor + eager autograd tests.
+
+Modeled on the reference OpTest pattern (unittests/op_test.py:326): numpy
+reference forward + gradient check against jax.grad ground truth.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    a = np.random.rand(3, 4).astype(np.float32)
+    t = paddle.to_tensor(a)
+    assert t.shape == [3, 4]
+    assert np.allclose(t.numpy(), a)
+
+
+def test_default_float32():
+    t = paddle.to_tensor([1.5, 2.5])
+    assert np.dtype(t.dtype) == np.float32
+
+
+def test_arithmetic_and_broadcast():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = paddle.to_tensor(np.ones((3,), np.float32))
+    z = x + y * 2 - 1
+    assert np.allclose(z.numpy(), x.numpy() + 1)
+    assert np.allclose((x / 2).numpy(), x.numpy() / 2)
+    assert np.allclose((x ** 2).numpy(), x.numpy() ** 2)
+
+
+def test_matmul_grad_matches_jax():
+    a = np.random.rand(4, 5).astype(np.float32)
+    b = np.random.rand(5, 6).astype(np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    w = paddle.to_tensor(b, stop_gradient=False)
+    loss = paddle.matmul(x, w).sum()
+    loss.backward()
+    ga, gb = jax.grad(lambda p, q: jnp.sum(p @ q), (0, 1))(a, b)
+    assert np.allclose(x.grad.numpy(), ga, atol=1e-5)
+    assert np.allclose(w.grad.numpy(), gb, atol=1e-5)
+
+
+def test_grad_accumulation_fanout():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * 3 + x * 4  # two uses of x
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [7.0])
+
+
+def test_chained_ops_grad():
+    a = np.random.rand(8).astype(np.float32) + 0.1
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = (x.log() * x.sqrt()).sum()
+    y.backward()
+    g = jax.grad(lambda v: jnp.sum(jnp.log(v) * jnp.sqrt(v)))(a)
+    assert np.allclose(x.grad.numpy(), g, atol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones(3, np.float32))  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        y = (x * 2).sum()
+    assert y._node is None
+
+
+def test_backward_twice_raises():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    assert np.allclose(x.grad.numpy(), 4 * np.ones(3))
+
+
+def test_detach_and_clone():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    s = (c * 2).sum()
+    s.backward()
+    assert np.allclose(x.grad.numpy(), 2 * np.ones(3))
+
+
+def test_getitem_setitem():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert np.allclose(x[1].numpy(), np.arange(4, 8))
+    x[0, 0] = 100.0
+    assert x.numpy()[0, 0] == 100.0
+
+
+def test_getitem_grad():
+    a = np.random.rand(4, 4).astype(np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = x[1:3].sum()
+    y.backward()
+    expected = np.zeros((4, 4), np.float32)
+    expected[1:3] = 1
+    assert np.allclose(x.grad.numpy(), expected)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    assert np.allclose(g.numpy(), [6.0])
+
+
+def test_reductions_match_numpy():
+    a = np.random.rand(3, 5).astype(np.float32)
+    x = paddle.to_tensor(a)
+    assert np.allclose(x.sum(axis=1).numpy(), a.sum(1), atol=1e-6)
+    assert np.allclose(x.mean().numpy(), a.mean(), atol=1e-6)
+    assert np.allclose(x.max(axis=0).numpy(), a.max(0))
+    assert np.allclose(x.std().numpy(), a.std(ddof=1), atol=1e-5)
+    assert np.allclose(x.logsumexp().numpy(), np.log(np.exp(a).sum()), atol=1e-5)
+
+
+def test_manipulation_ops():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    x = paddle.to_tensor(a)
+    assert paddle.reshape(x, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(x, 1).shape == [2, 12]
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    assert paddle.concat(parts, axis=1).shape == [2, 3, 4]
+    assert paddle.stack([x, x]).shape == [2, 2, 3, 4]
+    assert paddle.squeeze(parts[0], 1).shape == [2, 4]
+    assert paddle.unsqueeze(x, 0).shape == [1, 2, 3, 4]
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+    idx = paddle.to_tensor(np.array([1, 3, 5]))
+    assert np.allclose(paddle.gather(x, idx).numpy(), [1, 3, 5])
+    y = paddle.scatter(x, idx, paddle.to_tensor(np.zeros(3, np.float32)))
+    assert y.numpy()[1] == 0 and y.numpy()[3] == 0
+
+
+def test_where_topk_sort():
+    a = np.random.rand(4, 6).astype(np.float32)
+    x = paddle.to_tensor(a)
+    v, i = paddle.topk(x, 2, axis=1)
+    ref = np.sort(a, 1)[:, ::-1][:, :2]
+    assert np.allclose(v.numpy(), ref)
+    w = paddle.where(x > 0.5, x, paddle.zeros_like(x))
+    assert np.allclose(w.numpy(), np.where(a > 0.5, a, 0))
+
+
+def test_einsum():
+    a = np.random.rand(2, 3).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    assert np.allclose(out.numpy(), a @ b, atol=1e-5)
+
+
+def test_linalg_suite():
+    a = np.random.rand(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    x = paddle.to_tensor(spd)
+    c = paddle.cholesky(x)
+    assert np.allclose((c @ c.t()).numpy(), spd, atol=1e-3)
+    assert np.allclose(paddle.inverse(x).numpy(), np.linalg.inv(spd), atol=1e-3)
+    assert abs(paddle.det(x).item() - np.linalg.det(spd)) / abs(np.linalg.det(spd)) < 1e-3
+
+
+def test_cast_astype():
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    # int64 maps to int32 on TPU (x64 disabled) — integer semantics preserved
+    assert np.dtype(x.astype("int64").dtype).kind == "i"
+    assert np.dtype(x.astype("bfloat16").dtype).name == "bfloat16"
+    assert np.dtype(x.astype("float16").dtype) == np.float16
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([4]).numpy().sum() == 4
+    assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.linspace(0, 1, 5).shape == [5]
+    assert paddle.eye(3).numpy().trace() == 3
+    assert paddle.randn([3, 3]).shape == [3, 3]
+    assert paddle.randperm(10).numpy().sum() == 45
+    paddle.seed(42)
+    a = paddle.rand([4]).numpy()
+    paddle.seed(42)
+    b = paddle.rand([4]).numpy()
+    assert np.allclose(a, b)
+
+
+def test_logic_ops():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    y = paddle.to_tensor(np.array([1.0, 5.0, 3.0], np.float32))
+    assert (x == y).numpy().tolist() == [True, False, True]
+    assert paddle.allclose(x, x).item()
+    assert not paddle.equal_all(x, y).item()
