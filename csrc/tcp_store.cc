@@ -1,0 +1,332 @@
+// TCPStore: rendezvous key-value store for multi-host bootstrap.
+//
+// Reference parity: paddle/phi/core/distributed/store/tcp_store.h:120 and
+// tcp_utils.cc in /root/reference (the KV store behind init_parallel_env's
+// rank rendezvous). Same capability, fresh implementation: a small
+// threaded TCP server with SET/GET(blocking)/ADD/DELETE/WAIT ops over a
+// length-prefixed binary protocol, exposed through a C ABI for ctypes.
+//
+// Build: g++ -O3 -shared -fPIC (see paddle_tpu/utils/cpp_extension.py).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { SET = 0, GET = 1, ADD = 2, DEL = 3, CHECK = 4 };
+
+struct Store {
+  std::map<std::string, std::vector<uint8_t>> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!read_exact(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_exact(fd, out->data(), len);
+}
+
+bool write_blob(int fd, const void* data, uint32_t len) {
+  if (!write_exact(fd, &len, 4)) return false;
+  return len == 0 || write_exact(fd, data, len);
+}
+
+struct Server {
+  Store store;
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::vector<int> client_fds;
+  std::mutex handlers_mu;
+
+  void handle(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      if (!read_exact(fd, &op, 1)) break;
+      std::string key;
+      if (!read_blob(fd, &key)) break;
+      if (op == SET) {
+        std::string val;
+        if (!read_blob(fd, &val)) break;
+        {
+          std::lock_guard<std::mutex> lk(store.mu);
+          store.data[key].assign(val.begin(), val.end());
+        }
+        store.cv.notify_all();
+        uint8_t ok = 1;
+        if (!write_exact(fd, &ok, 1)) break;
+      } else if (op == GET) {
+        // blocking get: waits until the key exists (the WAIT semantic of the
+        // reference's tcp_store Get)
+        std::vector<uint8_t> val;
+        {
+          std::unique_lock<std::mutex> lk(store.mu);
+          store.cv.wait(lk, [&] { return stop.load() || store.data.count(key); });
+          if (stop.load()) break;
+          val = store.data[key];
+        }
+        if (!write_blob(fd, val.data(), static_cast<uint32_t>(val.size()))) break;
+      } else if (op == ADD) {
+        int64_t delta;
+        if (!read_exact(fd, &delta, 8)) break;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> lk(store.mu);
+          auto& v = store.data[key];
+          int64_t cur = 0;
+          if (v.size() == 8) std::memcpy(&cur, v.data(), 8);
+          cur += delta;
+          v.resize(8);
+          std::memcpy(v.data(), &cur, 8);
+          result = cur;
+        }
+        store.cv.notify_all();
+        if (!write_exact(fd, &result, 8)) break;
+      } else if (op == DEL) {
+        uint8_t existed;
+        {
+          std::lock_guard<std::mutex> lk(store.mu);
+          existed = store.data.erase(key) ? 1 : 0;
+        }
+        if (!write_exact(fd, &existed, 1)) break;
+      } else if (op == CHECK) {
+        uint8_t exists;
+        {
+          std::lock_guard<std::mutex> lk(store.mu);
+          exists = store.data.count(key) ? 1 : 0;
+        }
+        if (!write_exact(fd, &exists, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int start(int port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return -1;
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    int bound_port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 64) != 0) return -1;
+    accept_thread = std::thread([this] {
+      for (;;) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) {
+          if (stop.load()) return;
+          continue;
+        }
+        std::lock_guard<std::mutex> lk(handlers_mu);
+        client_fds.push_back(fd);
+        handlers.emplace_back([this, fd] { handle(fd); });
+      }
+    });
+    return bound_port;
+  }
+
+  void shutdown() {
+    stop.store(true);
+    store.cv.notify_all();
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    // Unblock every handler (recv returns 0 on a shutdown socket), then JOIN
+    // them so no thread can outlive this object (no use-after-free).
+    {
+      std::lock_guard<std::mutex> lk(handlers_mu);
+      for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    std::vector<std::thread> to_join;
+    {
+      std::lock_guard<std::mutex> lk(handlers_mu);
+      to_join.swap(handlers);
+    }
+    for (auto& t : to_join)
+      if (t.joinable()) t.join();
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+
+  int connect_to(const char* host, int port, int timeout_sec) {
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -1;
+    int attempts = timeout_sec > 0 ? timeout_sec * 10 : 100;
+    for (int attempt = 0; attempt < attempts; ++attempt) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) return -1;
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return 0;
+      }
+      ::close(fd);
+      fd = -1;
+      ::usleep(100000);
+    }
+    return -1;
+  }
+
+  void set_timeout(int seconds) {
+    if (fd < 0) return;
+    timeval tv{};
+    tv.tv_sec = seconds;
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ts_server_start(int port, int* bound_port) {
+  auto* s = new Server();
+  int p = s->start(port);
+  if (p < 0) {
+    delete s;
+    return nullptr;
+  }
+  if (bound_port) *bound_port = p;
+  return s;
+}
+
+void ts_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->shutdown();
+  delete s;
+}
+
+void* ts_client_connect(const char* host, int port) {
+  auto* c = new Client();
+  if (c->connect_to(host, port, 10) != 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void ts_client_set_timeout(void* h, int seconds) {
+  static_cast<Client*>(h)->set_timeout(seconds);
+}
+
+void ts_client_free(void* h) {
+  auto* c = static_cast<Client*>(h);
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+int ts_set(void* h, const char* key, const uint8_t* val, uint32_t vlen) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t op = SET;
+  if (!write_exact(c->fd, &op, 1)) return -1;
+  if (!write_blob(c->fd, key, static_cast<uint32_t>(std::strlen(key)))) return -1;
+  if (!write_blob(c->fd, val, vlen)) return -1;
+  uint8_t ok;
+  return read_exact(c->fd, &ok, 1) ? 0 : -1;
+}
+
+// Blocking get; returns value length, -1 on error, -2 if buffer too small
+// (in which case *needed holds the required size and the value is consumed).
+int64_t ts_get(void* h, const char* key, uint8_t* out, uint32_t cap) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t op = GET;
+  if (!write_exact(c->fd, &op, 1)) return -1;
+  if (!write_blob(c->fd, key, static_cast<uint32_t>(std::strlen(key)))) return -1;
+  uint32_t len = 0;
+  if (!read_exact(c->fd, &len, 4)) return -1;
+  std::vector<uint8_t> tmp(len);
+  if (len > 0 && !read_exact(c->fd, tmp.data(), len)) return -1;
+  if (len > cap) return -2;
+  if (len > 0) std::memcpy(out, tmp.data(), len);
+  return static_cast<int64_t>(len);
+}
+
+int64_t ts_add(void* h, const char* key, int64_t delta) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t op = ADD;
+  if (!write_exact(c->fd, &op, 1)) return INT64_MIN;
+  if (!write_blob(c->fd, key, static_cast<uint32_t>(std::strlen(key)))) return INT64_MIN;
+  if (!write_exact(c->fd, &delta, 8)) return INT64_MIN;
+  int64_t result;
+  return read_exact(c->fd, &result, 8) ? result : INT64_MIN;
+}
+
+int ts_check(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t op = CHECK;
+  if (!write_exact(c->fd, &op, 1)) return -1;
+  if (!write_blob(c->fd, key, static_cast<uint32_t>(std::strlen(key)))) return -1;
+  uint8_t exists;
+  return read_exact(c->fd, &exists, 1) ? exists : -1;
+}
+
+int ts_del(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t op = DEL;
+  if (!write_exact(c->fd, &op, 1)) return -1;
+  if (!write_blob(c->fd, key, static_cast<uint32_t>(std::strlen(key)))) return -1;
+  uint8_t existed;
+  return read_exact(c->fd, &existed, 1) ? existed : -1;
+}
+
+}  // extern "C"

@@ -23,12 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.tensor import Tensor
 from .mesh import AxisGroup, get_mesh
 
-try:  # jax>=0.6 module move
-    from jax import shard_map as _shard_map_mod
-
-    shard_map = _shard_map_mod.shard_map if hasattr(_shard_map_mod, "shard_map") else _shard_map_mod
-except Exception:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from ..parallel._compat import shard_map
 
 
 class ReduceOp:

@@ -1,0 +1,83 @@
+"""TCPStore: rendezvous KV store (native C++ backend).
+
+Reference parity: the Python-visible core.TCPStore used by init_parallel_env
+(/root/reference/python/paddle/distributed/parallel.py:1090, C++ at
+phi/core/distributed/store/tcp_store.h:120). Backed by csrc/tcp_store.cc.
+"""
+from __future__ import annotations
+
+import ctypes
+
+from ..utils.cpp_extension import load_native
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1, timeout=900):
+        self._lib = load_native()
+        self._server = None
+        self.host = host
+        if is_master:
+            bound = ctypes.c_int(0)
+            self._server = self._lib.ts_server_start(port, ctypes.byref(bound))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: failed to bind port {port}")
+            port = bound.value
+        self.port = port
+        self.timeout = timeout
+        self._client = self._lib.ts_client_connect(host.encode(), port)
+        if not self._client:
+            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+        if timeout:
+            # recv timeout: blocking get() raises instead of hanging forever
+            self._lib.ts_client_set_timeout(self._client, int(timeout))
+
+    def set(self, key: str, value):
+        data = value if isinstance(value, (bytes, bytearray)) else str(value).encode()
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        if self._lib.ts_set(self._client, key.encode(), buf, len(data)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        cap = 1 << 16
+        buf = (ctypes.c_uint8 * cap)()
+        n = self._lib.ts_get(self._client, key.encode(), buf, cap)
+        if n == -2:
+            cap = 1 << 24
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.ts_get(self._client, key.encode(), buf, cap)
+        if n < 0:
+            raise RuntimeError(
+                f"TCPStore.get({key!r}) failed (timeout={self.timeout}s or connection lost)"
+            )
+        return bytes(buf[: int(n)])
+
+    def add(self, key: str, delta: int) -> int:
+        r = self._lib.ts_add(self._client, key.encode(), int(delta))
+        if r == -(2**63):
+            raise RuntimeError("TCPStore.add failed")
+        return int(r)
+
+    def check(self, key: str) -> bool:
+        return self._lib.ts_check(self._client, key.encode()) == 1
+
+    def delete_key(self, key: str) -> bool:
+        return self._lib.ts_del(self._client, key.encode()) == 1
+
+    def wait(self, keys):
+        for k in keys if isinstance(keys, (list, tuple)) else [keys]:
+            self.get(k)  # blocking get IS the wait
+
+    def barrier(self, prefix: str, world_size: int, rank: int):
+        n = self.add(f"{prefix}/count", 1)
+        if n == world_size:
+            self.set(f"{prefix}/done", b"1")
+        self.get(f"{prefix}/done")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.ts_client_free(self._client)
+            if getattr(self, "_server", None):
+                self._lib.ts_server_stop(self._server)
+        except Exception:
+            pass

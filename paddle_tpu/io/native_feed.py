@@ -1,0 +1,186 @@
+"""Native data-feed fast path.
+
+Reference parity: the BufferedReader prefetcher + DataFeed batch assembly
+(SURVEY.md §2.3 data pipeline). For array-backed datasets this path does
+epoch shuffling, batch gather-collate, and bounded prefetch in C++
+(csrc/data_feed.cc), handing ready numpy batches to jax.device_put.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from ..utils.cpp_extension import load_native
+
+
+def shuffle_indices(n, seed):
+    lib = load_native()
+    idx = np.arange(n, dtype=np.int64)
+    lib.df_shuffle_indices(idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, int(seed) & (2**64 - 1))
+    return idx
+
+
+def gather_collate(base: np.ndarray, indices: np.ndarray, n_threads=4) -> np.ndarray:
+    """base: [N, ...]; returns base[indices] via parallel memcpy."""
+    lib = load_native()
+    base = np.ascontiguousarray(base)
+    indices = np.ascontiguousarray(indices, np.int64)
+    sample_bytes = base.itemsize * int(np.prod(base.shape[1:], dtype=np.int64))
+    out = np.empty((len(indices),) + base.shape[1:], base.dtype)
+    lib.df_gather_collate(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        base.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(indices), sample_bytes, n_threads,
+    )
+    return out
+
+
+class NativeBatchQueue:
+    """Bounded producer/consumer byte queue backed by the C++ ring buffer."""
+
+    def __init__(self, capacity=8):
+        self._lib = load_native()
+        self._h = self._lib.df_queue_new(capacity)
+        self._closed = False
+
+    def push(self, arr: np.ndarray) -> bool:
+        arr = np.ascontiguousarray(arr)
+        r = self._lib.df_queue_push(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), arr.nbytes
+        )
+        return r == 0
+
+    def pop(self, shape, dtype) -> np.ndarray | None:
+        out = np.empty(shape, dtype)
+        n = self._lib.df_queue_pop(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), out.nbytes
+        )
+        if n == 0:
+            return None
+        if n != out.nbytes:
+            raise RuntimeError(f"queue pop size mismatch: {n} vs {out.nbytes}")
+        return out
+
+    def close(self):
+        if not self._closed:
+            self._lib.df_queue_close(self._h)
+            self._closed = True
+
+    def __len__(self):
+        return int(self._lib.df_queue_size(self._h))
+
+    def __del__(self):
+        try:
+            self.close()
+            self._lib.df_queue_free(self._h)
+        except Exception:
+            pass
+
+
+class ArrayDataFeed:
+    """High-throughput feed over in-memory arrays (images/labels): C++
+    shuffle + collate + prefetch thread. Yields numpy batch tuples."""
+
+    def __init__(self, arrays, batch_size, shuffle=True, drop_last=False, seed=0, prefetch=4, n_threads=4):
+        self.arrays = [np.ascontiguousarray(a) for a in arrays]
+        self.n = len(self.arrays[0])
+        for a in self.arrays[1:]:
+            if len(a) != self.n:
+                raise ValueError(
+                    f"all arrays must share length: {len(a)} != {self.n}"
+                )
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.prefetch = prefetch
+        self.n_threads = n_threads
+        self._epoch = 0
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        if self.shuffle:
+            idx = shuffle_indices(self.n, self.seed + self._epoch)
+        else:
+            idx = np.arange(self.n, dtype=np.int64)
+        self._epoch += 1
+        bs = self.batch_size
+        n_batches = len(self)
+        fixed_shapes = self.drop_last or self.n % bs == 0
+        if fixed_shapes:
+            yield from self._iter_native_queue(idx, bs, n_batches)
+        else:
+            yield from self._iter_python_queue(idx, bs, n_batches)
+
+    def _iter_native_queue(self, idx, bs, n_batches):
+        """Fixed-shape batches flow through the C++ ring buffer (the
+        BufferedReader double-buffer role)."""
+        queues = [NativeBatchQueue(self.prefetch) for _ in self.arrays]
+        shapes = [(bs,) + a.shape[1:] for a in self.arrays]
+        error = []
+
+        def producer():
+            try:
+                for b in range(n_batches):
+                    sel = idx[b * bs : (b + 1) * bs]
+                    for a, q in zip(self.arrays, queues):
+                        if not q.push(gather_collate(a, sel, self.n_threads)):
+                            return  # consumer closed the queues
+            except Exception as e:
+                error.append(e)
+            finally:
+                for q in queues:
+                    q.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            for _ in range(n_batches):
+                batch = tuple(
+                    q.pop(shape, a.dtype)
+                    for q, shape, a in zip(queues, shapes, self.arrays)
+                )
+                if any(b is None for b in batch):
+                    break
+                yield batch
+        finally:
+            for q in queues:
+                q.close()
+            t.join(timeout=5)
+        if error:
+            raise error[0]
+
+    def _iter_python_queue(self, idx, bs, n_batches):
+        import queue as pyqueue
+
+        q = pyqueue.Queue(maxsize=self.prefetch)
+        SENTINEL = object()
+
+        def producer():
+            try:
+                for b in range(n_batches):
+                    sel = idx[b * bs : (b + 1) * bs]
+                    q.put(
+                        tuple(gather_collate(a, sel, self.n_threads) for a in self.arrays)
+                    )
+            except Exception as e:
+                q.put(e)
+            finally:
+                q.put(SENTINEL)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is SENTINEL:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item

@@ -1,0 +1,171 @@
+"""Pipelined GPT: pp x mp x dp in one compiled program.
+
+The pipeline schedule is parallel.pipeline.gpipe (shard_map + ppermute scan);
+inside the manual region the transformer block uses EXPLICIT Megatron
+collectives (qkv/fc1 column-sharded, proj/fc2 row-sharded with psum over
+'mp') — the shard_map twin of the GSPMD-annotated GPT in models/gpt.py and
+the reference's mp_ops.py (_c_identity/_mp_allreduce pairs,
+/root/reference/python/paddle/distributed/fleet/layers/mpu/mp_ops.py).
+Embedding/head run in the surrounding GSPMD region.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.pipeline import gpipe, stack_stage_params
+
+
+def _init_block(key, H, F, n_heads):
+    ks = jax.random.split(key, 4)
+    std = 0.02
+    return {
+        "ln1_g": jnp.ones((H,), jnp.float32),
+        "ln1_b": jnp.zeros((H,), jnp.float32),
+        "wqkv": jax.random.normal(ks[0], (H, 3 * H)) * std,
+        "bqkv": jnp.zeros((3 * H,), jnp.float32),
+        "wproj": jax.random.normal(ks[1], (H, H)) * std,
+        "bproj": jnp.zeros((H,), jnp.float32),
+        "ln2_g": jnp.ones((H,), jnp.float32),
+        "ln2_b": jnp.zeros((H,), jnp.float32),
+        "w1": jax.random.normal(ks[2], (H, F)) * std,
+        "b1": jnp.zeros((F,), jnp.float32),
+        "w2": jax.random.normal(ks[3], (F, H)) * std,
+        "b2": jnp.zeros((H,), jnp.float32),
+    }
+
+
+def _ln(x, g, b, eps=1e-5):
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * g + b
+
+
+def _block_fn(bp, x, n_heads_local, mp_axis="mp"):
+    """One transformer block on mp-local shards; x replicated over mp."""
+    h = _ln(x, bp["ln1_g"], bp["ln1_b"])
+    qkv = h @ bp["wqkv"] + bp["bqkv"]  # [mb, s, 3H/mp]
+    mb, s, three_h_local = qkv.shape
+    hd = three_h_local // (3 * n_heads_local)
+    qkv = qkv.reshape(mb, s, 3, n_heads_local, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scale = 1.0 / np.sqrt(hd)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    att = jnp.where(mask[None, None], att, -1e30)
+    att = jax.nn.softmax(att, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(mb, s, -1)
+    proj = out @ bp["wproj"]  # row-sharded: partial sums
+    proj = jax.lax.psum(proj, mp_axis) + bp["bproj"]
+    x = x + proj
+    h = _ln(x, bp["ln2_g"], bp["ln2_b"])
+    a = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
+    mlp = jax.lax.psum(a @ bp["w2"], mp_axis) + bp["b2"]
+    return x + mlp
+
+
+def make_pipelined_gpt(cfg, mesh, n_microbatches):
+    """Returns (params, train_step) — train_step jitted with shardings."""
+    pp = mesh.shape["pp"]
+    mp = mesh.shape["mp"]
+    assert cfg.num_layers % pp == 0
+    K = cfg.num_layers // pp
+    assert cfg.num_heads % mp == 0
+    n_heads_local = cfg.num_heads // mp
+    H, F, V, S = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.max_seq_len
+
+    key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, cfg.num_layers + 2)
+    stages = []
+    for p in range(pp):
+        stage_blocks = [_init_block(keys[p * K + i], H, F, cfg.num_heads) for i in range(K)]
+        stages.append(
+            jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *stage_blocks)
+        )  # leaves [K, ...]
+    blocks = stack_stage_params(stages)  # leaves [pp, K, ...]
+
+    params = {
+        "wte": jax.random.normal(keys[-2], (V, H)) * 0.02,
+        "wpe": jax.random.normal(keys[-1], (S, H)) * 0.02,
+        "lnf_g": jnp.ones((H,), jnp.float32),
+        "lnf_b": jnp.zeros((H,), jnp.float32),
+        "blocks": blocks,
+    }
+
+    # shardings: block leaves pp on dim0; Megatron mp on qkv/fc1 out-dim and
+    # proj/fc2 in-dim (leaf dims are [pp, K, in, out])
+    def block_spec(path_leaf_name):
+        col = {"wqkv", "w1"}
+        row = {"wproj", "w2"}
+        colb = {"bqkv", "b1"}
+        if path_leaf_name in col:
+            return P("pp", None, None, "mp")
+        if path_leaf_name in row:
+            return P("pp", None, "mp", None)
+        if path_leaf_name in colb:
+            return P("pp", None, "mp")
+        return P("pp")
+
+    block_specs = {k: block_spec(k) for k in blocks}
+    # fix replicated-leaf specs rank: ln/bias leaves are [pp, K, H]
+    for k in ("ln1_g", "ln1_b", "ln2_g", "ln2_b", "bproj", "b2"):
+        block_specs[k] = P("pp", None, None)
+    param_specs = {
+        "wte": P(),
+        "wpe": P(),
+        "lnf_g": P(),
+        "lnf_b": P(),
+        "blocks": block_specs,
+    }
+
+    stage_fn_inner = functools.partial(_block_fn, n_heads_local=n_heads_local)
+
+    def stage_fn(stage_params, x):  # leaves [K, ...]
+        def body(h, bp):
+            return stage_fn_inner(bp, h), None
+
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    # microbatch specs inside shard_map: batch dim sharded over dp
+    mb_spec = P(None, "dp", None, None)  # [M, mb, s, H]
+
+    def forward(p, ids):
+        B, s = ids.shape
+        mb = B // n_microbatches
+        x = jnp.take(p["wte"], ids, axis=0) + p["wpe"][None, :s]
+        x = x.reshape(n_microbatches, mb, s, H)
+        y = gpipe(
+            stage_fn, p["blocks"], x, mesh, axis="pp",
+            params_specs=param_specs["blocks"], io_spec=mb_spec,
+        )
+        y = y.reshape(B, s, H)
+        y = _ln(y, p["lnf_g"], p["lnf_b"])
+        return y @ p["wte"].T
+
+    def loss_fn(p, ids, labels):
+        logits = forward(p, ids)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), -1)
+        return -jnp.mean(picked)
+
+    ns = lambda spec: NamedSharding(mesh, spec)
+    pspecs = jax.tree_util.tree_map(lambda s: ns(s), param_specs, is_leaf=lambda s: isinstance(s, P))
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(pspecs, ns(P("dp")), ns(P("dp")), ns(P())),
+        out_shardings=(ns(P()), pspecs),
+        donate_argnums=(0,),
+    )
+    def train_step(p, ids, labels, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        new_p = jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
+        return loss, new_p
+
+    params = jax.device_put(params, pspecs)
+    return params, train_step

@@ -1,0 +1,79 @@
+"""Compiled pipeline parallelism: GPipe schedule over the 'pp' mesh axis.
+
+Reference parity: meta_parallel/pipeline_parallel.py:117
+(forward_backward_pipeline — 1F1B over NCCL p2p with SendRecvMeta handshake)
+in /root/reference.
+
+TPU-native design: the whole schedule is ONE compiled XLA program.
+`shard_map` places each pipeline stage's (stacked) weights on its own 'pp'
+slice; a `lax.scan` runs M + P - 1 ticks, each tick computing the local
+stage on its current micro-activation and handing the result to the next
+stage with `ppermute` over ICI. There is no shape handshake (shapes are
+static) and no schedule code for backward: jax.grad transposes the scan +
+ppermute into the reversed backward pipeline automatically.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ._compat import shard_map
+
+
+def gpipe(stage_fn, stacked_params, microbatches, mesh, axis="pp", params_specs=None, io_spec=None):
+    """Run a GPipe pipeline inside one SPMD program.
+
+    stage_fn(stage_params, x) -> y           (same shape as x)
+    stacked_params: pytree, every leaf stacked on a leading axis of size P
+    microbatches:   [M, mb, ...] array; io_spec gives its sharding over the
+                    non-pp axes (e.g. P(None, 'dp', ...) to dp-shard mb)
+    Returns [M, mb, ...] outputs of the LAST stage.
+    """
+    n_stages = mesh.shape[axis]
+    if io_spec is None:
+        io_spec = P()
+    # n_stages == 1 still goes through shard_map: stage_fn may use mesh
+    # collectives (psum over 'mp'), which need the manual region.
+    M = microbatches.shape[0]
+
+    def per_stage(params_local, mbs):
+        params_here = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        s = jax.lax.axis_index(axis)
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(buf, t):
+            inject = mbs[jnp.clip(t, 0, M - 1)]
+            x = jnp.where(s == 0, inject, buf)
+            y = stage_fn(params_here, x)
+            handed = jax.lax.ppermute(y, axis, perm)
+            return handed, y
+
+        _, ys = jax.lax.scan(tick, jnp.zeros_like(mbs[0]), jnp.arange(M + n_stages - 1))
+        # valid last-stage outputs live at ticks P-1 .. M+P-2
+        out = jax.lax.dynamic_slice_in_dim(ys, n_stages - 1, M, axis=0)
+        return out[None]  # leading pp axis for out_specs
+
+    if params_specs is None:
+        params_specs = jax.tree_util.tree_map(
+            lambda a: P(axis) if hasattr(a, "ndim") else P(), stacked_params
+        )
+    out_spec = P(axis, *tuple(io_spec))
+    fn = shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(params_specs, io_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+    stacked_out = fn(stacked_params, microbatches)  # [P, M, mb, ...]
+    return stacked_out[-1]
+
+
+def stack_stage_params(per_stage_params):
+    """List of per-stage pytrees (same structure) -> stacked pytree."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )

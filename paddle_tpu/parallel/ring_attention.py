@@ -14,12 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-try:
-    from jax import shard_map as _sm
-
-    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
-except Exception:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from ._compat import shard_map
 
 from jax.sharding import PartitionSpec as P
 

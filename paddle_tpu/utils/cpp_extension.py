@@ -1,0 +1,114 @@
+"""JIT C++ extension build/load.
+
+Reference parity: python/paddle/utils/cpp_extension/cpp_extension.py (the
+`load` JIT path) in /root/reference — compile user/framework C++ to a shared
+object at runtime and load it. Pybind11 is not available in this image, so
+extensions use a plain C ABI loaded with ctypes.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sysconfig
+import threading
+
+_CACHE_DIR = os.path.join(
+    os.environ.get("PADDLE_TPU_EXTENSION_DIR", os.path.expanduser("~/.cache/paddle_tpu_extensions"))
+)
+_LOCK = threading.Lock()
+_LOADED = {}
+
+
+def _hash_sources(sources, extra_flags):
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_flags).encode())
+    return h.hexdigest()[:16]
+
+
+def load(name, sources, extra_cxx_flags=None, verbose=False, build_directory=None):
+    """Compile `sources` into lib<name>.so (cached by content hash) and
+    return the ctypes.CDLL handle."""
+    extra = list(extra_cxx_flags or [])
+    key = (name, _hash_sources(sources, extra))
+    with _LOCK:
+        if key in _LOADED:
+            return _LOADED[key]
+        out_dir = build_directory or _CACHE_DIR
+        os.makedirs(out_dir, exist_ok=True)
+        so_path = os.path.join(out_dir, f"lib{name}_{key[1]}.so")
+        if not os.path.exists(so_path):
+            # per-process temp name: concurrent ranks may JIT-build the same
+            # extension; the atomic os.replace publishes whichever wins
+            tmp_path = f"{so_path}.{os.getpid()}.tmp"
+            cmd = (
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+                + extra
+                + list(sources)
+                + ["-o", tmp_path]
+            )
+            if verbose:
+                print("cpp_extension:", " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+            os.replace(tmp_path, so_path)
+        lib = ctypes.CDLL(so_path)
+        _LOADED[key] = lib
+        return lib
+
+
+_REPO_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc")
+
+
+def load_native():
+    """Build + load the framework's native runtime library (csrc/)."""
+    sources = [
+        os.path.join(_REPO_CSRC, "tcp_store.cc"),
+        os.path.join(_REPO_CSRC, "data_feed.cc"),
+    ]
+    lib = load("paddle_tpu_native", sources)
+    _declare(lib)
+    return lib
+
+
+def _declare(lib):
+    c = ctypes
+    lib.ts_server_start.restype = c.c_void_p
+    lib.ts_server_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+    lib.ts_server_stop.argtypes = [c.c_void_p]
+    lib.ts_client_connect.restype = c.c_void_p
+    lib.ts_client_connect.argtypes = [c.c_char_p, c.c_int]
+    lib.ts_client_free.argtypes = [c.c_void_p]
+    lib.ts_client_set_timeout.argtypes = [c.c_void_p, c.c_int]
+    lib.ts_set.restype = c.c_int
+    lib.ts_set.argtypes = [c.c_void_p, c.c_char_p, c.POINTER(c.c_uint8), c.c_uint32]
+    lib.ts_get.restype = c.c_int64
+    lib.ts_get.argtypes = [c.c_void_p, c.c_char_p, c.POINTER(c.c_uint8), c.c_uint32]
+    lib.ts_add.restype = c.c_int64
+    lib.ts_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.ts_check.restype = c.c_int
+    lib.ts_check.argtypes = [c.c_void_p, c.c_char_p]
+    lib.ts_del.restype = c.c_int
+    lib.ts_del.argtypes = [c.c_void_p, c.c_char_p]
+    lib.df_shuffle_indices.argtypes = [c.POINTER(c.c_int64), c.c_int64, c.c_uint64]
+    lib.df_iota.argtypes = [c.POINTER(c.c_int64), c.c_int64]
+    lib.df_queue_new.restype = c.c_void_p
+    lib.df_queue_new.argtypes = [c.c_int64]
+    lib.df_queue_push.restype = c.c_int
+    lib.df_queue_push.argtypes = [c.c_void_p, c.POINTER(c.c_uint8), c.c_int64]
+    lib.df_queue_pop.restype = c.c_int64
+    lib.df_queue_pop.argtypes = [c.c_void_p, c.POINTER(c.c_uint8), c.c_int64]
+    lib.df_queue_size.restype = c.c_int64
+    lib.df_queue_size.argtypes = [c.c_void_p]
+    lib.df_queue_close.argtypes = [c.c_void_p]
+    lib.df_queue_free.argtypes = [c.c_void_p]
+    lib.df_gather_collate.argtypes = [
+        c.POINTER(c.c_uint8), c.POINTER(c.c_uint8), c.POINTER(c.c_int64),
+        c.c_int64, c.c_int64, c.c_int,
+    ]
+    lib.df_u8_to_f32_normalize.argtypes = [
+        c.POINTER(c.c_float), c.POINTER(c.c_uint8), c.c_int64, c.c_float, c.c_float,
+    ]
