@@ -60,19 +60,30 @@ from .ops.common_nn import one_hot  # noqa: F401
 
 # --- subsystems ------------------------------------------------------------
 from . import amp  # noqa: F401
+from . import audio  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
 from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
 from . import framework  # noqa: F401
+from . import geometric  # noqa: F401
+from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import nn  # noqa: F401
+from . import onnx  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
+from . import signal  # noqa: F401
+from . import sparse  # noqa: F401
 from . import static  # noqa: F401
+from . import text  # noqa: F401
 from . import vision  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
 
 from .device import get_device, set_device  # noqa: F401
 from .framework.io import load, save  # noqa: F401

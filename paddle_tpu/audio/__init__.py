@@ -1,0 +1,7 @@
+"""paddle.audio — feature extraction.
+
+Reference parity: python/paddle/audio/ in /root/reference (Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC + window functions).
+"""
+from . import functional  # noqa: F401
+from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram  # noqa: F401

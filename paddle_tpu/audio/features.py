@@ -1,0 +1,67 @@
+"""Audio feature layers (Spectrogram / MelSpectrogram / MFCC)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import signal as S
+from ..nn.layer import Layer
+from ..ops._helpers import T, op
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None, window="hann", power=2.0, center=True, pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = AF.get_window(window, self.win_length)
+
+    def forward(self, x):
+        spec = S.stft(
+            x, self.n_fft, self.hop_length, self.win_length, self.window,
+            self.center, self.pad_mode,
+        )
+        p = self.power
+        return op(lambda a: jnp.abs(a) ** p, T(spec), name="spec_power")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None, window="hann", power=2.0, center=True, pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window, power, center, pad_mode)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # [..., freq, time]
+        fb = self.fbank._array
+
+        return op(lambda a: jnp.einsum("mf,...ft->...mt", fb, a), T(spec), name="mel")
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kw):
+        super().__init__(*args, **kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = super().forward(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None, n_mels=64, f_min=50.0, f_max=None, **kw):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, n_mels=n_mels, f_min=f_min, f_max=f_max)
+        self.dct = AF.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        lm = self.logmel(x)  # [..., mel, time]
+        d = self.dct._array
+
+        return op(lambda a: jnp.einsum("mk,...mt->...kt", d, a), T(lm), name="mfcc")

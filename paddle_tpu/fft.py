@@ -1,0 +1,90 @@
+"""paddle.fft — spectral API.
+
+Reference parity: python/paddle/fft.py in /root/reference (cuFFT-backed
+there; XLA FFT here).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._helpers import T, op
+
+
+def _norm(norm):
+    return None if norm in (None, "backward") else norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return op(lambda a: jnp.fft.fft(a, n, axis, _norm(norm)), T(x), name="fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return op(lambda a: jnp.fft.ifft(a, n, axis, _norm(norm)), T(x), name="ifft")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return op(lambda a: jnp.fft.fft2(a, s, axes, _norm(norm)), T(x), name="fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return op(lambda a: jnp.fft.ifft2(a, s, axes, _norm(norm)), T(x), name="ifft2")
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return op(lambda a: jnp.fft.fftn(a, s, axes, _norm(norm)), T(x), name="fftn")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return op(lambda a: jnp.fft.ifftn(a, s, axes, _norm(norm)), T(x), name="ifftn")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return op(lambda a: jnp.fft.rfft(a, n, axis, _norm(norm)), T(x), name="rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return op(lambda a: jnp.fft.irfft(a, n, axis, _norm(norm)), T(x), name="irfft")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return op(lambda a: jnp.fft.rfft2(a, s, axes, _norm(norm)), T(x), name="rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return op(lambda a: jnp.fft.irfft2(a, s, axes, _norm(norm)), T(x), name="irfft2")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return op(lambda a: jnp.fft.rfftn(a, s, axes, _norm(norm)), T(x), name="rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return op(lambda a: jnp.fft.irfftn(a, s, axes, _norm(norm)), T(x), name="irfftn")
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return op(lambda a: jnp.fft.hfft(a, n, axis, _norm(norm)), T(x), name="hfft")
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return op(lambda a: jnp.fft.ihfft(a, n, axis, _norm(norm)), T(x), name="ihfft")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor._from_op(jnp.fft.fftfreq(int(n), d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor._from_op(jnp.fft.rfftfreq(int(n), d))
+
+
+def fftshift(x, axes=None, name=None):
+    return op(lambda a: jnp.fft.fftshift(a, axes), T(x), name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return op(lambda a: jnp.fft.ifftshift(a, axes), T(x), name="ifftshift")

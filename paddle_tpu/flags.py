@@ -1,0 +1,69 @@
+"""Global flags registry.
+
+Reference parity: the 90 PADDLE_DEFINE_EXPORTED_* flags in
+paddle/phi/core/flags.cc + python get_flags/set_flags in /root/reference.
+Flags are env-overridable (FLAGS_x=...) process-level knobs.
+"""
+from __future__ import annotations
+
+import os
+
+_DEFS = {
+    # name: (default, doc)
+    "FLAGS_check_nan_inf": (False, "insert isfinite guards on compiled-step outputs"),
+    "FLAGS_benchmark": (False, "synchronize after each eager op (timing mode)"),
+    "FLAGS_eager_delete_tensor_gb": (0.0, "no-op on TPU (XLA owns buffers)"),
+    "FLAGS_use_pallas_attention": (True, "route attention through the Pallas flash kernel"),
+    "FLAGS_pallas_block_q": (128, "flash attention q tile"),
+    "FLAGS_pallas_block_k": (128, "flash attention k tile"),
+    "FLAGS_log_compiles": (False, "log XLA compilations"),
+    "FLAGS_allocator_strategy": ("auto_growth", "accepted for parity; PjRt allocates"),
+    "FLAGS_fraction_of_gpu_memory_to_use": (0.92, "accepted for parity"),
+    "FLAGS_cudnn_deterministic": (False, "XLA is deterministic per compile"),
+    "FLAGS_embedding_deterministic": (False, "accepted for parity"),
+    "FLAGS_max_inplace_grad_add": (0, "accepted for parity"),
+    "FLAGS_retain_grad_for_all_tensor": (False, "retain .grad on non-leaf tensors"),
+    "FLAGS_set_to_1d": (True, "0-D squeeze compat flag"),
+}
+
+_VALUES = {}
+
+
+def _coerce(default, raw):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    return type(default)(raw)
+
+
+def get_flags(flags):
+    single = isinstance(flags, str)
+    names = [flags] if single else list(flags)
+    out = {}
+    for n in names:
+        if n not in _DEFS:
+            raise ValueError(f"unknown flag {n}")
+        default, _ = _DEFS[n]
+        if n in _VALUES:
+            out[n] = _VALUES[n]
+        elif n in os.environ:
+            out[n] = _coerce(default, os.environ[n])
+        else:
+            out[n] = default
+    return out
+
+
+def set_flags(flags: dict):
+    for n, v in flags.items():
+        if n not in _DEFS:
+            raise ValueError(f"unknown flag {n}")
+        default, _ = _DEFS[n]
+        _VALUES[n] = type(default)(v) if not isinstance(default, bool) else bool(v)
+    # apply side effects
+    if flags.get("FLAGS_log_compiles") is not None:
+        import jax
+
+        jax.config.update("jax_log_compiles", bool(flags["FLAGS_log_compiles"]))
+
+
+def flag(name):
+    return get_flags(name)[name]

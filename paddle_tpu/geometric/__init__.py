@@ -1,0 +1,113 @@
+"""paddle.geometric — GNN message passing.
+
+Reference parity: python/paddle/geometric/ in /root/reference
+(send_u_recv, send_ue_recv, segment ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..ops._helpers import T
+
+
+def _segment(kind, data, ids, num_segments):
+    if kind == "sum":
+        return jax.ops.segment_sum(data, ids, num_segments=num_segments)
+    if kind == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=num_segments)
+        c = jax.ops.segment_sum(jnp.ones_like(ids, s.dtype), ids, num_segments=num_segments)
+        return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (s.ndim - 1))
+    if kind == "max":
+        return jax.ops.segment_max(data, ids, num_segments=num_segments)
+    if kind == "min":
+        return jax.ops.segment_min(data, ids, num_segments=num_segments)
+    raise ValueError(kind)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    xt = T(x)
+    src = T(src_index)._array
+    dst = T(dst_index)._array
+    n = int(out_size) if out_size is not None else xt.shape[0]
+
+    def f(a):
+        return _segment(reduce_op, a[src], dst, n)
+
+    out, node = autograd.apply(f, xt, name="send_u_recv")
+    return Tensor._from_op(out, node)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum", out_size=None, name=None):
+    xt, yt = T(x), T(y)
+    src = T(src_index)._array
+    dst = T(dst_index)._array
+    n = int(out_size) if out_size is not None else xt.shape[0]
+
+    def f(a, e):
+        msg = a[src]
+        if message_op == "add":
+            msg = msg + e
+        elif message_op == "sub":
+            msg = msg - e
+        elif message_op == "mul":
+            msg = msg * e
+        elif message_op == "div":
+            msg = msg / e
+        return _segment(reduce_op, msg, dst, n)
+
+    out, node = autograd.apply(f, xt, yt, name="send_ue_recv")
+    return Tensor._from_op(out, node)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    xt, yt = T(x), T(y)
+    src = T(src_index)._array
+    dst = T(dst_index)._array
+
+    def f(a, b):
+        mu, mv = a[src], b[dst]
+        if message_op == "add":
+            return mu + mv
+        if message_op == "sub":
+            return mu - mv
+        if message_op == "mul":
+            return mu * mv
+        if message_op == "div":
+            return mu / mv
+        raise ValueError(message_op)
+
+    out, node = autograd.apply(f, xt, yt, name="send_uv")
+    return Tensor._from_op(out, node)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment_op("sum", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_op("mean", data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_op("max", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_op("min", data, segment_ids)
+
+
+def _segment_op(kind, data, segment_ids):
+    import numpy as np
+
+    dt = T(data)
+    ids = T(segment_ids)._array
+    n = int(np.asarray(ids).max()) + 1 if ids.size else 0
+
+    def f(a):
+        return _segment(kind, a, ids, n)
+
+    out, node = autograd.apply(f, dt, name=f"segment_{kind}")
+    return Tensor._from_op(out, node)

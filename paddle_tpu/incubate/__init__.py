@@ -1,0 +1,9 @@
+"""paddle.incubate — fused layers, extra optimizers, autotune, autograd prims.
+
+Reference parity: python/paddle/incubate/ in /root/reference (SURVEY.md §2.3).
+"""
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .autotune import set_config  # noqa: F401
+from .operators import graph_send_recv, softmax_mask_fuse, softmax_mask_fuse_upper_triangle  # noqa: F401

@@ -1,0 +1,39 @@
+"""incubate.autograd — forward-mode AD + primitive decomposition.
+
+Reference parity: python/paddle/incubate/autograd/ (primapi.py forward_grad,
+primx.py) in /root/reference. In the reference this is a whole op-level
+primitive system; in a JAX-backed framework forward-mode IS the runtime
+(jax.jvp), so the API maps directly.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...autograd.functional import jvp as _jvp, vjp as _vjp  # noqa: F401
+from ...core.tensor import Tensor
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    raise NotImplementedError(
+        "static prim system is trace-native here: use paddle_tpu.autograd.jvp"
+    )
+
+
+def jvp(func, xs, v=None):
+    return _jvp(func, xs, v)
+
+
+def vjp(func, xs, v=None):
+    return _vjp(func, xs, v)
+
+
+def enable_prim():
+    pass  # decomposition to primitives is XLA's job — always on
+
+
+def disable_prim():
+    pass
+
+
+def prim_enabled():
+    return True

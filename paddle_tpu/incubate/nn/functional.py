@@ -1,0 +1,31 @@
+"""incubate.nn.functional fused ops (fused_matmul_bias etc.)."""
+from __future__ import annotations
+
+from ...ops.common_nn import linear as _linear
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False, name=None):
+    from ...ops.linalg import matmul
+
+    out = matmul(x, y, transpose_x, transpose_y)
+    if bias is not None:
+        from ...ops.math import add
+
+        out = add(out, bias)
+    return out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        from ...ops.manipulation import t
+
+        weight = t(weight)
+    return _linear(x, weight, bias)
+
+
+def fused_multi_head_attention(*args, **kwargs):
+    raise NotImplementedError("use incubate.nn.FusedMultiHeadAttention layer")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias, act_type="gelu"):
+    raise NotImplementedError("use incubate.nn.FusedEcMoe layer")

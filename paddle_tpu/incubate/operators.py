@@ -1,0 +1,39 @@
+"""incubate.operators — fused/graph ops.
+
+Reference parity: python/paddle/incubate/operators/ (softmax_mask_fuse.py,
+graph_send_recv.py) in /root/reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import T, op
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    mt = T(mask)
+
+    def f(a):
+        return jax.nn.softmax(a + mt._array.astype(a.dtype), axis=-1)
+
+    return op(f, T(x), name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    def f(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+
+    return op(f, T(x), name="softmax_mask_fuse_upper_triangle")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None, name=None):
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, pool_type, out_size)
+
+
+def graph_khop_sampler(*args, **kwargs):
+    raise NotImplementedError("graph sampling: host-side; planned")

@@ -1,0 +1,78 @@
+"""incubate optimizers: LookAhead, ModelAverage.
+
+Reference parity: python/paddle/incubate/optimizer/ in /root/reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._lk_step = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._lk_step += 1
+        if self._lk_step % self.k == 0:
+            for p in self.inner_optimizer._params:
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    slow = jnp.copy(p._array)
+                slow = slow + self.alpha * (p._array - slow)
+                # keep our own buffer: the inner optimizer's jitted update
+                # donates p._array, so the stored slow state must not alias it
+                self._slow[id(p)] = slow
+                p._array = jnp.copy(slow)
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage(Optimizer):
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000, max_average_window=10000, name=None):
+        super().__init__(0.0, parameters)
+        self.rate = average_window_rate
+        self._sums = {}
+        self._counts = {}
+
+    def step(self):
+        for p in self._params:
+            s = self._sums.get(id(p))
+            self._sums[id(p)] = p._array if s is None else s + p._array
+            self._counts[id(p)] = self._counts.get(id(p), 0) + 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            saved = {id(p): p._array for p in self._params}
+            for p in self._params:
+                if id(p) in self._sums:
+                    p._array = self._sums[id(p)] / self._counts[id(p)]
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for p in self._params:
+                        p._array = saved[id(p)]
+
+        return ctx()
+
+    def restore(self, executor=None):
+        pass

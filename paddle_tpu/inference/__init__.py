@@ -1,0 +1,173 @@
+"""Inference predictor.
+
+Reference parity: the AnalysisPredictor stack
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:95 with
+Config + CreatePredictor + zero-copy IO, SURVEY.md §2.4).
+
+TPU-native design (SURVEY.md §7 step 9): a predictor is a saved state_dict +
+model factory, AOT-compiled per input-shape bucket (the dynamic-shape answer:
+bucketing + padding instead of TRT dynamic profiles). The IR-optimization
+pass pipeline of the reference collapses into XLA.
+"""
+from __future__ import annotations
+
+import bisect
+
+import jax
+import numpy as np
+
+from ..core import rng
+from ..core.functional import functional_call, state_dict_arrays
+from ..core.tensor import Tensor
+
+
+class Config:
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path or (model_path + ".pdparams" if model_path else None)
+        self._model_factory = None
+        self._buckets = []  # allowed batch sizes, ascending
+        self._pad_value = 0.0
+        self.use_tpu = True
+
+    # TPU predictor extensions ------------------------------------------------
+    def set_model_factory(self, factory):
+        """factory() -> nn.Layer with architecture matching the checkpoint."""
+        self._model_factory = factory
+
+    def set_batch_buckets(self, buckets):
+        self._buckets = sorted(int(b) for b in buckets)
+
+    # reference-API no-ops (the compiler owns these decisions) ---------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, enable=True):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # subgraph engines are replaced by whole-program XLA
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class PredictorTensor:
+    """Zero-copy-style IO handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._data = None
+
+    def copy_from_cpu(self, arr):
+        self._data = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._data)
+
+    def reshape(self, shape):
+        pass
+
+    def shape(self):
+        return list(self._data.shape) if self._data is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        if config._model_factory is None:
+            raise ValueError(
+                "Config.set_model_factory(...) is required: TPU inference "
+                "re-traces the model and AOT-compiles it (no ProgramDesc)"
+            )
+        self.config = config
+        self.model = config._model_factory()
+        if config.params_path:
+            from ..framework.io import load
+
+            self.model.set_state_dict(load(config.params_path))
+        self.model.eval()
+        self._params, self._buffers = state_dict_arrays(self.model)
+        self._compiled = {}
+        self._inputs = {}
+        self._outputs = {}
+        self._input_names = ["input"]
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs.setdefault(name, PredictorTensor(name))
+
+    def get_output_names(self):
+        return list(self._outputs.keys()) or ["output"]
+
+    def get_output_handle(self, name):
+        return self._outputs.setdefault(name, PredictorTensor(name))
+
+    def _bucket_pad(self, arr):
+        if not self.config._buckets:
+            return arr, arr.shape[0]
+        n = arr.shape[0]
+        i = bisect.bisect_left(self.config._buckets, n)
+        if i == len(self.config._buckets):
+            target = self.config._buckets[-1]
+            if n > target:
+                raise ValueError(f"batch {n} exceeds largest bucket {target}")
+        else:
+            target = self.config._buckets[i]
+        if target != n:
+            pad = np.zeros((target - n,) + arr.shape[1:], arr.dtype)
+            arr = np.concatenate([arr, pad])
+        return arr, n
+
+    def _get_compiled(self, shapes_key, n_inputs):
+        if shapes_key not in self._compiled:
+            model = self.model
+            buffers = self._buffers
+
+            @jax.jit
+            def fwd(params, key, *arrays):
+                out, _ = functional_call(
+                    model, params, buffers, args=arrays, rng_key=key, training=False
+                )
+                return out
+
+            self._compiled[shapes_key] = fwd
+        return self._compiled[shapes_key]
+
+    def run(self, inputs=None):
+        """inputs: optional list of numpy arrays (else uses input handles)."""
+        if inputs is None:
+            inputs = [self._inputs[n]._data for n in self._input_names if n in self._inputs]
+        arrays = []
+        real_n = None
+        for a in inputs:
+            a = np.asarray(a)
+            if a.dtype == np.float64:
+                a = a.astype(np.float32)
+            padded, n = self._bucket_pad(a)
+            real_n = n if real_n is None else real_n
+            arrays.append(padded)
+        key = tuple((a.shape, str(a.dtype)) for a in arrays)
+        fwd = self._get_compiled(key, len(arrays))
+        out = fwd(self._params, rng.next_key(), *[np.asarray(a) for a in arrays])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results = []
+        for i, o in enumerate(outs):
+            o = np.asarray(o)
+            if real_n is not None and o.shape and o.shape[0] >= real_n:
+                o = o[:real_n]
+            results.append(o)
+            name = f"output_{i}" if i else "output"
+            self.get_output_handle(name)._data = o
+        return results
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

