@@ -1,0 +1,30 @@
+"""Force an n-device CPU host platform for multi-chip testing without TPUs.
+
+Shared by __graft_entry__.py and tests/conftest.py. The env var alone is not
+enough because the axon TPU plugin's sitecustomize sets jax_platforms
+programmatically, so jax.config must be flipped too — before any jax backend
+initialization (SURVEY.md §4 fake-backend strategy; XLA's host platform is
+the equivalent of reference phi/backends/custom/fake_cpu_device.h).
+"""
+import os
+import re
+
+
+def force_host_cpu_devices(n_devices: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={n_devices}"
+    if "--xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", want, flags)
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu" or len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"needed {n_devices} CPU devices but the backend is already up "
+            f"({jax.default_backend()}, {len(jax.devices())} devices); "
+            "call force_host_cpu_devices before any jax use"
+        )

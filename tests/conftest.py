@@ -2,18 +2,14 @@
 run deterministically without TPU hardware (SURVEY.md §4 fake-backend testing
 strategy — XLA's host platform is the fake_cpu_device.h equivalent).
 
-Note: the axon TPU plugin's sitecustomize sets jax_platforms programmatically,
-so the env var alone is not enough — we update jax.config before any backend
-initialization. Set PADDLE_TPU_TEST_ON_TPU=1 to run the suite on the real
-chip instead.
+Set PADDLE_TPU_TEST_ON_TPU=1 to run the suite on the real chip instead.
 """
 import os
+import sys
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if not os.environ.get("PADDLE_TPU_TEST_ON_TPU"):
-    import jax
+    from _cpu_mesh import force_host_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
+    force_host_cpu_devices(8)
