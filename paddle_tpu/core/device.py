@@ -18,14 +18,17 @@ _current_device = None  # lazily resolved jax.Device
 
 @functools.lru_cache(maxsize=None)
 def _platform_devices(platform: str):
+    """Process-local devices only: under multi-controller JAX, jax.devices()
+    lists every process's devices, but tensors can only be created on
+    addressable ones."""
     try:
-        return tuple(jax.devices(platform))
+        return tuple(jax.local_devices(backend=platform))
     except RuntimeError:
         return ()
 
 
 def _default_device():
-    return jax.devices()[0]
+    return jax.local_devices()[0]
 
 
 def set_device(device: str):
@@ -39,7 +42,7 @@ def set_device(device: str):
     name = name.lower()
     if name in ("tpu", "gpu", "xpu", "npu", "mlu", "ipu", "custom_dev", "axon"):
         # Any accelerator alias maps to the default (accelerator) backend.
-        devs = jax.devices()
+        devs = jax.local_devices()
         if devs[0].platform == "cpu" and name == "tpu":
             # No TPU attached; fall back to CPU silently (tests / CI).
             devs = _platform_devices("cpu")

@@ -142,7 +142,11 @@ class Tensor:
         for a in list(args) + list(kwargs.values()):
             if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu"):
                 plat = "cpu" if a.startswith("cpu") else None
-                devs = jax.devices("cpu") if plat == "cpu" else jax.devices()
+                devs = (
+                    jax.local_devices(backend="cpu")
+                    if plat == "cpu"
+                    else jax.local_devices()
+                )
                 t = Tensor._from_op(jax.device_put(t._array, devs[0]), t._node, t._out_index)
                 t.stop_gradient = self.stop_gradient
             else:

@@ -2,28 +2,35 @@
 
 Reference parity: python/paddle/distributed/communication/ in /root/reference
 (all_reduce.py, all_gather.py, all_to_all.py, reduce_scatter.py, broadcast.py,
-scatter.py, send/recv, group.py; collective.py new_group:185).
+scatter.py, send/recv, group.py; collective.py new_group:185) and the
+ProcessGroup contract (paddle/fluid/distributed/collective/process_group.h:53).
 
-TPU-native design (SURVEY.md §5): a collective is a tiny compiled XLA
-computation over a mesh axis (shard_map + psum/all_gather/...), cached per
-(op, shape, dtype, axis). For fully-replicated inputs on a 1-sized axis these
-degrade to identities — matching single-rank semantics of the reference. The
-ProcessGroup object is an AxisGroup (a named mesh axis), not an NCCL
-communicator; there is no uniqueId bootstrap — topology comes from the
-runtime.
+TPU-native design (SURVEY.md §5): a rank is a *process* (multi-controller
+JAX). A collective stacks each rank's local value into one global jax.Array
+sharded over a single-axis "rank" mesh (one device per process,
+jax.make_array_from_process_local_data), runs one jitted computation whose
+output is fully replicated — XLA lowers the cross-device reduce/gather to
+real ICI/DCN collectives — and slices the per-rank result on host. Every
+process compiles the *same* program (a multi-controller requirement), so
+per-rank selection happens host-side, never in traced code.
+
+With one process the group has one rank and collectives are identities —
+exactly the reference's single-rank semantics. The SPMD primitives at the
+bottom (psum/ppermute/...) remain the compiled-path collectives used inside
+shard_map'ped programs.
 """
 from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from .mesh import AxisGroup, get_mesh
-
-from ..parallel._compat import shard_map
 
 
 class ReduceOp:
@@ -34,149 +41,497 @@ class ReduceOp:
     AVG = "avg"
 
 
-_GROUPS = {}
+_REDUCERS = {
+    ReduceOp.SUM: lambda x: jnp.sum(x, axis=0),
+    ReduceOp.MAX: lambda x: jnp.max(x, axis=0),
+    ReduceOp.MIN: lambda x: jnp.min(x, axis=0),
+    ReduceOp.PROD: lambda x: jnp.prod(x, axis=0),
+    ReduceOp.AVG: lambda x: jnp.mean(x, axis=0),
+}
 
 
-def _default_group():
-    mesh = get_mesh()
-    if mesh is None:
-        from .mesh import init_mesh
+class ProcessGroup:
+    """A clique of processes (reference Group, communication/group.py).
 
-        mesh = init_mesh({"dp": len(jax.devices())})
-    # collapse all axes into a flattened view: default group = whole mesh;
-    # use the first axis with size>1, else "dp"
-    for a in mesh.axis_names:
-        if mesh.shape[a] > 1:
-            return AxisGroup(mesh, a)
-    return AxisGroup(mesh, "dp")
+    `ranks` are global process indices. Each rank is represented on the mesh
+    by its first local device; the single mesh axis is "rank".
+    """
+
+    def __init__(self, ranks, gid):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.nranks = len(self.ranks)
+        me = jax.process_index()
+        self.rank = self.ranks.index(me) if me in self.ranks else -1
+        by_proc = {}
+        for d in jax.devices():
+            cur = by_proc.get(d.process_index)
+            if cur is None or d.id < cur.id:
+                by_proc[d.process_index] = d
+        missing = [r for r in self.ranks if r not in by_proc]
+        if missing:
+            raise ValueError(f"group ranks {missing} have no devices")
+        self._devices = [by_proc[r] for r in self.ranks]
+        self.mesh = Mesh(np.asarray(self._devices), ("rank",))
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def is_member(self):
+        return self.rank >= 0
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"ProcessGroup(id={self.id}, ranks={self.ranks})"
 
 
-def new_group(ranks=None, backend=None, timeout=None):
-    """Returns the axis group covering the default mesh (rank subsets map to
-    mesh axes in this SPMD design; arbitrary subsets are future work)."""
-    return _default_group()
+_GROUPS: dict[int, ProcessGroup] = {}
+_NEXT_GID = 1
 
 
-def get_group(gid=0):
-    return _default_group()
+def _default_group() -> ProcessGroup:
+    g = _GROUPS.get(0)
+    if g is None or g.nranks != jax.process_count():
+        # (re)build: a default group cached before jax.distributed.initialize
+        # would silently pin world size to 1
+        g = _GROUPS[0] = ProcessGroup(range(jax.process_count()), 0)
+    return g
 
 
-def _group(group):
-    return group if isinstance(group, AxisGroup) else _default_group()
+def new_group(ranks=None, backend=None, timeout=None) -> ProcessGroup:
+    """Reference collective.py new_group:185 — a subgroup over the given
+    global process ranks (all processes when None)."""
+    global _NEXT_GID
+    if ranks is None:
+        ranks = list(range(jax.process_count()))
+    g = ProcessGroup(sorted(int(r) for r in ranks), _NEXT_GID)
+    _GROUPS[_NEXT_GID] = g
+    _NEXT_GID += 1
+    return g
 
 
-def is_initialized():
-    return get_mesh() is not None
+def get_group(gid=0) -> ProcessGroup:
+    if gid == 0:
+        return _default_group()  # staleness-checked rebuild path
+    return _GROUPS.get(gid) or _default_group()
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUPS.clear()
+        _p2p_group.cache_clear()
+        _axis_group_ranks.cache_clear()
+        _interned_group.cache_clear()
+        _self_group.cache_clear()
+    else:
+        _GROUPS.pop(group.id, None)
 
 
 @functools.lru_cache(maxsize=None)
-def _collective_fn(kind, axis, mesh_id, shape, dtype, extra=None):
-    mesh = get_mesh()
+def _axis_group_ranks(mesh_devs_key, shape, axis_names, axis):
+    """Process indices spanning `axis` of the mesh at this process's slot.
 
-    if kind == "all_reduce":
-        def f(x):
-            red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}[extra]
-            return red(x, axis)
-        in_spec, out_spec = P(), P()
-    elif kind == "all_gather":
-        def f(x):
-            return jax.lax.all_gather(x, axis)
-        in_spec, out_spec = P(), P()
+    One process may drive several devices (single-host SPMD) — then the axis
+    subgroup collapses to just this process and eager collectives are
+    identities, which is the correct single-controller semantics."""
+    me = jax.process_index()
+    devices = np.asarray(mesh_devs_key, dtype=object).reshape(shape)
+    ax = axis_names.index(axis)
+    mine = np.argwhere(
+        np.vectorize(lambda d: d.process_index == me)(devices)
+    )
+    if mine.size == 0:
+        return None  # this process has no device in the mesh
+    coord = list(mine[0])
+    sl = [int(c) for c in coord]
+    sl[ax] = slice(None)
+    line = devices[tuple(sl)]
+    return tuple(sorted({d.process_index for d in line.flat}))
+
+
+def _group(group) -> ProcessGroup:
+    if group is None:
+        return _default_group()
+    if isinstance(group, AxisGroup):
+        # mesh-axis group -> the clique of *processes* spanning that axis at
+        # this process's mesh coordinates
+        mesh = group.mesh
+        ranks = _axis_group_ranks(
+            tuple(mesh.devices.flat), mesh.devices.shape, tuple(mesh.axis_names),
+            group.axis,
+        )
+        if ranks is None or len(ranks) == 1:
+            return _self_group()
+        return _interned_group(ranks)
+    return group
+
+
+# Internal groups (axis-derived, p2p, self) get negative ids and stay out of
+# _GROUPS/_NEXT_GID: user-facing gids must stay globally consistent, and
+# new_group is only collectively synchronized when *all* processes call it —
+# which internal lazy construction does not guarantee.
+_NEXT_INTERNAL_GID = -2
+
+
+def _internal_group(ranks) -> ProcessGroup:
+    global _NEXT_INTERNAL_GID
+    g = ProcessGroup(ranks, _NEXT_INTERNAL_GID)
+    _NEXT_INTERNAL_GID -= 1
+    return g
+
+
+@functools.lru_cache(maxsize=None)
+def _interned_group(ranks: tuple) -> ProcessGroup:
+    return _internal_group(list(ranks))
+
+
+@functools.lru_cache(maxsize=None)
+def _self_group() -> ProcessGroup:
+    return ProcessGroup([jax.process_index()], -1)
+
+
+def is_initialized():
+    return get_mesh() is not None or jax.process_count() > 1
+
+
+# ---- stacked-collective computation layer ----------------------------------
+# Pure functions over a rank-major stacked array (n, ...) sharded P("rank").
+# Outputs are fully replicated so every process can read them; programs are
+# rank-independent so all processes compile identical executables.
+
+
+@functools.lru_cache(maxsize=None)
+def _stacked_fn(kind, mesh_devs, op_or_src, shard_rows=False):
+    devices = list(mesh_devs)
+    mesh = Mesh(np.asarray(devices), ("rank",))
+    # shard_rows: leading dim of the result indexes destination rank — keep it
+    # sharded so rank r's row lands only on rank r's device (no n-fold
+    # replication of alltoall/scatter payloads)
+    out = NamedSharding(mesh, P("rank") if shard_rows else P())
+
+    if kind == "reduce":  # all_reduce / reduce / reduce_scatter share this
+        f = _REDUCERS[op_or_src]
+    elif kind == "gather":  # all_gather: materialize replicated stack
+        f = lambda x: x
+    elif kind == "select":  # broadcast / scatter: row src
+        src = int(op_or_src)
+        f = lambda x: x[src]
+    elif kind == "transpose":  # alltoall: out[r] = in[:, r]
+        f = lambda x: jnp.swapaxes(x, 0, 1)
     else:
         raise ValueError(kind)
+    return jax.jit(f, out_shardings=out)
 
-    return jax.jit(
-        shard_map(f, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec, check_vma=False)
+
+def stacked_collective(kind, stacked, group_mesh_devices, op_or_src=None,
+                       shard_rows=False):
+    """Run one collective computation over a rank-major stacked global array.
+
+    Exposed separately from the eager API so the math is unit-testable on a
+    single process with a multi-device CPU mesh (tests/test_collective.py)."""
+    fn = _stacked_fn(kind, tuple(group_mesh_devices), op_or_src, shard_rows)
+    return fn(stacked)
+
+
+def _my_row(arr, g: ProcessGroup):
+    """This rank's row of a P(\"rank\")-sharded (nranks, ...) result."""
+    dev = g._devices[g.rank]
+    for s in arr.addressable_shards:
+        if s.device == dev:
+            return np.asarray(s.data)[0]
+    raise RuntimeError(f"no addressable shard on {dev} for rank {g.rank}")
+
+
+def _member_rank(g: ProcessGroup, global_rank, what):
+    idx = g.get_group_rank(global_rank)
+    if idx < 0:
+        raise ValueError(f"{what} rank {global_rank} is not in group {g.ranks}")
+    return idx
+
+
+def _to_host(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._array)
+    return np.asarray(x)
+
+
+def _stack_local(g: ProcessGroup, local_np):
+    """Each rank contributes its local value as one row of the (nranks, ...)
+    global array sharded over the "rank" axis."""
+    sharding = NamedSharding(g.mesh, P("rank", *([None] * local_np.ndim)))
+    return jax.make_array_from_process_local_data(
+        sharding, local_np[None], (g.nranks,) + local_np.shape
     )
 
 
+def _set_result(tensor, value):
+    if isinstance(tensor, Tensor):
+        tensor.set_value(value)
+        return tensor
+    return jnp.asarray(value)
+
+
+# ---- eager collective API ---------------------------------------------------
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference communication/all_reduce.py — in-place across-rank reduce."""
     g = _group(group)
+    if not g.is_member():
+        return tensor
     if g.nranks == 1:
         return tensor
-    # replicated input: each device holds the same value; psum over the axis
-    # multiplies by axis size for SUM — to match multi-process semantics of
-    # independent per-rank values, sharded arrays are required. For the SPMD
-    # programming model the compiled path handles reduction; eagerly, treat
-    # replicated input as already-reduced.
+    stacked = _stack_local(g, _to_host(tensor))
+    out = stacked_collective("reduce", stacked, g._devices, op)
+    return _set_result(tensor, np.asarray(out))
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference communication/reduce.py — only dst receives the result."""
+    g = _group(group)
+    if not g.is_member() or g.nranks == 1:
+        return tensor
+    dst_idx = _member_rank(g, dst, "dst")
+    stacked = _stack_local(g, _to_host(tensor))
+    out = stacked_collective("reduce", stacked, g._devices, op)
+    if g.rank == dst_idx:
+        return _set_result(tensor, np.asarray(out))
     return tensor
 
 
 def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
+    """Reference communication/all_gather.py — every rank gets every rank's
+    tensor, in rank order."""
     if tensor is None:
         raise ValueError("tensor required")
     g = _group(group)
-    n = g.nranks
-    if isinstance(tensor_list, list):
-        for _ in range(n):
-            tensor_list.append(tensor.clone())
+    if not g.is_member():
         return tensor_list
-    return tensor
+    local = _to_host(tensor)
+    if g.nranks == 1:
+        gathered = local[None]
+    else:
+        stacked = _stack_local(g, local)
+        gathered = np.asarray(stacked_collective("gather", stacked, g._devices))
+    rows = [jnp.asarray(gathered[i]) for i in range(g.nranks)]
+    if isinstance(tensor_list, list):
+        tensor_list.extend(Tensor(r) if isinstance(tensor, Tensor) else r for r in rows)
+        return tensor_list
+    return rows
+
+
+def _encode_size(n: int) -> np.ndarray:
+    """uint64 length as 8 uint8s — survives the trip through jnp (which would
+    silently downcast int64 to int32 without x64 mode)."""
+    return np.frombuffer(np.uint64(n).tobytes(), dtype=np.uint8).copy()
+
+
+def _decode_size(arr) -> int:
+    raw = np.asarray(arr, dtype=np.uint8).tobytes()
+    return int(np.frombuffer(raw, dtype=np.uint64)[0])
 
 
 def all_gather_object(object_list, obj, group=None):
+    """Reference communication/all_gather.py:all_gather_object — pickle the
+    object into a uint8 tensor, all_gather with per-rank length framing."""
+    import pickle
+
     g = _group(group)
-    for _ in range(g.nranks):
+    if not g.is_member():
+        return object_list
+    if g.nranks == 1:
         object_list.append(obj)
+        return object_list
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = []
+    all_gather(sizes, jnp.asarray(_encode_size(payload.size)), group=g)
+    cap = max(_decode_size(s) for s in sizes)
+    padded = np.zeros(cap, dtype=np.uint8)
+    padded[: payload.size] = payload
+    chunks = []
+    all_gather(chunks, jnp.asarray(padded), group=g)
+    for s, c in zip(sizes, chunks):
+        raw = np.asarray(c)[: _decode_size(s)].tobytes()
+        object_list.append(pickle.loads(raw))
     return object_list
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    return tensor
+    """Reference communication/broadcast.py — src's value to every rank."""
+    g = _group(group)
+    if not g.is_member() or g.nranks == 1:
+        return tensor
+    src_idx = _member_rank(g, src, "src")
+    stacked = _stack_local(g, _to_host(tensor))
+    out = stacked_collective("select", stacked, g._devices, src_idx)
+    return _set_result(tensor, np.asarray(out))
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    import pickle
+
+    g = _group(group)
+    if not g.is_member() or g.nranks == 1:
+        return object_list
+    if g.rank == _member_rank(g, src, "src"):
+        payload = np.frombuffer(pickle.dumps(list(object_list)), dtype=np.uint8)
+    else:
+        payload = np.zeros(0, dtype=np.uint8)
+    nt = broadcast(jnp.asarray(_encode_size(payload.size)), src=src, group=g)
+    cap = _decode_size(nt)
+    padded = np.zeros(cap, dtype=np.uint8)
+    padded[: payload.size] = payload[:cap]
+    data = broadcast(jnp.asarray(padded), src=src, group=g)
+    received = pickle.loads(np.asarray(data).tobytes())
+    object_list[:] = received
     return object_list
 
 
-def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return tensor
-
-
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
-    if isinstance(tensor_list, (list, tuple)) and tensor_list:
-        tensor.set_value(tensor_list[0])
-    return tensor
+    """Reference communication/reduce_scatter.py — rank r receives the
+    op-reduction of every rank's tensor_list[r]."""
+    g = _group(group)
+    if not g.is_member():
+        return tensor
+    if g.nranks == 1:
+        return _set_result(tensor, _to_host(tensor_list[0])) if tensor_list else tensor
+    local = np.stack([_to_host(t) for t in tensor_list])  # (nranks, ...)
+    stacked = _stack_local(g, local)  # (nranks, nranks, ...)
+    out = stacked_collective("reduce", stacked, g._devices, op, shard_rows=True)
+    return _set_result(tensor, _my_row(out, g))
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if tensor_list:
-        tensor.set_value(tensor_list[0])
-    return tensor
+    """Reference communication/scatter.py — src's tensor_list[r] to rank r."""
+    g = _group(group)
+    if not g.is_member():
+        return tensor
+    if g.nranks == 1:
+        return _set_result(tensor, _to_host(tensor_list[0])) if tensor_list else tensor
+    src_idx = _member_rank(g, src, "src")
+    recv_buf = _to_host(tensor)
+    shape, dtype = recv_buf.shape, recv_buf.dtype
+    if g.rank == src_idx:
+        local = np.stack([_to_host(t) for t in tensor_list]).astype(dtype)
+    else:
+        local = np.zeros((g.nranks,) + shape, dtype=dtype)
+    stacked = _stack_local(g, local)
+    rows = stacked_collective("select", stacked, g._devices, src_idx, shard_rows=True)
+    return _set_result(tensor, _my_row(rows, g))
 
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
-    for t in in_tensor_list:
-        out_tensor_list.append(t.clone())
+    """Reference communication/all_to_all.py — rank r receives
+    [in_tensor_list[r] from every rank p], in rank order."""
+    g = _group(group)
+    if not g.is_member():
+        return out_tensor_list
+    if g.nranks == 1:
+        out_tensor_list.extend(t.clone() if isinstance(t, Tensor) else t for t in in_tensor_list)
+        return out_tensor_list
+    local = np.stack([_to_host(t) for t in in_tensor_list])  # (nranks, ...)
+    stacked = _stack_local(g, local)  # (nranks_src, nranks_dst, ...)
+    swapped = stacked_collective("transpose", stacked, g._devices, shard_rows=True)
+    mine = _my_row(swapped, g)  # (nranks, ...) — only my row crosses the wire
+    sample = in_tensor_list[0]
+    for i in range(g.nranks):
+        row = mine[i]
+        out_tensor_list.append(Tensor(row) if isinstance(sample, Tensor) else jnp.asarray(row))
     return out_tensor_list
 
 
 all_to_all = alltoall
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager cross-process send/recv requires multi-process runtime; "
-        "pipeline transport uses compiled ppermute (meta_parallel)"
+@functools.lru_cache(maxsize=None)
+def _p2p_group(a, b):
+    return _internal_group([min(a, b), max(a, b)])
+
+
+_P2P_INBOX: dict[int, list] = {}  # peer process index -> FIFO of received arrays
+
+
+def _pair_exchange(peer, local_np, is_send):
+    """One order-matched exchange on the (me, peer) pair.
+
+    Every send/recv call on a pair enters the SAME 2-rank gather program (a
+    multi-controller requirement: both processes must run identical
+    executables), carrying (send-flag, payload) both ways. A peer's flagged
+    payload is queued in a per-pair FIFO inbox, so MPI-style matching holds:
+    the n-th send on one side reaches the n-th recv on the other, including
+    the both-sides-send-first pattern. Ordering across *different* pairs is
+    the caller's job (classic blocking-ring hazard: stagger even/odd, or use
+    the compiled path's lax.ppermute — the performant TPU route anyway)."""
+    me = jax.process_index()
+    g = _p2p_group(me, peer)
+    flag = np.asarray([1.0 if is_send else 0.0], dtype=np.float32)
+    flags = np.asarray(
+        stacked_collective("gather", _stack_local(g, flag), g._devices)
     )
+    payloads = np.asarray(
+        stacked_collective("gather", _stack_local(g, local_np), g._devices)
+    )
+    pidx = g.get_group_rank(peer)
+    if flags[pidx][0] > 0.5:
+        _P2P_INBOX.setdefault(peer, []).append(payloads[pidx])
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Reference communication/send.py — blocking; the peer must eventually
+    call the matching recv on this pair."""
+    me = jax.process_index()
+    if me == dst:
+        raise ValueError("cannot send to self")
+    _pair_exchange(dst, _to_host(tensor), True)
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager cross-process send/recv requires multi-process runtime; "
-        "pipeline transport uses compiled ppermute (meta_parallel)"
-    )
+    me = jax.process_index()
+    if me == src:
+        raise ValueError("cannot recv from self")
+    inbox = _P2P_INBOX.setdefault(src, [])
+    while not inbox:
+        _pair_exchange(src, _to_host(tensor), False)
+    return _set_result(tensor, inbox.pop(0))
+
+
+class _CompletedTask:
+    """Waitable handle (reference ProcessGroup task contract). The underlying
+    exchange is blocking, so by construction the work is done."""
+
+    def __init__(self, tensor):
+        self._tensor = tensor
+
+    def wait(self):
+        wait(self._tensor)
+        return True
+
+    def is_completed(self):
+        return True
 
 
 def isend(tensor, dst=0, group=None):
-    return send(tensor, dst, group)
+    return _CompletedTask(send(tensor, dst, group))
 
 
 def irecv(tensor, src=0, group=None):
-    return recv(tensor, src, group)
+    return _CompletedTask(recv(tensor, src, group))
 
 
 def barrier(group=None):
+    """All ranks synchronize: a 1-element all_reduce everyone must enter."""
+    g = _group(group)
+    if g.is_member() and g.nranks > 1:
+        stacked = _stack_local(g, np.ones(1, dtype=np.float32))
+        np.asarray(stacked_collective("reduce", stacked, g._devices, ReduceOp.SUM))
     from ..core.device import synchronize
 
     synchronize()

@@ -77,7 +77,7 @@ def init_parallel_env():
     coord = os.getenv("PADDLE_MASTER") or os.getenv("MASTER_ADDR")
     nprocs = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.getenv("PADDLE_TRAINER_ID", "0"))
-    if coord and nprocs > 1:
+    if coord and nprocs > 1 and not jax.distributed.is_initialized():
         port = os.getenv("MASTER_PORT", "8476")
         addr = coord if ":" in coord else f"{coord}:{port}"
         jax.distributed.initialize(

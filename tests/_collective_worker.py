@@ -1,0 +1,127 @@
+"""Worker for the 2-process eager-collective test (run via subprocess).
+
+Mirrors the reference's per-rank test program pattern
+(test_collective_api_base.py: each rank runs the collective then the parent
+verifies) but verification happens in-rank against numpy and the parent only
+checks exit codes + OK markers.
+
+Usage: python _collective_worker.py <rank> <nranks> <port>
+"""
+import os
+import sys
+
+RANK = int(sys.argv[1])
+NRANKS = int(sys.argv[2])
+PORT = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["MASTER_ADDR"] = "127.0.0.1"
+os.environ["MASTER_PORT"] = PORT
+os.environ["PADDLE_TRAINERS_NUM"] = str(NRANKS)
+os.environ["PADDLE_TRAINER_ID"] = str(RANK)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# must run before anything touches the XLA backend (paddle_tpu import does)
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{PORT}", num_processes=NRANKS, process_id=RANK
+)
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+assert env.rank == RANK and env.world_size == NRANKS, (env.rank, env.world_size)
+assert jax.process_count() == NRANKS
+
+ranks = list(range(NRANKS))
+
+
+def rank_val(r, base=0):
+    return np.arange(4, dtype=np.float32) + 10.0 * r + base
+
+
+# all_reduce (sum / max / prod) on a paddle Tensor, in place
+t = paddle.to_tensor(rank_val(RANK))
+dist.all_reduce(t)
+np.testing.assert_allclose(t.numpy(), sum(rank_val(r) for r in ranks))
+
+t = paddle.to_tensor(rank_val(RANK))
+dist.all_reduce(t, op=dist.ReduceOp.MAX)
+np.testing.assert_allclose(t.numpy(), rank_val(NRANKS - 1))
+
+# all_gather in rank order
+gathered = []
+dist.all_gather(gathered, paddle.to_tensor(rank_val(RANK)))
+assert len(gathered) == NRANKS
+for r in ranks:
+    np.testing.assert_allclose(gathered[r].numpy(), rank_val(r))
+
+# broadcast from src=1
+t = paddle.to_tensor(rank_val(RANK))
+dist.broadcast(t, src=1)
+np.testing.assert_allclose(t.numpy(), rank_val(1))
+
+# reduce to dst=1: only dst holds the sum
+t = paddle.to_tensor(rank_val(RANK))
+dist.reduce(t, dst=1)
+expect = sum(rank_val(r) for r in ranks) if RANK == 1 else rank_val(RANK)
+np.testing.assert_allclose(t.numpy(), expect)
+
+# reduce_scatter: rank r gets sum_p in_list[p][r]
+in_list = [paddle.to_tensor(rank_val(RANK, base=100.0 * j)) for j in range(NRANKS)]
+out = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+dist.reduce_scatter(out, in_list)
+np.testing.assert_allclose(out.numpy(), sum(rank_val(r, base=100.0 * RANK) for r in ranks))
+
+# scatter from src=0
+src_list = [paddle.to_tensor(rank_val(j, base=7.0)) for j in range(NRANKS)]
+out = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+dist.scatter(out, src_list if RANK == 0 else None, src=0)
+np.testing.assert_allclose(out.numpy(), rank_val(RANK, base=7.0))
+
+# alltoall: rank r receives in_list[r] from each rank p, in p order
+in_list = [paddle.to_tensor(rank_val(RANK, base=1000.0 * j)) for j in range(NRANKS)]
+out_list = []
+dist.alltoall(in_list, out_list)
+for p in ranks:
+    np.testing.assert_allclose(out_list[p].numpy(), rank_val(p, base=1000.0 * RANK))
+
+# send / recv pair (blocking, both sides call)
+if NRANKS >= 2:
+    if RANK == 0:
+        dist.send(paddle.to_tensor(rank_val(0, base=5.0)), dst=1)
+    elif RANK == 1:
+        buf = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+        dist.recv(buf, src=0)
+        np.testing.assert_allclose(buf.numpy(), rank_val(0, base=5.0))
+
+# symmetric exchange: both ranks send first, then recv — must not deadlock
+if NRANKS == 2:
+    peer = 1 - RANK
+    dist.send(paddle.to_tensor(rank_val(RANK, base=9.0)), dst=peer)
+    buf = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+    dist.recv(buf, src=peer)
+    np.testing.assert_allclose(buf.numpy(), rank_val(peer, base=9.0))
+
+# subgroup: new_group([0]) — rank 1 is not a member, collective is a no-op
+g0 = dist.new_group([0])
+t = paddle.to_tensor(rank_val(RANK))
+dist.all_reduce(t, group=g0)
+np.testing.assert_allclose(t.numpy(), rank_val(RANK))  # 1-rank / non-member
+
+# object collectives
+objs = []
+dist.all_gather_object(objs, {"rank": RANK, "payload": [RANK] * (RANK + 1)})
+assert objs == [{"rank": r, "payload": [r] * (r + 1)} for r in ranks], objs
+
+olist = [{"from": RANK}] if RANK == 0 else [None]
+dist.broadcast_object_list(olist, src=0)
+assert olist == [{"from": 0}], olist
+
+dist.barrier()
+print(f"COLLECTIVE_OK rank={RANK}", flush=True)

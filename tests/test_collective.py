@@ -1,0 +1,154 @@
+"""Eager collective semantics.
+
+Two layers (VERDICT round-1 item 2):
+- single-process unit tests of the stacked-collective math on the forced
+  8-device CPU mesh (each row of the stacked array simulates one rank);
+- a real 2-process test via subprocess + jax.distributed (Gloo), mirroring
+  the reference's test_collective_api_base.py Popen pattern.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.collective import ReduceOp, stacked_collective
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _rank_mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("rank",))
+
+
+def _stacked(vals):
+    """Simulate n ranks' local values as one rank-sharded stacked array."""
+    arr = np.stack(vals)
+    mesh = _rank_mesh(arr.shape[0])
+    spec = P("rank", *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec)), list(mesh.devices.flat)
+
+
+class TestStackedCollectiveMath:
+    def setup_method(self):
+        self.vals = [np.arange(6, dtype=np.float32).reshape(2, 3) + 10 * r for r in range(4)]
+
+    def test_all_reduce_ops(self):
+        stacked, devs = _stacked(self.vals)
+        for op, ref in [
+            (ReduceOp.SUM, sum(self.vals)),
+            (ReduceOp.MAX, np.max(self.vals, axis=0)),
+            (ReduceOp.MIN, np.min(self.vals, axis=0)),
+            (ReduceOp.PROD, np.prod(np.stack(self.vals), axis=0)),
+            (ReduceOp.AVG, np.mean(self.vals, axis=0)),
+        ]:
+            out = stacked_collective("reduce", stacked, devs, op)
+            np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+            assert out.sharding.is_fully_replicated
+
+    def test_all_gather_replicates_stack(self):
+        stacked, devs = _stacked(self.vals)
+        out = stacked_collective("gather", stacked, devs)
+        np.testing.assert_allclose(np.asarray(out), np.stack(self.vals))
+        assert out.sharding.is_fully_replicated
+
+    def test_broadcast_selects_src_row(self):
+        stacked, devs = _stacked(self.vals)
+        out = stacked_collective("select", stacked, devs, 2)
+        np.testing.assert_allclose(np.asarray(out), self.vals[2])
+
+    def test_alltoall_transposes(self):
+        # rank-major matrix of per-destination payloads
+        mat = [np.stack([v + 100 * d for d, v in enumerate(self.vals)]) + 1000 * r
+               for r in range(4)]
+        stacked, devs = _stacked(mat)
+        out = np.asarray(stacked_collective("transpose", stacked, devs))
+        for r in range(4):
+            for p in range(4):
+                np.testing.assert_allclose(out[r, p], mat[p][r])
+
+    def test_shard_rows_keeps_rows_on_rank_devices(self):
+        # reduce_scatter-shaped input: (nranks, nranks, payload)
+        vals = [np.arange(12, dtype=np.float32).reshape(4, 3) + 10 * r for r in range(4)]
+        stacked, devs = _stacked(vals)
+        out = stacked_collective("reduce", stacked, devs, ReduceOp.SUM, shard_rows=True)
+        assert not out.sharding.is_fully_replicated
+        full = sum(vals)
+        for shard in out.addressable_shards:
+            r = devs.index(shard.device)
+            np.testing.assert_allclose(np.asarray(shard.data)[0], full[r], rtol=1e-6)
+
+    def test_compiled_program_contains_collective(self):
+        stacked, devs = _stacked(self.vals)
+        mesh = _rank_mesh(4)
+        lowered = jax.jit(
+            lambda x: jnp.sum(x, axis=0), out_shardings=NamedSharding(mesh, P())
+        ).lower(stacked)
+        hlo = lowered.compile().as_text()
+        assert "all-reduce" in hlo or "all-gather" in hlo, hlo[:500]
+
+
+class TestSingleProcessSemantics:
+    def test_single_rank_all_reduce_identity(self):
+        t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        out = dist.all_reduce(t)
+        np.testing.assert_allclose(out.numpy(), np.arange(4, dtype=np.float32))
+
+    def test_single_rank_all_gather(self):
+        lst = []
+        dist.all_gather(lst, paddle.to_tensor(np.ones(3, dtype=np.float32)))
+        assert len(lst) == 1
+        np.testing.assert_allclose(lst[0].numpy(), np.ones(3))
+
+    def test_new_group_registry(self):
+        g = dist.new_group([0])
+        assert g.nranks == 1 and g.rank == 0 and g.is_member()
+        from paddle_tpu.distributed.collective import get_group
+
+        assert get_group(g.id) is g
+
+    def test_new_group_rejects_unknown_rank(self):
+        with pytest.raises(ValueError):
+            dist.new_group([0, 99])
+
+    def test_send_to_self_raises(self):
+        with pytest.raises(ValueError):
+            dist.send(paddle.to_tensor(np.ones(2)), dst=jax.process_index())
+
+
+@pytest.mark.slow
+def test_two_process_collectives():
+    """Real cross-process collectives over jax.distributed + Gloo."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = os.path.join(HERE, "_collective_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(r), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"COLLECTIVE_OK rank={r}" in out, out
