@@ -120,7 +120,10 @@ def new_group(ranks=None, backend=None, timeout=None) -> ProcessGroup:
 def get_group(gid=0) -> ProcessGroup:
     if gid == 0:
         return _default_group()  # staleness-checked rebuild path
-    return _GROUPS.get(gid) or _default_group()
+    g = _GROUPS.get(gid)
+    if g is None:
+        raise KeyError(f"no process group with id {gid} (destroyed or never created)")
+    return g
 
 
 def destroy_process_group(group=None):
@@ -130,6 +133,7 @@ def destroy_process_group(group=None):
         _axis_group_ranks.cache_clear()
         _interned_group.cache_clear()
         _self_group.cache_clear()
+        _P2P_INBOX.clear()
     else:
         _GROUPS.pop(group.id, None)
 
@@ -471,16 +475,19 @@ def _pair_exchange(peer, local_np, is_send):
     the compiled path's lax.ppermute — the performant TPU route anyway)."""
     me = jax.process_index()
     g = _p2p_group(me, peer)
-    flag = np.asarray([1.0 if is_send else 0.0], dtype=np.float32)
-    flags = np.asarray(
-        stacked_collective("gather", _stack_local(g, flag), g._devices)
+    # ONE gather carries [send-flag byte, payload bytes] — dtype-preserving
+    local_np = np.ascontiguousarray(local_np)
+    flat = np.concatenate(
+        [np.asarray([1 if is_send else 0], np.uint8),
+         np.frombuffer(local_np.tobytes(), dtype=np.uint8)]
     )
-    payloads = np.asarray(
-        stacked_collective("gather", _stack_local(g, local_np), g._devices)
-    )
+    out = np.asarray(stacked_collective("gather", _stack_local(g, flat), g._devices))
     pidx = g.get_group_rank(peer)
-    if flags[pidx][0] > 0.5:
-        _P2P_INBOX.setdefault(peer, []).append(payloads[pidx])
+    if out[pidx][0]:
+        payload = np.frombuffer(
+            np.ascontiguousarray(out[pidx][1:]).tobytes(), dtype=local_np.dtype
+        ).reshape(local_np.shape)
+        _P2P_INBOX.setdefault(peer, []).append(payload)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):

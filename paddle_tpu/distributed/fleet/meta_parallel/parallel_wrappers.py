@@ -35,6 +35,16 @@ class TensorParallel(Layer):
 
 
 class PipelineParallel(Layer):
+    """Reference meta_parallel/pipeline_parallel.py:31. When the wrapped
+    model is a PipelineLayer whose middle is a homogeneous trunk (the usual
+    [embed, N x block, head] shape), train_batch compiles the whole
+    fwd+bwd as ONE 1F1B program over the hcg mesh's 'pp' axis
+    (parallel.pipeline.one_f_one_b): prologue layers run before the
+    pipeline (training through its input grads), epilogue layers + loss run
+    fused into the last stage's backward. Heterogeneous models without such
+    a trunk fall back to sequential gradient accumulation (degree-1
+    semantics)."""
+
     def __init__(self, layers, hcg, strategy):
         super().__init__()
         self._layers = layers
@@ -44,18 +54,181 @@ class PipelineParallel(Layer):
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
         self.total_loss = None
+        self._pipe = None  # lazily-built compiled 1F1B closure
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """GPipe-style: accumulate grads over micro-batches then step.
+    # ---- compiled 1F1B dispatch -----------------------------------------
+    def _trunk_partition(self):
+        """(prologue, trunk, epilogue) by longest homogeneous run of
+        parameterized layers whose length divides the pp degree."""
+        from ....core.functional import state_dict_arrays
 
-        The compiled multi-stage ppermute schedule lives in
-        paddle_tpu.parallel.pipeline (used by the GPT flagship); this eager
-        driver preserves the reference API and micro-batching semantics."""
+        funcs = list(getattr(self._layers, "_funcs", []))
+        pp = self._hcg.get_pipe_parallel_world_size() if self._hcg else 1
+        if not funcs or pp <= 1:
+            return None
+        sigs = []
+        for l in funcs:
+            if isinstance(l, Layer):
+                p, b = state_dict_arrays(l)
+                sigs.append(
+                    (type(l).__name__,
+                     tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in p.items())),
+                     bool(p) and not b)
+                )
+            else:
+                sigs.append(None)
+        best = (0, 0)
+        i = 0
+        while i < len(sigs):
+            if sigs[i] is None or not sigs[i][2]:
+                i += 1
+                continue
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        start, end = best
+        n = end - start
+        if n < pp or n % pp:
+            return None
+        return funcs[:start], funcs[start:end], funcs[end:]
+
+    def _build_pipe(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ....core.functional import functional_call, state_dict_arrays
+        from ....core.tensor import Tensor
+        from ....parallel.pipeline import make_pipeline_loss, stack_stage_params
+
+        part = self._trunk_partition()
+        if part is None:
+            return None
+        prologue, trunk, epilogue = part
+        pp = self._hcg.get_pipe_parallel_world_size()
+        mesh = self._hcg.mesh
+        K = len(trunk) // pp
+        template = trunk[0]
+
+        pro_layers = [l for l in prologue if isinstance(l, Layer)]
+        epi_layers = [l for l in epilogue if isinstance(l, Layer)]
+        loss_layer = self._layers._loss_fn
+
+        def stage_fn(stage_p, x):
+            def body(h, lp):
+                out, _ = functional_call(template, lp, {}, (h,))
+                return out, None
+
+            out, _ = jax.lax.scan(body, x, stage_p)
+            return out
+
+        def head_loss(head, y, lab):
+            h = y
+            for lp, layer in zip(head, epi_layers):
+                h, _ = functional_call(layer, lp, {}, (h,))
+            if loss_layer is None:
+                return jnp.mean(h)
+            from ....core import autograd as ag
+
+            with ag.trace_mode():
+                lv = loss_layer(Tensor._from_op(h), Tensor._from_op(lab))
+            return lv._array if isinstance(lv, Tensor) else lv
+
+        ploss = make_pipeline_loss(stage_fn, head_loss, mesh, axis="pp")
+        M = self.accumulate_steps
+
+        def pure_loss(pro, stk, epi, ins, labs):
+            h = ins
+            for lp, layer in zip(pro, pro_layers):
+                h, _ = functional_call(layer, lp, {}, (h,))
+            mbshape = (M, h.shape[0] // M) + tuple(h.shape[1:])
+            x = h.reshape(mbshape)
+            lab_mb = labs.reshape((M, labs.shape[0] // M) + tuple(labs.shape[1:]))
+            return ploss(stk, tuple(epi), x, lab_mb)
+
+        grad_fn = jax.jit(jax.value_and_grad(pure_loss, argnums=(0, 1, 2)))
+
+        # eager Parameter objects in the same traversal orders, for writing
+        # computed grads back before optimizer.step()
+        def named_params(layer):
+            return layer.named_parameters_dict()
+
+        pro_objs = [named_params(l) for l in pro_layers]
+        epi_objs = [named_params(l) for l in epi_layers]
+        trunk_objs = [named_params(l) for l in trunk]
+
+        from jax.sharding import NamedSharding, PartitionSpec as Spec
+
+        replicated = NamedSharding(mesh, Spec())
+
+        def run(ins, labs):
+            pro = [state_dict_arrays(l)[0] for l in pro_layers]
+            epi = [state_dict_arrays(l)[0] for l in epi_layers]
+            tp = [state_dict_arrays(l)[0] for l in trunk]
+            stk = stack_stage_params(
+                [stack_stage_params(tp[s * K:(s + 1) * K]) for s in range(pp)]
+            )
+            # eager tensors live on one device; the pipeline program spans
+            # the whole hcg mesh
+            pro, stk, epi, ins, labs = jax.device_put(
+                (pro, stk, epi, ins, labs), replicated
+            )
+            loss, (gpro, gstk, gepi) = grad_fn(pro, stk, epi, ins, labs)
+
+            def add_grad(t, arr):
+                g = Tensor._from_op(jnp.asarray(arr))
+                t._grad = g if t._grad is None else Tensor._from_op(t._grad._array + g._array)
+
+            for objs, gd in zip(pro_objs, gpro):
+                for k, t in objs.items():
+                    add_grad(t, gd[k])
+            for objs, gd in zip(epi_objs, gepi):
+                for k, t in objs.items():
+                    add_grad(t, gd[k])
+            for idx, objs in enumerate(trunk_objs):
+                s, k_i = divmod(idx, K)
+                for k, t in objs.items():
+                    add_grad(t, gstk[k][s, k_i])
+            return loss
+
+        return run
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """One optimizer step over accumulate_steps microbatches. Uses the
+        compiled 1F1B program when the model has a pipelineable trunk, else
+        sequential accumulation (reference API semantics either way)."""
         inputs, labels = data
         n = self.accumulate_steps
+
+        from ....core.tensor import Tensor
+
+        ins_t = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        lab_t = labels[0] if isinstance(labels, (list, tuple)) else labels
+        batch_ok = (
+            isinstance(ins_t, Tensor)
+            and ins_t.shape[0] % n == 0
+            and (not isinstance(lab_t, Tensor) or lab_t.shape[0] % n == 0)
+        )
+        if self._pipe is None and self._hcg is not None:
+            self._pipe = (self._build_pipe() if batch_ok else None) or False
+        if self._pipe and batch_ok:
+            import numpy as np
+
+            ins_a = ins_t._array if isinstance(ins_t, Tensor) else ins_t
+            lab_a = lab_t._array if isinstance(lab_t, Tensor) else lab_t
+            loss = self._pipe(ins_a, lab_a)
+            optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            self.total_loss = Tensor._from_op(loss)
+            return self.total_loss
+
         total = None
         mb_inputs = _split_batch(inputs, n)
         mb_labels = _split_batch(labels, n)

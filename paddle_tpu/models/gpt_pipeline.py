@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.pipeline import gpipe, stack_stage_params
+from ..parallel.pipeline import gpipe, make_pipeline_loss, stack_stage_params
 
 
 def _init_block(key, H, F, n_heads):
@@ -45,14 +45,34 @@ def _ln(x, g, b, eps=1e-5):
     return (x - m) * jax.lax.rsqrt(v + eps) * g + b
 
 
-def _block_fn(bp, x, n_heads_local, mp_axis="mp"):
-    """One transformer block on mp-local shards; x replicated over mp."""
+def _block_fn(bp, x, n_heads_local, mp_axis="mp", dialect="gspmd"):
+    """One transformer block on mp-local shards; x replicated over mp.
+
+    dialect="gspmd": plain lax.psum — correct when the stage is
+    differentiated by jax.grad THROUGH shard_map (the gpipe path, where the
+    outer transpose machinery reduces replicated-input cotangents).
+    dialect="manual": mp_copy/mp_psum custom-vjp collectives — required when
+    the stage is differentiated by explicit jax.vjp INSIDE the manual region
+    (the 1F1B executors). See parallel/pipeline.py dialect note.
+    """
+    from ..parallel.pipeline import mp_copy, mp_psum
+
+    if dialect == "manual":
+        col_in = lambda t: mp_copy(t, mp_axis)
+        row_out = lambda t: mp_psum(t, mp_axis)
+    else:
+        col_in = lambda t: t
+        row_out = lambda t: jax.lax.psum(t, mp_axis)
+
     h = _ln(x, bp["ln1_g"], bp["ln1_b"])
-    qkv = h @ bp["wqkv"] + bp["bqkv"]  # [mb, s, 3H/mp]
+    qkv = col_in(h) @ bp["wqkv"] + bp["bqkv"]  # [mb, s, 3H/mp]
     mb, s, three_h_local = qkv.shape
     hd = three_h_local // (3 * n_heads_local)
-    qkv = qkv.reshape(mb, s, 3, n_heads_local, hd)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    # head-major layout [heads, 3, hd]: a contiguous column shard is a whole
+    # set of heads (each with its q,k,v), so any mp degree computes the SAME
+    # model as mp=1 — qkv-major order would scramble q/k/v across shards
+    qkv = qkv.reshape(mb, s, n_heads_local, 3, hd)
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
     scale = 1.0 / np.sqrt(hd)
     att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     mask = jnp.tril(jnp.ones((s, s), bool))
@@ -60,16 +80,23 @@ def _block_fn(bp, x, n_heads_local, mp_axis="mp"):
     att = jax.nn.softmax(att, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(mb, s, -1)
     proj = out @ bp["wproj"]  # row-sharded: partial sums
-    proj = jax.lax.psum(proj, mp_axis) + bp["bproj"]
+    proj = row_out(proj) + bp["bproj"]
     x = x + proj
     h = _ln(x, bp["ln2_g"], bp["ln2_b"])
-    a = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
-    mlp = jax.lax.psum(a @ bp["w2"], mp_axis) + bp["b2"]
+    a = jax.nn.gelu(col_in(h) @ bp["w1"] + bp["b1"])
+    mlp = row_out(a @ bp["w2"]) + bp["b2"]
     return x + mlp
 
 
-def make_pipelined_gpt(cfg, mesh, n_microbatches):
-    """Returns (params, train_step) — train_step jitted with shardings."""
+def make_pipelined_gpt(cfg, mesh, n_microbatches, schedule="gpipe"):
+    """Returns (params, train_step) — train_step jitted with shardings.
+
+    schedule: "gpipe" (forward scan, jax.grad-transposed backward) or
+    "1f1b" (explicit fwd+bwd schedule, bounded activation memory — reference
+    pipeline_parallel.py:117). Under 1f1b the final layernorm + tied
+    unembedding + CE loss run fused into the last stage's backward and the
+    embedding prologue trains through the schedule's input grads
+    (parallel.pipeline.make_pipeline_loss)."""
     pp = mesh.shape["pp"]
     mp = mesh.shape["mp"]
     assert cfg.num_layers % pp == 0
@@ -122,14 +149,24 @@ def make_pipelined_gpt(cfg, mesh, n_microbatches):
         "blocks": block_specs,
     }
 
-    stage_fn_inner = functools.partial(_block_fn, n_heads_local=n_heads_local)
+    def make_stage_fn(dialect):
+        inner = functools.partial(
+            _block_fn, n_heads_local=n_heads_local, dialect=dialect
+        )
 
-    def stage_fn(stage_params, x):  # leaves [K, ...]
-        def body(h, bp):
-            return stage_fn_inner(bp, h), None
+        def stage_fn(stage_params, x):  # leaves [K, ...]
+            def body(h, bp):
+                return inner(bp, h), None
 
-        out, _ = jax.lax.scan(body, x, stage_params)
-        return out
+            out, _ = jax.lax.scan(body, x, stage_params)
+            return out
+
+        return stage_fn
+
+    # gpipe differentiates through shard_map (gspmd dialect); 1f1b runs
+    # explicit vjp inside the manual region (manual dialect) — see
+    # parallel/pipeline.py dialect note
+    stage_fn = make_stage_fn("gspmd")
 
     # microbatch specs inside shard_map: batch dim sharded over dp
     mb_spec = P(None, "dp", None, None)  # [M, mb, s, H]
@@ -147,11 +184,33 @@ def make_pipelined_gpt(cfg, mesh, n_microbatches):
         y = _ln(y, p["lnf_g"], p["lnf_b"])
         return y @ p["wte"].T
 
-    def loss_fn(p, ids, labels):
-        logits = forward(p, ids)
+    def _ce(logits, labels):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), -1)
         return -jnp.mean(picked)
+
+    if schedule == "1f1b":
+        def head_loss(head, y, lab):
+            y = _ln(y, head["lnf_g"], head["lnf_b"])
+            return _ce(y @ head["wte"].T, lab)
+
+        ploss = make_pipeline_loss(
+            make_stage_fn("manual"), head_loss, mesh, axis="pp",
+            params_specs=param_specs["blocks"], io_spec=mb_spec,
+            label_spec=P(None, "dp", None), reduce_axes=("dp",),
+        )
+
+        def loss_fn(p, ids, labels):
+            B, s = ids.shape
+            mb = B // n_microbatches
+            x = jnp.take(p["wte"], ids, axis=0) + p["wpe"][None, :s]
+            x = x.reshape(n_microbatches, mb, s, H)
+            labs = labels.reshape(n_microbatches, mb, s)
+            head = {"lnf_g": p["lnf_g"], "lnf_b": p["lnf_b"], "wte": p["wte"]}
+            return ploss(p["blocks"], head, x, labs)
+    else:
+        def loss_fn(p, ids, labels):
+            return _ce(forward(p, ids), labels)
 
     ns = lambda spec: NamedSharding(mesh, spec)
     pspecs = jax.tree_util.tree_map(lambda s: ns(s), param_specs, is_leaf=lambda s: isinstance(s, P))

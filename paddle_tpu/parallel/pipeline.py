@@ -1,16 +1,27 @@
-"""Compiled pipeline parallelism: GPipe schedule over the 'pp' mesh axis.
+"""Compiled pipeline parallelism: GPipe / 1F1B / interleaved schedules over
+the 'pp' mesh axis.
 
 Reference parity: meta_parallel/pipeline_parallel.py:117
 (forward_backward_pipeline — 1F1B over NCCL p2p with SendRecvMeta handshake)
-in /root/reference.
+and :461 (interleaved virtual stages) in /root/reference.
 
-TPU-native design: the whole schedule is ONE compiled XLA program.
+TPU-native design: each schedule is ONE compiled XLA program.
 `shard_map` places each pipeline stage's (stacked) weights on its own 'pp'
-slice; a `lax.scan` runs M + P - 1 ticks, each tick computing the local
-stage on its current micro-activation and handing the result to the next
-stage with `ppermute` over ICI. There is no shape handshake (shapes are
-static) and no schedule code for backward: jax.grad transposes the scan +
-ppermute into the reversed backward pipeline automatically.
+slice and a `lax.scan` runs the schedule's ticks, handing activations (and,
+for 1F1B, gradient signals) between stages with `ppermute` over ICI. There
+is no shape handshake (shapes are static).
+
+- gpipe: forward-only scan; jax.grad transposes it into the reversed
+  backward pipeline. Simple, but the scan stacks every tick's output, so
+  live activations grow with the number of microbatches M — the problem
+  1F1B exists to solve.
+- one_f_one_b: the full fwd+bwd schedule is explicit. Each cycle every
+  stage runs one gated forward micro-step and one gated backward micro-step
+  (jax.vjp, recompute-from-saved-input), with residual inputs held in a
+  ring buffer of 2*P slots — activation memory is O(P), independent of M.
+- interleaved 1F1B: V virtual chunks per device (reference :461). The ring
+  ppermute's wrap-around edge (last device -> device 0) carries activations
+  from chunk c to chunk c+1.
 """
 from __future__ import annotations
 
@@ -21,6 +32,61 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ._compat import shard_map
+
+
+# ---- manual-vjp collective dialect ------------------------------------------
+# Explicit-schedule executors (one_f_one_b) differentiate the stage function
+# with jax.vjp INSIDE the shard_map region. There, lax.psum's default
+# transpose re-psums an already-replicated cotangent (x mp_size error) and
+# replicated inputs' cotangents arrive as per-rank partial sums. Stage
+# functions handed to these executors must therefore use this dialect:
+#   mp_copy  at each column-parallel input  (identity fwd / psum bwd —
+#             reference mp_ops.py _c_identity)
+#   mp_psum  at each row-parallel output    (psum fwd / identity bwd —
+#             reference mp_ops.py _mp_allreduce)
+# Under jax.grad-of-shard_map (the gpipe path) the OUTER transpose machinery
+# already inserts these reductions, so there the plain-lax.psum form is the
+# correct one — build one stage_fn per dialect (see models/gpt_pipeline.py).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_copy(x, axis):
+    return x
+
+
+def _mp_copy_fwd(x, axis):
+    return x, None
+
+
+def _mp_copy_bwd(axis, _, ct):
+    return (jax.lax.psum(ct, axis),)
+
+
+mp_copy.defvjp(_mp_copy_fwd, _mp_copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_psum(x, axis):
+    return jax.lax.psum(x, axis)
+
+
+def _mp_psum_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _mp_psum_bwd(axis, _, ct):
+    return (ct,)
+
+
+mp_psum.defvjp(_mp_psum_fwd, _mp_psum_bwd)
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_where(pred, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
 
 
 def gpipe(stage_fn, stacked_params, microbatches, mesh, axis="pp", params_specs=None, io_spec=None):
@@ -77,3 +143,328 @@ def stack_stage_params(per_stage_params):
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *per_stage_params
     )
+
+
+def one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches, labels, mesh,
+                axis="pp", params_specs=None, io_spec=None, label_spec=None,
+                reduce_axes=(), head_params=None, return_input_grads=False):
+    """1F1B fwd+bwd pipeline in one SPMD program (reference
+    pipeline_parallel.py:117 startup/steady/cooldown, re-expressed as a
+    uniform gated schedule XLA can compile).
+
+    stage_fn(stage_params, x) -> y      (same shape as x)
+    loss_fn(y, label) -> scalar         (per-microbatch mean loss), or
+    loss_fn(head_params, y, label) when head_params is given — the "head"
+    (e.g. final layernorm + unembedding + CE) runs fused into the last
+    stage's backward and its grads are returned too.
+    microbatches: [M, mb, ...]; labels: [M, ...]
+
+    Schedule (P stages, M microbatches, cycles t = 0 .. M+2P-3):
+      forward of mb i at stage s:  t = s + i
+      backward of mb i at stage s: t = 2P - 2 - s + i
+    The last stage backs up a microbatch in the same cycle it forwards it;
+    at most 2(P - s) - 1 microbatches are in flight at stage s, so forward
+    inputs live in a ring buffer of 2P slots — activation memory is
+    independent of M (the GPipe scan's per-tick output stack is not).
+    Backward recomputes the stage forward from the saved input under
+    jax.vjp (recompute-from-input, the reference's recompute_interval=1
+    behavior fused into the schedule).
+
+    reduce_axes: mesh axes the *batch* is sharded over (e.g. ("dp",)) —
+    gradients and loss are averaged across them (the loss is the mean over
+    batch shards).
+
+    return_input_grads: additionally return d(loss)/d(microbatches) so a
+    prologue outside the pipeline (embedding) can backprop through it (see
+    pipeline_train_loss's custom_vjp).
+
+    Returns (mean_loss, param_grads[, head_grads][, input_grads]) with grads
+    scaled 1/M — numerically the grads of mean-over-microbatch loss.
+    """
+    n_stages = mesh.shape[axis]
+    if io_spec is None:
+        io_spec = P()
+    if label_spec is None:
+        label_spec = io_spec
+    M = microbatches.shape[0]
+    B = 2 * n_stages  # ring-buffer slots
+    T = M + 2 * n_stages - 2
+    with_head = head_params is not None
+    head = head_params if with_head else ()
+
+    def per_stage(params_local, head_p, mbs, labs):
+        params_here = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        s = jax.lax.axis_index(axis)
+        perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
+        perm_bwd = [(i + 1, i) for i in range(n_stages - 1)]
+        is_last = s == n_stages - 1
+
+        def head_loss(h_, yy, lab):
+            if with_head:
+                return loss_fn(h_, yy, lab).astype(jnp.float32)
+            return loss_fn(yy, lab).astype(jnp.float32)
+
+        def cycle(carry, t):
+            fwd_in, bwd_in, buf, gacc, hacc, dmbs, loss_acc = carry
+
+            # ---- forward micro-step ----------------------------------
+            i_f = t - s
+            fwd_active = (i_f >= 0) & (i_f < M)
+            inject = mbs[jnp.clip(i_f, 0, M - 1)]
+            x_in = jnp.where(s == 0, inject, fwd_in)
+            y = stage_fn(params_here, x_in)
+            # single-slot dynamic-update-slice (a full-array where would copy
+            # the whole ring buffer every cycle)
+            slot = i_f % B
+            buf = buf.at[slot].set(jnp.where(fwd_active, x_in, buf[slot]))
+            fwd_out = jax.lax.ppermute(y, axis, perm_fwd)
+
+            # ---- backward micro-step ---------------------------------
+            i_b = t - (2 * n_stages - 2 - s)
+            bwd_active = (i_b >= 0) & (i_b < M)
+            x_saved = buf[jnp.clip(i_b, 0, M - 1) % B]
+            yb, vjp_fn = jax.vjp(lambda p_, x_: stage_fn(p_, x_), params_here, x_saved)
+            lab = jax.tree_util.tree_map(
+                lambda l: l[jnp.clip(i_b, 0, M - 1)], labs
+            )
+            (loss_j, (dh, dy_last)) = jax.value_and_grad(
+                head_loss, argnums=(0, 1)
+            )(head_p, yb, lab)
+            g = jnp.where(is_last, dy_last.astype(yb.dtype), bwd_in)
+            dp, dx = vjp_fn(g)
+            gacc = _tree_where(bwd_active, _tree_add(gacc, dp), gacc)
+            hacc = _tree_where(bwd_active & is_last, _tree_add(hacc, dh), hacc)
+            if return_input_grads:
+                bslot = jnp.clip(i_b, 0, M - 1)
+                dmbs = dmbs.at[bslot].set(
+                    jnp.where(bwd_active & (s == 0), dx, dmbs[bslot])
+                )
+            loss_acc = loss_acc + jnp.where(bwd_active & is_last, loss_j, 0.0)
+            bwd_out = jax.lax.ppermute(dx, axis, perm_bwd)
+
+            return (fwd_out, bwd_out, buf, gacc, hacc, dmbs, loss_acc), None
+
+        zero_mb = jnp.zeros_like(mbs[0])
+        init = (
+            zero_mb,
+            zero_mb,
+            jnp.zeros((B,) + mbs.shape[1:], mbs.dtype),
+            jax.tree_util.tree_map(jnp.zeros_like, params_here),
+            jax.tree_util.tree_map(jnp.zeros_like, head_p),
+            jnp.zeros_like(mbs) if return_input_grads else jnp.zeros((), mbs.dtype),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, gacc, hacc, dmbs, loss_acc), _ = jax.lax.scan(
+            cycle, init, jnp.arange(T)
+        )
+        # mean over microbatches; loss/head grads live on the last stage and
+        # input grads on the first — psum broadcasts (others contribute 0)
+        loss = jax.lax.psum(loss_acc / M, axis)
+        grads = jax.tree_util.tree_map(lambda a: a / M, gacc)
+        hgrads = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a / M, axis), hacc
+        )
+        dmbs = jax.lax.psum(dmbs / M, axis) if return_input_grads else dmbs
+        for ax in reduce_axes:
+            # loss is the mean over batch shards, so grads average too; each
+            # shard's input grads scale by 1/axis_size (its slice of the mean)
+            loss = jax.lax.pmean(loss, ax)
+            grads = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, ax), grads)
+            hgrads = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, ax), hgrads)
+            if return_input_grads:
+                dmbs = dmbs / mesh.shape[ax]
+        grads = jax.tree_util.tree_map(lambda a: a[None], grads)
+        return loss, grads, hgrads, dmbs
+
+    if params_specs is None:
+        params_specs = jax.tree_util.tree_map(
+            lambda a: P(axis) if hasattr(a, "ndim") else P(), stacked_params
+        )
+    head_specs = jax.tree_util.tree_map(lambda a: P(), head)
+    dmb_spec = io_spec if return_input_grads else P()
+    fn = shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(params_specs, head_specs, io_spec, label_spec),
+        out_specs=(P(), params_specs, head_specs, dmb_spec),
+        check_vma=False,
+    )
+    loss, grads, hgrads, dmbs = fn(stacked_params, head, microbatches, labels)
+    out = [loss, grads]
+    if with_head:
+        out.append(hgrads)
+    if return_input_grads:
+        out.append(dmbs)
+    return tuple(out)
+
+
+def make_pipeline_loss(stage_fn, loss_fn, mesh, axis="pp", params_specs=None,
+                       io_spec=None, label_spec=None, reduce_axes=()):
+    """Differentiable 1F1B: returns f(stacked_params, head_params,
+    microbatches, labels) -> scalar loss whose custom_vjp replays the
+    schedule's explicitly-accumulated grads, so jax.grad flows into the
+    trunk, the fused head, AND the microbatch inputs — letting a prologue
+    outside the pipeline (embedding) train normally under one jit."""
+
+    def _run(stacked, head, mbs, labels):
+        return one_f_one_b(
+            stage_fn, loss_fn, stacked, mbs, labels, mesh, axis=axis,
+            params_specs=params_specs, io_spec=io_spec, label_spec=label_spec,
+            reduce_axes=reduce_axes, head_params=head, return_input_grads=True,
+        )
+
+    @jax.custom_vjp
+    def ploss(stacked, head, mbs, labels):
+        return _run(stacked, head, mbs, labels)[0]
+
+    def fwd(stacked, head, mbs, labels):
+        loss, grads, hgrads, dmbs = _run(stacked, head, mbs, labels)
+        return loss, (grads, hgrads, dmbs)
+
+    def bwd(res, ct):
+        grads, hgrads, dmbs = res
+        scale = lambda t: jax.tree_util.tree_map(lambda a: ct * a, t)
+        return scale(grads), scale(hgrads), scale(dmbs), None
+
+    ploss.defvjp(fwd, bwd)
+    return ploss
+
+
+def stack_interleaved_params(per_virtual_stage_params, n_devices):
+    """Virtual-stage param list (length V*P, global layer order) -> pytree
+    with leaves [P, V, ...]: leaf[s, c] holds virtual stage c*P + s (chunk c
+    of device s), the reference's interleaved placement (:461)."""
+    vp = len(per_virtual_stage_params)
+    assert vp % n_devices == 0, (vp, n_devices)
+    v = vp // n_devices
+    rows = []
+    for s in range(n_devices):
+        chunks = [per_virtual_stage_params[c * n_devices + s] for c in range(v)]
+        rows.append(jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *chunks))
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *rows)
+
+
+def interleaved_one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches,
+                            labels, mesh, n_chunks, axis="pp",
+                            params_specs=None, io_spec=None, label_spec=None,
+                            reduce_axes=()):
+    """Interleaved-virtual-stage 1F1B (reference pipeline_parallel.py:461):
+    each device hosts V = n_chunks model chunks; chunk c of device s is
+    virtual stage g = c*P + s of a depth-V*P pipeline. The schedule is the
+    1F1B gated-cycle machinery over virtual depth V*P; the ring ppermute's
+    wrap-around edge (device P-1 -> device 0) carries an activation from
+    chunk c into chunk c+1 (and the mirrored edge carries gradient signals
+    back). Activation buffers hold 2*V*P microbatch inputs per chunk —
+    still independent of M.
+
+    stacked_params / params_specs: leaves [P, V, ...] (stack_interleaved_params).
+    Returns (mean_loss, grads[P, V, ...]).
+    """
+    n_stages = mesh.shape[axis]
+    V = n_chunks
+    VP = V * n_stages
+    if io_spec is None:
+        io_spec = P()
+    if label_spec is None:
+        label_spec = io_spec
+    M = microbatches.shape[0]
+    B = 2 * VP
+    T = M + 2 * VP - 2
+
+    def per_stage(params_local, mbs, labs):
+        params_here = jax.tree_util.tree_map(lambda a: a[0], params_local)  # [V, ...]
+        s = jax.lax.axis_index(axis)
+        ring_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        ring_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def chunk_params(c):
+            return jax.tree_util.tree_map(lambda a: a[c], params_here)
+
+        def cycle(carry, t):
+            fwd_in, bwd_in, buf, gacc, loss_acc = carry
+            # fwd_in/bwd_in: [V, mb...]; buf: [V, B, mb...]
+
+            ys, new_bufs = [], []
+            for c in range(V):
+                g = c * n_stages + s
+                i_f = t - g
+                fwd_active = (i_f >= 0) & (i_f < M)
+                inject = mbs[jnp.clip(i_f, 0, M - 1)]
+                x_in = jnp.where(g == 0, inject, fwd_in[c])
+                y = stage_fn(chunk_params(c), x_in)
+                slot = jnp.clip(i_f, 0, M - 1) % B
+                new_bufs.append(
+                    buf[c].at[slot].set(jnp.where(fwd_active, x_in, buf[c][slot]))
+                )
+                ys.append(y)
+            buf = jnp.stack(new_bufs)
+            handed = jax.lax.ppermute(jnp.stack(ys), axis, ring_fwd)
+            # wrap-around edge: device 0 receives device P-1's chunk c as its
+            # chunk c+1 input (virtual boundary c*P+P-1 -> (c+1)*P)
+            shifted = jnp.concatenate([jnp.zeros_like(handed[:1]), handed[:-1]], 0)
+            fwd_in = jnp.where(s == 0, shifted, handed)
+
+            dxs = []
+            new_gacc, new_loss = gacc, loss_acc
+            for c in range(V):
+                g = c * n_stages + s
+                i_b = t - (2 * VP - 2 - g)
+                bwd_active = (i_b >= 0) & (i_b < M)
+                is_last = g == VP - 1
+                x_saved = buf[c][jnp.clip(i_b, 0, M - 1) % B]
+                yb, vjp_fn = jax.vjp(
+                    lambda p_, x_: stage_fn(p_, x_), chunk_params(c), x_saved
+                )
+                lab = jax.tree_util.tree_map(
+                    lambda l: l[jnp.clip(i_b, 0, M - 1)], labs
+                )
+                loss_j, dy_last = jax.value_and_grad(
+                    lambda yy: loss_fn(yy, lab).astype(jnp.float32)
+                )(yb)
+                gcot = jnp.where(is_last, dy_last.astype(yb.dtype), bwd_in[c])
+                dp, dx = vjp_fn(gcot)
+                new_gacc = jax.tree_util.tree_map(
+                    lambda acc, d, c=c, act=bwd_active: acc.at[c].set(
+                        jnp.where(act, acc[c] + d, acc[c])
+                    ),
+                    new_gacc, dp,
+                )
+                new_loss = new_loss + jnp.where(bwd_active & is_last, loss_j, 0.0)
+                dxs.append(dx)
+            handed_b = jax.lax.ppermute(jnp.stack(dxs), axis, ring_bwd)
+            # mirrored wrap-around: device P-1 receives device 0's chunk c+1
+            # signal as its chunk c (virtual (c+1)*P -> c*P+P-1)
+            shifted_b = jnp.concatenate([handed_b[1:], jnp.zeros_like(handed_b[:1])], 0)
+            bwd_in = jnp.where(s == n_stages - 1, shifted_b, handed_b)
+
+            return (fwd_in, bwd_in, buf, new_gacc, new_loss), None
+
+        zero_mb = jnp.zeros((V,) + mbs.shape[1:], mbs.dtype)
+        init = (
+            zero_mb,
+            zero_mb,
+            jnp.zeros((V, B) + mbs.shape[1:], mbs.dtype),
+            jax.tree_util.tree_map(jnp.zeros_like, params_here),
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, gacc, loss_acc), _ = jax.lax.scan(cycle, init, jnp.arange(T))
+        loss = jax.lax.psum(loss_acc / M, axis)
+        grads = jax.tree_util.tree_map(lambda a: a / M, gacc)
+        for ax in reduce_axes:
+            loss = jax.lax.pmean(loss, ax)
+            grads = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, ax), grads)
+        grads = jax.tree_util.tree_map(lambda a: a[None], grads)
+        return loss, grads
+
+    if params_specs is None:
+        params_specs = jax.tree_util.tree_map(
+            lambda a: P(axis) if hasattr(a, "ndim") else P(), stacked_params
+        )
+    fn = shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(params_specs, io_spec, label_spec),
+        out_specs=(P(), params_specs),
+        check_vma=False,
+    )
+    return fn(stacked_params, microbatches, labels)

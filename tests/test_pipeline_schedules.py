@@ -1,0 +1,236 @@
+"""1F1B + interleaved pipeline schedules (VERDICT round-1 item 3).
+
+Reference parity: meta_parallel/pipeline_parallel.py:117 (1F1B) and :461
+(interleaved virtual stages). Checks: numerical equality with non-pipelined
+execution, bounded activation memory vs GPipe, cross-mesh/schedule GPT
+trajectory equality, and the user-facing PipelineLayer/fleet dispatch.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.mesh import init_mesh
+from paddle_tpu.parallel.pipeline import (
+    interleaved_one_f_one_b,
+    one_f_one_b,
+    stack_interleaved_params,
+    stack_stage_params,
+)
+
+M, MB, D = 6, 4, 8
+
+
+def _mlp_stages(n, seed=0):
+    rs = np.random.RandomState(seed)
+    return [
+        {"w": jnp.asarray(rs.randn(D, D).astype(np.float32) * 0.3),
+         "b": jnp.asarray(rs.randn(D).astype(np.float32) * 0.1)}
+        for _ in range(n)
+    ]
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _loss_fn(y, lab):
+    return jnp.mean((y - lab) ** 2)
+
+
+def _data(seed=0):
+    rs = np.random.RandomState(seed)
+    return (jnp.asarray(rs.randn(M, MB, D).astype(np.float32)),
+            jnp.asarray(rs.randn(M, MB, D).astype(np.float32)))
+
+
+def _ref_loss_and_grads(stages, x, labs):
+    def ref(stages_list):
+        tot = 0.0
+        for i in range(M):
+            h = x[i]
+            for p in stages_list:
+                h = _stage_fn(p, h)
+            tot = tot + _loss_fn(h, labs[i])
+        return tot / M
+
+    return jax.value_and_grad(ref)(stages)
+
+
+class Test1F1B:
+    def test_matches_sequential_pp4_dp2(self):
+        mesh = init_mesh({"pp": 4, "dp": 2})
+        stages = _mlp_stages(4)
+        x, labs = _data()
+        loss, grads = one_f_one_b(
+            _stage_fn, _loss_fn, stack_stage_params(stages), x, labs, mesh,
+            io_spec=P(None, "dp"), label_spec=P(None, "dp"), reduce_axes=("dp",),
+        )
+        rl, rg = _ref_loss_and_grads(stages, x, labs)
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        rgs = stack_stage_params(rg)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(rgs[k]), rtol=1e-4, atol=1e-6
+            )
+
+    def test_head_and_input_grads(self):
+        """Fused head grads + d(loss)/d(inputs) against jax.grad of the same
+        composite (head = extra linear layer folded into the last stage)."""
+        mesh = init_mesh({"pp": 2})
+        stages = _mlp_stages(2)
+        rs = np.random.RandomState(3)
+        head = {"wh": jnp.asarray(rs.randn(D, D).astype(np.float32) * 0.3)}
+        x, labs = _data(3)
+
+        def head_loss(h, y, lab):
+            return _loss_fn(y @ h["wh"], lab)
+
+        loss, grads, hgrads, dmbs = one_f_one_b(
+            _stage_fn, head_loss, stack_stage_params(stages), x, labs, mesh,
+            head_params=head, return_input_grads=True,
+        )
+
+        def ref(stages_list, h, xx):
+            tot = 0.0
+            for i in range(M):
+                hh = xx[i]
+                for p in stages_list:
+                    hh = _stage_fn(p, hh)
+                tot = tot + _loss_fn(hh @ h["wh"], labs[i])
+            return tot / M
+
+        rl, (rg, rh, rx) = jax.value_and_grad(ref, argnums=(0, 1, 2))(stages, head, x)
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(hgrads["wh"]), np.asarray(rh["wh"]), rtol=1e-4, atol=1e-6
+        )
+        np.testing.assert_allclose(np.asarray(dmbs), np.asarray(rx), rtol=1e-4, atol=1e-6)
+
+    def test_interleaved_matches_sequential(self):
+        mesh = init_mesh({"pp": 2, "dp": 2})
+        vstages = _mlp_stages(4, seed=1)  # V=2 chunks x P=2 devices
+        x, labs = _data(1)
+        loss, grads = interleaved_one_f_one_b(
+            _stage_fn, _loss_fn, stack_interleaved_params(vstages, 2), x, labs,
+            mesh, n_chunks=2, io_spec=P(None, "dp"), label_spec=P(None, "dp"),
+            reduce_axes=("dp",),
+        )
+        rl, rg = _ref_loss_and_grads(vstages, x, labs)
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        rgs = stack_interleaved_params(rg, 2)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(rgs[k]), rtol=1e-4, atol=1e-6
+            )
+
+
+class TestGPTSchedules:
+    def _train(self, degrees, sched, steps=3):
+        from paddle_tpu.models.gpt import GPTConfig
+        from paddle_tpu.models.gpt_pipeline import make_pipelined_gpt
+
+        rs = np.random.RandomState(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                        num_heads=2, max_seq_len=32)
+        ids = jnp.asarray(rs.randint(0, 128, (8, 32)))
+        labs = jnp.asarray(rs.randint(0, 128, (8, 32)))
+        mesh = init_mesh(degrees)
+        params, step = make_pipelined_gpt(cfg, mesh, n_microbatches=4, schedule=sched)
+        p, ls = params, []
+        for _ in range(steps):
+            loss, p = step(p, ids, labs, jnp.float32(1e-1))
+            ls.append(float(loss))
+        return ls
+
+    def test_cross_mesh_and_schedule_trajectories_agree(self):
+        base = self._train({"pp": 2}, "gpipe")
+        np.testing.assert_allclose(self._train({"pp": 2}, "1f1b"), base, rtol=3e-4)
+        np.testing.assert_allclose(
+            self._train({"pp": 2, "mp": 2, "dp": 2}, "1f1b"), base, rtol=3e-4
+        )
+        np.testing.assert_allclose(
+            self._train({"pp": 2, "mp": 2, "dp": 2}, "gpipe"), base, rtol=3e-4
+        )
+
+    def test_1f1b_activation_memory_bounded(self):
+        """At M=32 microbatches GPipe's scan stacks every tick's output while
+        1F1B holds a 2P-slot ring buffer — compiled temp memory must differ
+        by a wide margin (reference pipeline_parallel.py:117 motivation)."""
+        from paddle_tpu.models.gpt import GPTConfig
+        from paddle_tpu.models.gpt_pipeline import make_pipelined_gpt
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                        num_heads=2, max_seq_len=64)
+        mesh = init_mesh({"pp": 4})
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, 128, (64, 64)))
+        labs = jnp.asarray(rs.randint(0, 128, (64, 64)))
+        temps = {}
+        for sched in ("gpipe", "1f1b"):
+            params, step = make_pipelined_gpt(cfg, mesh, 32, schedule=sched)
+            ma = step.lower(params, ids, labs, jnp.float32(1e-3)).compile().memory_analysis()
+            if ma is None:
+                pytest.skip("memory_analysis unavailable on this backend")
+            temps[sched] = ma.temp_size_in_bytes
+        assert temps["1f1b"] * 4 < temps["gpipe"], temps
+
+
+class _Block(nn.Layer):
+    def __init__(self, h):
+        super().__init__()
+        self.fc = nn.Linear(h, h)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+class TestPipelineLayerDispatch:
+    def _build(self, seed):
+        from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+        paddle.seed(seed)
+        descs = (
+            [LayerDesc(nn.Linear, 8, 16)]
+            + [LayerDesc(_Block, 16) for _ in range(4)]
+            + [LayerDesc(nn.Linear, 16, 4)]
+        )
+        return PipelineLayer(layers=descs, num_stages=4, loss_fn=nn.MSELoss())
+
+    def test_fleet_pp_dispatches_compiled_1f1b(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 4, "sharding_degree": 2,
+        }
+        strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        rs = np.random.RandomState(0)
+        X = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        Y = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+
+        def run(force_fallback):
+            m = self._build(7)
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+            wrapped = fleet.fleet.distributed_model(m)
+            opt = fleet.fleet.distributed_optimizer(opt)
+            if force_fallback:
+                wrapped._pipe = False
+            losses = [
+                float(np.asarray(wrapped.train_batch((X, Y), opt)._array))
+                for _ in range(4)
+            ]
+            return wrapped, losses
+
+        piped, t1 = run(False)
+        assert piped._pipe, "PipelineParallel did not build the compiled 1F1B path"
+        _, t2 = run(True)
+        np.testing.assert_allclose(t1, t2, rtol=2e-4)
+        assert t1[-1] < t1[0]  # actually training
