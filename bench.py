@@ -1,8 +1,10 @@
 """Benchmark: flagship GPT compiled train-step throughput on the local chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 vs_baseline: the reference publishes no numbers (BASELINE.md); 1.0 = the
 recorded target placeholder until an A100 reference measurement exists.
+Extras: mfu (model flops utilization vs the chip's bf16 peak), best batch
+size from the sweep, and per-batch throughput.
 """
 from __future__ import annotations
 
@@ -12,6 +14,35 @@ import time
 
 import numpy as np
 
+# bf16 peak FLOP/s by TPU generation (public spec sheets)
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5": 459e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in sorted(_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return 197e12  # conservative default (v5e-class)
+
+
+def _train_flops_per_token(cfg) -> float:
+    """6*N for the matmuls (fwd+bwd) + causal attention score/value FLOPs."""
+    H, L, S, V = cfg.hidden_size, cfg.num_layers, cfg.max_seq_len, cfg.vocab_size
+    Ff = cfg.intermediate_size
+    n_matmul = L * (4 * H * H + 2 * H * Ff) + V * H  # qkv+proj + mlp + unembed
+    # causal attention: 2 matmuls of S*H per token fwd, x3 for train, /2 causal
+    attn = L * 2 * S * H * 3
+    return 6.0 * n_matmul + attn
+
 
 def main():
     import jax
@@ -19,23 +50,23 @@ def main():
 
     import paddle_tpu as paddle
     from paddle_tpu.core import rng
-    from paddle_tpu.core.functional import state_dict_arrays
+    from paddle_tpu.core.functional import functional_call, state_dict_arrays
     from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
     paddle.seed(0)
-    # GPT-small-ish sized to fit one chip comfortably in bf16
-    cfg = GPTConfig(
-        vocab_size=32768,
-        hidden_size=1024,
-        num_layers=12,
-        num_heads=16,
-        max_seq_len=1024,
-        attn_impl="flash" if on_tpu else "xla",
-        dtype="bfloat16",
-    )
-    batch, seq = (8, 1024) if on_tpu else (2, 128)
-    if not on_tpu:
+    seq = 1024 if on_tpu else 128
+    if on_tpu:
+        cfg = GPTConfig(
+            vocab_size=32768,
+            hidden_size=1024,
+            num_layers=12,
+            num_heads=16,
+            max_seq_len=seq,
+            attn_impl="flash",
+            dtype="bfloat16",
+        )
+    else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
                         num_heads=8, max_seq_len=seq, attn_impl="xla")
     model = GPT(cfg)
@@ -44,8 +75,6 @@ def main():
 
     params, buffers = state_dict_arrays(model)
     opt_state = opt.init_state_arrays(params)
-
-    from paddle_tpu.core.functional import functional_call
 
     def step(params, buffers, opt_state, lr, key, ids, labels):
         def loss_fn(p):
@@ -59,26 +88,43 @@ def main():
         return loss, new_params, new_buf, new_opt
 
     jstep = jax.jit(step, donate_argnums=(0, 2))
-
-    rs = np.random.RandomState(0)
-    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
-    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
     lr = jnp.asarray(1e-4, jnp.float32)
+    rs = np.random.RandomState(0)
 
-    # warmup / compile
-    loss, params, buffers, opt_state = jstep(params, buffers, opt_state, lr, rng.next_key(), ids, labels)
-    float(np.asarray(loss))
-
-    iters = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
+    def run(batch, iters):
+        nonlocal params, buffers, opt_state
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
+        labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
         loss, params, buffers, opt_state = jstep(
             params, buffers, opt_state, lr, rng.next_key(), ids, labels
         )
-    float(np.asarray(loss))  # sync
-    dt = time.perf_counter() - t0
+        float(np.asarray(loss))  # compile + sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss, params, buffers, opt_state = jstep(
+                params, buffers, opt_state, lr, rng.next_key(), ids, labels
+            )
+        float(np.asarray(loss))
+        dt = time.perf_counter() - t0
+        return batch * seq * iters / dt
 
-    tokens_per_sec = batch * seq * iters / dt
+    sweep = {}
+    batches = (8, 16, 32) if on_tpu else (2,)
+    iters = 20 if on_tpu else 3
+    for b in batches:
+        try:
+            sweep[b] = run(b, iters)
+        except Exception:  # OOM at large batch: keep what we have
+            if not sweep:
+                raise
+            break
+    best_batch = max(sweep, key=sweep.get)
+    tokens_per_sec = sweep[best_batch]
+
+    flops_per_token = _train_flops_per_token(cfg)
+    peak = _peak_flops(jax.devices()[0])
+    mfu = tokens_per_sec * flops_per_token / peak
+
     print(
         json.dumps(
             {
@@ -86,6 +132,9 @@ def main():
                 "value": round(tokens_per_sec, 1),
                 "unit": "tokens/sec",
                 "vs_baseline": 1.0,
+                "mfu": round(mfu, 4),
+                "batch": best_batch,
+                "sweep": {str(k): round(v, 1) for k, v in sweep.items()},
             }
         )
     )
