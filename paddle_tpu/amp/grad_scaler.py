@@ -1,15 +1,26 @@
 """Loss scaling.
 
-Reference parity: python/paddle/amp/grad_scaler.py:581 in /root/reference.
+Reference parity: python/paddle/amp/grad_scaler.py:581 in /root/reference
+(GradScaler with per-optimizer OptimizerState INIT/UNSCALED/STEPPED tracking,
+mirroring the reference's ``_optimizer_states`` bookkeeping so the documented
+pattern ``scaler.unscale_(opt); clip; scaler.step(opt); scaler.update()``
+unscales exactly once).
 On TPU training runs bf16 (same exponent range as fp32) so dynamic loss
-scaling is unnecessary; GradScaler keeps the fp16 semantics for parity and is
-an enabled-aware pass-through by default.
+scaling is unnecessary; GradScaler keeps the fp16 semantics for parity.
 """
 from __future__ import annotations
+
+import enum
 
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+
+
+class OptimizerState(enum.Enum):
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
 
 
 class GradScaler:
@@ -32,7 +43,12 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        self._found_inf = False  # OR over optimizers since the last update()
+        self._optimizer_states = {}  # id(optimizer) -> OptimizerState
+        self._optimizer_found_inf = {}  # id(optimizer) -> bool
+
+    def _state_of(self, optimizer):
+        return self._optimizer_states.get(id(optimizer), OptimizerState.INIT)
 
     def scale(self, loss):
         if not self._enable:
@@ -42,29 +58,54 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        st = self._state_of(optimizer)
+        if st is OptimizerState.UNSCALED:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update()."
+            )
+        if st is OptimizerState.STEPPED:
+            raise RuntimeError("unscale_() is being called after step().")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._params:
             if p._grad is not None:
                 p._grad = p._grad * inv
                 found = found or bool(jnp.any(~jnp.isfinite(p._grad)))
-        self._found_inf = found
+        # per-optimizer flag decides step-skipping; the global flag (an OR,
+        # so a second optimizer's clean grads can't erase an earlier inf)
+        # drives the dynamic-scale update
+        self._optimizer_found_inf[id(optimizer)] = found
+        self._found_inf = self._found_inf or found
+        self._optimizer_states[id(optimizer)] = OptimizerState.UNSCALED
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        st = self._state_of(optimizer)
+        if st is OptimizerState.STEPPED:
+            raise RuntimeError(
+                "step() has already been called since the last update()."
+            )
+        if st is OptimizerState.INIT:
+            self.unscale_(optimizer)
+        if not self._optimizer_found_inf.get(id(optimizer), False):
             optimizer.step()
-        self.update()
+        self._optimizer_states[id(optimizer)] = OptimizerState.STEPPED
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
-        if not (self._enable and self._dynamic):
+        if not self._enable:
+            return
+        self._optimizer_states.clear()
+        self._optimizer_found_inf.clear()
+        if not self._dynamic:
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1

@@ -461,32 +461,68 @@ def _p2p_group(a, b):
 
 _P2P_INBOX: dict[int, list] = {}  # peer process index -> FIFO of received arrays
 
+_P2P_MAX_NDIM = 8
+_META_BYTES = 1 + 16 + 1 + 8 * _P2P_MAX_NDIM  # flag | dtype str | ndim | dims
+
+
+def _pack_meta(local_np, is_send):
+    """Fixed-size metadata block: the SendRecvMeta handshake of the reference
+    (pp_utils/p2p_communication.py:53), carried in-band every exchange."""
+    meta = np.zeros(_META_BYTES, np.uint8)
+    meta[0] = 1 if is_send else 0
+    dt = np.dtype(local_np.dtype).str.encode()[:16]
+    meta[1:1 + len(dt)] = np.frombuffer(dt, np.uint8)
+    if local_np.ndim > _P2P_MAX_NDIM:
+        raise ValueError(f"send/recv supports <= {_P2P_MAX_NDIM} dims")
+    meta[17] = local_np.ndim
+    dims = np.asarray(local_np.shape, np.int64)
+    meta[18:18 + 8 * local_np.ndim] = np.frombuffer(dims.tobytes(), np.uint8)
+    return meta
+
+
+def _unpack_meta(meta):
+    flag = bool(meta[0])
+    dtype = np.dtype(bytes(meta[1:17]).rstrip(b"\x00").decode())
+    ndim = int(meta[17])
+    dims = np.frombuffer(bytes(meta[18:18 + 8 * ndim]), np.int64)
+    return flag, dtype, tuple(int(d) for d in dims)
+
 
 def _pair_exchange(peer, local_np, is_send):
     """One order-matched exchange on the (me, peer) pair.
 
-    Every send/recv call on a pair enters the SAME 2-rank gather program (a
-    multi-controller requirement: both processes must run identical
-    executables), carrying (send-flag, payload) both ways. A peer's flagged
-    payload is queued in a per-pair FIFO inbox, so MPI-style matching holds:
-    the n-th send on one side reaches the n-th recv on the other, including
-    the both-sides-send-first pattern. Ordering across *different* pairs is
-    the caller's job (classic blocking-ring hazard: stagger even/odd, or use
-    the compiled path's lax.ppermute — the performant TPU route anyway)."""
+    Two phases, both entering the SAME 2-rank gather program on both
+    processes (a multi-controller requirement: identical executables):
+      1. a fixed-size metadata gather — (send-flag, dtype, shape) both ways;
+      2. a payload gather padded to the larger side's byte size, so
+         mismatched send/recv buffers cannot corrupt or crash inside the
+         array-stacking machinery — the receiver reconstructs with the
+         SENDER's dtype/shape and the recv() caller validates.
+    A peer's flagged payload is queued in a per-pair FIFO inbox, so
+    MPI-style matching holds: the n-th send on one side reaches the n-th
+    recv on the other, including the both-sides-send-first pattern.
+    Ordering across *different* pairs is the caller's job (classic
+    blocking-ring hazard: stagger even/odd, or use the compiled path's
+    lax.ppermute — the performant TPU route anyway)."""
     me = jax.process_index()
     g = _p2p_group(me, peer)
-    # ONE gather carries [send-flag byte, payload bytes] — dtype-preserving
-    local_np = np.ascontiguousarray(local_np)
-    flat = np.concatenate(
-        [np.asarray([1 if is_send else 0], np.uint8),
-         np.frombuffer(local_np.tobytes(), dtype=np.uint8)]
-    )
-    out = np.asarray(stacked_collective("gather", _stack_local(g, flat), g._devices))
     pidx = g.get_group_rank(peer)
-    if out[pidx][0]:
+    local_np = np.ascontiguousarray(local_np)
+
+    meta_out = np.asarray(
+        stacked_collective("gather", _stack_local(g, _pack_meta(local_np, is_send)), g._devices)
+    )
+    peer_flag, peer_dtype, peer_shape = _unpack_meta(meta_out[pidx])
+    peer_bytes = int(peer_dtype.itemsize * int(np.prod(peer_shape, dtype=np.int64)))
+
+    pad = max(local_np.nbytes, peer_bytes)
+    flat = np.zeros(pad, np.uint8)
+    flat[: local_np.nbytes] = np.frombuffer(local_np.tobytes(), dtype=np.uint8)
+    out = np.asarray(stacked_collective("gather", _stack_local(g, flat), g._devices))
+    if peer_flag:
         payload = np.frombuffer(
-            np.ascontiguousarray(out[pidx][1:]).tobytes(), dtype=local_np.dtype
-        ).reshape(local_np.shape)
+            np.ascontiguousarray(out[pidx][:peer_bytes]).tobytes(), dtype=peer_dtype
+        ).reshape(peer_shape)
         _P2P_INBOX.setdefault(peer, []).append(payload)
 
 
@@ -500,14 +536,33 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return tensor
 
 
+_RECV_MAX_POLLS = 100000  # diagnostic bound for send/recv sequence mismatches
+
+
 def recv(tensor, src=0, group=None, sync_op=True):
     me = jax.process_index()
     if me == src:
         raise ValueError("cannot recv from self")
     inbox = _P2P_INBOX.setdefault(src, [])
+    polls = 0
     while not inbox:
         _pair_exchange(src, _to_host(tensor), False)
-    return _set_result(tensor, inbox.pop(0))
+        polls += 1
+        if polls >= _RECV_MAX_POLLS:
+            raise RuntimeError(
+                f"recv(src={src}) polled {polls} exchanges without a matching "
+                "send — the peer's send/recv sequence is out of step with "
+                "this process (both sides waiting in recv?)"
+            )
+    payload = inbox.pop(0)
+    want = _to_host(tensor)
+    if payload.shape != want.shape or payload.dtype != want.dtype:
+        raise RuntimeError(
+            f"recv(src={src}) buffer mismatch: peer sent "
+            f"{payload.dtype}{list(payload.shape)}, local buffer is "
+            f"{want.dtype}{list(want.shape)}"
+        )
+    return _set_result(tensor, payload)
 
 
 class _CompletedTask:

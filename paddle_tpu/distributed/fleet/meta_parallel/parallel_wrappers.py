@@ -214,8 +214,18 @@ class PipelineParallel(Layer):
             and ins_t.shape[0] % n == 0
             and (not isinstance(lab_t, Tensor) or lab_t.shape[0] % n == 0)
         )
-        if self._pipe is None and self._hcg is not None:
-            self._pipe = (self._build_pipe() if batch_ok else None) or False
+        # the closure cache is structure-dependent only; batch divisibility is
+        # re-decided per call so one odd batch doesn't disable 1F1B forever
+        if self._pipe is None and self._hcg is not None and batch_ok:
+            self._pipe = self._build_pipe() or False
+            if self._pipe is False:
+                import warnings
+
+                warnings.warn(
+                    "PipelineParallel: model has no homogeneous trunk whose "
+                    "length divides the pp degree; train_batch falls back to "
+                    "sequential microbatch accumulation (no pipeline speedup)."
+                )
         if self._pipe and batch_ok:
             import numpy as np
 

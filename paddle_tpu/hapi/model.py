@@ -153,7 +153,9 @@ class Model:
             return self._train_batch_eager(ins, labs)
         params, buffers = state_dict_arrays(self.network)
         if self._opt_state is None:
-            self._opt_state = self._optimizer.init_state_arrays(params)
+            self._opt_state = self._optimizer.state_arrays_for(
+                self.network.named_parameters_dict()
+            )
         key = self._shapes_key("train", ins + labs)
         if key not in self._compiled_steps:
             self._compiled_steps[key] = self._make_train_step(len(ins), len(labs))
@@ -164,6 +166,10 @@ class Model:
         load_state_arrays(self.network, params=new_params, buffers=new_buf)
         self._opt_state = new_opt
         self._optimizer._step_count += 1
+        # keep eager accumulators in sync so state_dict()/save emit real slots
+        self._optimizer.sync_state_arrays(
+            self.network.named_parameters_dict(), new_opt
+        )
         metrics = self._update_metrics(outs, labs)
         loss_val = [float(np.asarray(loss))]
         if metrics:
@@ -398,7 +404,9 @@ class Model:
         opt_path = path + ".pdopt"
         if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
             self._optimizer.set_state_dict(fload(opt_path))
-        self._opt_state = None  # re-seeded from optimizer accumulators lazily
+        # re-seeded from optimizer accumulators on the next train_batch via
+        # Optimizer.state_arrays_for (set_state_dict filled _accumulators)
+        self._opt_state = None
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters()

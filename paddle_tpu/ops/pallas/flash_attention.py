@@ -466,7 +466,41 @@ def _flash_custom(causal, bq, bk, dropout_p, has_mask, mask_b, mask_h, interpret
         dq = _bhsd_to_bshd(dqf, b, h)
         dk = _bhsd_to_bshd(dkf, b, h)
         dv = _bhsd_to_bshd(dvf, b, h)
-        dmask = jnp.zeros((mask_b, mask_h) + (qf.shape[1], kf.shape[1]), jnp.float32) if has_mask else None
+        dmask = None
+        if has_mask:
+            # d loss/d mask = p * (dp - delta), recomputed in plain XLA from
+            # the saved lse (no extra softmax pass). XLA dead-code-eliminates
+            # this whole block whenever the mask cotangent is unused, so
+            # non-trainable masks pay nothing; trainable additive biases
+            # (e.g. relative-position bias) get exact gradients. dropout>0
+            # never reaches here (dispatch falls back to XLA for mask+dropout
+            # since the in-kernel PRNG stream is not reproducible outside).
+            sq, sk = qf.shape[1], kf.shape[1]
+            d = qf.shape[2]
+            scale = 1.0 / np.sqrt(d)
+            s = jax.lax.dot_general(
+                qf.astype(jnp.float32), kf.astype(jnp.float32),
+                (((2,), (2,)), ((0,), (0,))),
+            ) * scale
+            if causal:
+                mask_c = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+                s = jnp.where(mask_c[None], s, _NEG_INF)
+            s = s + mf.astype(jnp.float32)
+            p = jnp.exp(s - lse[:, 0, :][:, :, None])
+            dp = jax.lax.dot_general(
+                gf.astype(jnp.float32), vf.astype(jnp.float32),
+                (((2,), (2,)), ((0,), (0,))),
+            )
+            delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32), -1)
+            dsm = (p * (dp - delta[:, :, None])).reshape(b, h, sq, sk)
+            # reduce over whichever dims the mask broadcasts (b==1 keeps
+            # (1,H,...) masks possible when the batch itself is 1)
+            axes = ()
+            if mask_b == 1:
+                axes += (0,)
+            if mask_h == 1:
+                axes += (1,)
+            dmask = dsm.sum(axis=axes, keepdims=True) if axes else dsm
         return dq, dk, dv, dmask, None
 
     flash.defvjp(fwd, bwd)
@@ -502,6 +536,11 @@ def flash_attention_array(
         else:
             mf = mask
     drop_ok = dropout_p == 0.0 or dropout_key is not None
+    if dropout_p > 0.0 and mask is not None:
+        # mask gradients require recomputing ds outside the kernel, which is
+        # impossible with the in-kernel dropout PRNG — keep semantics uniform
+        # by using the XLA path for the (rare) mask+dropout combination
+        mask_ok = False
     if (
         mask_ok and drop_ok
         and sq % bq == 0 and sk % bk == 0

@@ -155,6 +155,28 @@ class Optimizer:
     def init_state_arrays(self, params: dict):
         return {k: self._init_slots(a) for k, a in params.items()}
 
+    def state_arrays_for(self, named_params: dict):
+        """Compiled-path state seeded from eager accumulators when present.
+
+        Checkpoint-resume parity (reference optimizer.state_dict round-trip,
+        /root/reference/python/paddle/optimizer/optimizer.py): after
+        `set_state_dict` populated `_accumulators`, a compiled train step must
+        continue from those slots, not fresh zeros.
+        """
+        out = {}
+        for k, p in named_params.items():
+            st = self._accumulators.get(id(p))
+            out[k] = dict(st) if st else self._init_slots(p._array)
+        return out
+
+    def sync_state_arrays(self, named_params: dict, state: dict):
+        """Write compiled-path optimizer state back into eager accumulators
+        so `state_dict()` (and hence Model.save) sees real slot values."""
+        for k, p in named_params.items():
+            st = state.get(k)
+            if st:
+                self._accumulators[id(p)] = dict(st)
+
     def apply_gradients_arrays(self, params: dict, grads: dict, state: dict, lr=None, grad_scale=None):
         """Pure: returns (new_params, new_state). Used inside jit."""
         lr = jnp.asarray(self.get_lr(), jnp.float32) if lr is None else lr
@@ -191,7 +213,9 @@ class Optimizer:
     # ---- checkpointing ------------------------------------------------------
     def state_dict(self):
         sd = OrderedDict()
+        order = []
         for i, p in enumerate(self._params):
+            order.append(p.name)
             st = self._accumulators.get(id(p))
             if st:
                 for slot, arr in st.items():
@@ -199,19 +223,32 @@ class Optimizer:
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         sd["@step"] = self._step_count
+        # param names are process-local; the saved ordering lets a fresh
+        # optimizer instance match slots positionally on load
+        sd["@param_order"] = order
         return sd
 
     def set_state_dict(self, state_dict):
         self._step_count = int(state_dict.get("@step", 0))
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
-        for p in self._params:
+        order = state_dict.get("@param_order")
+        for i, p in enumerate(self._params):
+            # positional name first: auto-generated names are process-local,
+            # and an overlapping-but-shifted name could alias another param
+            names = []
+            if order is not None and i < len(order):
+                names.append(order[i])
+            if p.name not in names:
+                names.append(p.name)
             slots = {}
             for slot in self._slot_names:
-                k = f"{p.name}_{slot}"
-                if k in state_dict:
-                    v = state_dict[k]
-                    slots[slot] = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+                for nm in names:
+                    k = f"{nm}_{slot}"
+                    if k in state_dict:
+                        v = state_dict[k]
+                        slots[slot] = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+                        break
             if slots:
                 st = self._init_slots(p._array)
                 st.update(slots)

@@ -178,6 +178,14 @@ def one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches, labels, mesh,
     prologue outside the pipeline (embedding) can backprop through it (see
     pipeline_train_loss's custom_vjp).
 
+    Known cost of the uniform SPMD schedule: head_loss (for GPT, the vocab
+    unembedding matmul fwd+bwd) is evaluated every cycle on every stage and
+    discarded except on the last stage's active backward steps — about
+    P*(M+2P-2)/M times the necessary head compute. Keeping the head inside
+    the per-cycle vjp is what lets its gradient fuse into the same scan;
+    lax.cond cannot skip it under SPMD (all branches compile in). Shrink the
+    head (e.g. factorized unembedding) if this dominates at small M.
+
     Returns (mean_loss, param_grads[, head_grads][, input_grads]) with grads
     scaled 1/M — numerically the grads of mean-over-microbatch loss.
     """
@@ -350,12 +358,34 @@ def interleaved_one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches,
                             reduce_axes=()):
     """Interleaved-virtual-stage 1F1B (reference pipeline_parallel.py:461):
     each device hosts V = n_chunks model chunks; chunk c of device s is
-    virtual stage g = c*P + s of a depth-V*P pipeline. The schedule is the
-    1F1B gated-cycle machinery over virtual depth V*P; the ring ppermute's
-    wrap-around edge (device P-1 -> device 0) carries an activation from
-    chunk c into chunk c+1 (and the mirrored edge carries gradient signals
-    back). Activation buffers hold 2*V*P microbatch inputs per chunk —
-    still independent of M.
+    virtual stage g = c*P + s of a depth-V*P pipeline.
+
+    Schedule (Megatron-style modular timing, in chunk-cycles — each cycle a
+    device runs exactly ONE chunk forward and ONE chunk backward, with the
+    chunk index selected dynamically):
+
+      forward of mb i at virtual stage g = c*P + s:
+        t_f = s + c*P + (i mod P) + V*P*(i div P)
+      backward of mb i at virtual stage g:
+        t_b = (V*P-1-g) + (i mod P) + V*P*(i div P) + (V*P-1)
+
+    Per device the forward cycles r = t - s decompose uniquely as
+    r = (i div P)*VP + c*P + (i mod P), so forwards are dense in t (one per
+    cycle) and likewise backwards — T = M*V + V*P + P - 2 chunk-cycles.
+    Since a chunk-cycle costs (tf+tb)/V of a full stage, the bubble is
+    (P + (P-2)/V) * (tf+tb) versus plain 1F1B's (2P-2)*(tf+tb): a
+    (1+1/V)/2 reduction (V=2: 25%, V->inf: 50%). This is the best the
+    uniform gated-cycle XLA form allows — the reference's asymmetric
+    warmup/cooldown (forward-only cycles costing tf, not tf+tb) would get
+    closer to the paper's 1/V but needs data-dependent cycle shapes XLA
+    can't compile into one scan.
+
+    The ring ppermute's wrap-around edge (device P-1 -> device 0) carries an
+    activation from chunk c to chunk c+1 (and the mirrored edge carries
+    gradient signals back); the modular timing makes the hand-off line up
+    exactly (r advances by P across the wrap, stepping c by one).
+    Activation ring buffers hold 2*P microbatch inputs per chunk (slot =
+    i mod 2P; re-use distance V*2P cycles > the 2(VP-1) live window).
 
     stacked_params / params_specs: leaves [P, V, ...] (stack_interleaved_params).
     Returns (mean_loss, grads[P, V, ...]).
@@ -368,8 +398,11 @@ def interleaved_one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches,
     if label_spec is None:
         label_spec = io_spec
     M = microbatches.shape[0]
-    B = 2 * VP
-    T = M + 2 * VP - 2
+    B = 2 * n_stages  # per-chunk ring-buffer slots
+    # run through the LAST backward: mb M-1 at virtual stage g=0 fires at
+    # t = (VP-1) + ((M-1) mod P) + VP*((M-1) div P) + (VP-1); for M a
+    # multiple of P this reduces to M*V + VP + P - 3
+    T = 2 * VP - 1 + ((M - 1) % n_stages) + VP * ((M - 1) // n_stages)
 
     def per_stage(params_local, mbs, labs):
         params_here = jax.tree_util.tree_map(lambda a: a[0], params_local)  # [V, ...]
@@ -378,68 +411,68 @@ def interleaved_one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches,
         ring_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
 
         def chunk_params(c):
+            # c is traced: dynamic slice into the [V, ...] leaves
             return jax.tree_util.tree_map(lambda a: a[c], params_here)
 
         def cycle(carry, t):
             fwd_in, bwd_in, buf, gacc, loss_acc = carry
-            # fwd_in/bwd_in: [V, mb...]; buf: [V, B, mb...]
+            # fwd_in/bwd_in: [mb...] single slots; buf: [V, B, mb...]
 
-            ys, new_bufs = [], []
-            for c in range(V):
-                g = c * n_stages + s
-                i_f = t - g
-                fwd_active = (i_f >= 0) & (i_f < M)
-                inject = mbs[jnp.clip(i_f, 0, M - 1)]
-                x_in = jnp.where(g == 0, inject, fwd_in[c])
-                y = stage_fn(chunk_params(c), x_in)
-                slot = jnp.clip(i_f, 0, M - 1) % B
-                new_bufs.append(
-                    buf[c].at[slot].set(jnp.where(fwd_active, x_in, buf[c][slot]))
-                )
-                ys.append(y)
-            buf = jnp.stack(new_bufs)
-            handed = jax.lax.ppermute(jnp.stack(ys), axis, ring_fwd)
-            # wrap-around edge: device 0 receives device P-1's chunk c as its
-            # chunk c+1 input (virtual boundary c*P+P-1 -> (c+1)*P)
-            shifted = jnp.concatenate([jnp.zeros_like(handed[:1]), handed[:-1]], 0)
-            fwd_in = jnp.where(s == 0, shifted, handed)
+            # ---- forward micro-step: decompose r = t - s ----------------
+            r_f = t - s
+            blk_f = jnp.floor_divide(r_f, VP)
+            rem_f = jnp.mod(r_f, VP)
+            c_f = jnp.clip(jnp.floor_divide(rem_f, n_stages), 0, V - 1)
+            i_f = blk_f * n_stages + jnp.mod(rem_f, n_stages)
+            fwd_active = (r_f >= 0) & (i_f >= 0) & (i_f < M)
+            i_fc = jnp.clip(i_f, 0, M - 1)
+            inject = mbs[i_fc]
+            g_f = c_f * n_stages + s
+            x_in = jnp.where(g_f == 0, inject, fwd_in)
+            y = stage_fn(chunk_params(c_f), x_in)
+            slot_f = jnp.mod(i_fc, B)
+            buf = buf.at[c_f, slot_f].set(
+                jnp.where(fwd_active, x_in, buf[c_f, slot_f])
+            )
+            fwd_out = jax.lax.ppermute(y, axis, ring_fwd)
 
-            dxs = []
-            new_gacc, new_loss = gacc, loss_acc
-            for c in range(V):
-                g = c * n_stages + s
-                i_b = t - (2 * VP - 2 - g)
-                bwd_active = (i_b >= 0) & (i_b < M)
-                is_last = g == VP - 1
-                x_saved = buf[c][jnp.clip(i_b, 0, M - 1) % B]
-                yb, vjp_fn = jax.vjp(
-                    lambda p_, x_: stage_fn(p_, x_), chunk_params(c), x_saved
-                )
-                lab = jax.tree_util.tree_map(
-                    lambda l: l[jnp.clip(i_b, 0, M - 1)], labs
-                )
-                loss_j, dy_last = jax.value_and_grad(
-                    lambda yy: loss_fn(yy, lab).astype(jnp.float32)
-                )(yb)
-                gcot = jnp.where(is_last, dy_last.astype(yb.dtype), bwd_in[c])
-                dp, dx = vjp_fn(gcot)
-                new_gacc = jax.tree_util.tree_map(
-                    lambda acc, d, c=c, act=bwd_active: acc.at[c].set(
-                        jnp.where(act, acc[c] + d, acc[c])
-                    ),
-                    new_gacc, dp,
-                )
-                new_loss = new_loss + jnp.where(bwd_active & is_last, loss_j, 0.0)
-                dxs.append(dx)
-            handed_b = jax.lax.ppermute(jnp.stack(dxs), axis, ring_bwd)
-            # mirrored wrap-around: device P-1 receives device 0's chunk c+1
-            # signal as its chunk c (virtual (c+1)*P -> c*P+P-1)
-            shifted_b = jnp.concatenate([handed_b[1:], jnp.zeros_like(handed_b[:1])], 0)
-            bwd_in = jnp.where(s == n_stages - 1, shifted_b, handed_b)
+            # ---- backward micro-step: unique c_b with
+            #      (r_b + c_b*P) mod VP < P -------------------------------
+            r_b = t + s + 2 - 2 * VP
+            q_b = jnp.mod(r_b, VP)
+            c_b = jnp.clip(
+                jnp.mod(V - jnp.floor_divide(q_b, n_stages), V), 0, V - 1
+            )
+            u_b = r_b + c_b * n_stages
+            i_b = (
+                jnp.floor_divide(u_b, VP) * n_stages + jnp.mod(q_b, n_stages)
+            )
+            bwd_active = (u_b >= 0) & (i_b >= 0) & (i_b < M)
+            g_b = c_b * n_stages + s
+            is_last = g_b == VP - 1
+            i_bc = jnp.clip(i_b, 0, M - 1)
+            x_saved = buf[c_b, jnp.mod(i_bc, B)]
+            yb, vjp_fn = jax.vjp(
+                lambda p_, x_: stage_fn(p_, x_), chunk_params(c_b), x_saved
+            )
+            lab = jax.tree_util.tree_map(lambda l: l[i_bc], labs)
+            loss_j, dy_last = jax.value_and_grad(
+                lambda yy: loss_fn(yy, lab).astype(jnp.float32)
+            )(yb)
+            gcot = jnp.where(is_last, dy_last.astype(yb.dtype), bwd_in)
+            dp, dx = vjp_fn(gcot)
+            gacc = jax.tree_util.tree_map(
+                lambda acc, d: acc.at[c_b].set(
+                    jnp.where(bwd_active, acc[c_b] + d, acc[c_b])
+                ),
+                gacc, dp,
+            )
+            loss_acc = loss_acc + jnp.where(bwd_active & is_last, loss_j, 0.0)
+            bwd_out = jax.lax.ppermute(dx, axis, ring_bwd)
 
-            return (fwd_in, bwd_in, buf, new_gacc, new_loss), None
+            return (fwd_out, bwd_out, buf, gacc, loss_acc), None
 
-        zero_mb = jnp.zeros((V,) + mbs.shape[1:], mbs.dtype)
+        zero_mb = jnp.zeros_like(mbs[0])
         init = (
             zero_mb,
             zero_mb,

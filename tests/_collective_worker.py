@@ -108,6 +108,37 @@ if NRANKS == 2:
     dist.recv(buf, src=peer)
     np.testing.assert_allclose(buf.numpy(), rank_val(peer, base=9.0))
 
+# mismatched send/recv buffers: the metadata handshake must raise a clear
+# error on the receiver, not corrupt or crash inside array stacking
+if NRANKS == 2:
+    if RANK == 0:
+        dist.send(paddle.to_tensor(np.ones((2, 3), np.float32)), dst=1)
+    else:
+        buf = paddle.to_tensor(np.zeros(4, dtype=np.float32))  # wrong shape
+        try:
+            dist.recv(buf, src=0)
+            raise AssertionError("recv of mismatched shape did not raise")
+        except RuntimeError as e:
+            assert "mismatch" in str(e), e
+
+    # same-size different-dtype mismatch, reversed direction (the first
+    # block already exercised the padded unequal-byte-size exchange)
+    if RANK == 1:
+        dist.send(paddle.to_tensor(np.arange(4, dtype=np.int32)), dst=0)
+    else:
+        buf = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+        try:
+            dist.recv(buf, src=1)
+            raise AssertionError("recv of mismatched dtype did not raise")
+        except RuntimeError as e:
+            assert "mismatch" in str(e), e
+
+    # after the failed matches the pair stream stays usable
+    dist.send(paddle.to_tensor(rank_val(RANK, base=21.0)), dst=peer)
+    buf = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+    dist.recv(buf, src=peer)
+    np.testing.assert_allclose(buf.numpy(), rank_val(peer, base=21.0))
+
 # subgroup: new_group([0]) — rank 1 is not a member, collective is a no-op
 g0 = dist.new_group([0])
 t = paddle.to_tensor(rank_val(RANK))

@@ -95,7 +95,57 @@ def test_grad_scaler_dynamic():
     loss = (w * 1.0).sum()
     scaler.scale(loss).backward()
     scaler.step(opt)
+    scaler.update()
     assert scaler._scale == 8.0  # grew after a good step
+
+
+def test_grad_scaler_single_unscale_with_clip():
+    # documented pattern: unscale_ -> clip -> step must divide by scale ONCE
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0, use_dynamic_loss_scaling=False)
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    loss = (w * 2.0).sum()  # dL/dw = 2
+    scaler.scale(loss).backward()  # grad = 8
+    scaler.unscale_(opt)  # grad = 2 (back to true)
+    scaler.step(opt)  # must NOT unscale again
+    scaler.update()
+    assert abs(w.numpy()[0] - (1.0 - 0.1 * 2.0)) < 1e-6
+
+    # double unscale_ raises
+    loss2 = (w * 2.0).sum()
+    scaler.scale(loss2).backward()
+    scaler.unscale_(opt)
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        scaler.unscale_(opt)
+    scaler.step(opt)
+    with pytest.raises(RuntimeError):
+        scaler.step(opt)
+    scaler.update()  # resets state machine
+    opt.clear_grad()
+
+
+def test_grad_scaler_two_optimizers_independent_inf():
+    """One optimizer's inf grads must not be erased by another's clean
+    unscale_: opt1 skips its step, opt2 still steps, the scale backs off."""
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    w1 = paddle.Parameter(np.array([1.0], np.float32))
+    w2 = paddle.Parameter(np.array([1.0], np.float32))
+    opt1 = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w1])
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w2])
+    import jax.numpy as jnp
+
+    w1._grad = jnp.asarray(np.array([np.inf], np.float32))
+    w2._grad = jnp.asarray(np.array([2.0], np.float32))
+    scaler.unscale_(opt1)
+    scaler.unscale_(opt2)  # clean — must not clear opt1's inf
+    scaler.step(opt1)
+    scaler.step(opt2)
+    scaler.update()
+    assert w1.numpy()[0] == 1.0  # skipped
+    assert abs(w2.numpy()[0] - (1.0 - 0.1 * 1.0)) < 1e-6  # grad 2/scale 2
+    assert scaler._scale == 1.0  # backed off from 2.0
 
 
 def test_pylayer():
@@ -175,3 +225,50 @@ def test_flash_attention_pallas_interpret():
         assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
     finally:
         del os.environ["PADDLE_TPU_PALLAS_INTERPRET"]
+
+
+def test_flash_attention_mask_grad_matches_xla():
+    """Pallas path must differentiate an additive mask (e.g. a trainable
+    relative-position bias) identically to the XLA fallback."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _attention_xla,
+        flash_attention_array,
+    )
+
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.rand(2, 128, 2, 16).astype(np.float32))
+    k = jnp.asarray(rs.rand(2, 128, 2, 16).astype(np.float32))
+    v = jnp.asarray(rs.rand(2, 128, 2, 16).astype(np.float32))
+
+    q1, k1, v1 = q[:1], k[:1], v[:1]  # batch-1: a (1,H) mask reaches the
+    # kernel un-broadcast (mask_b=1, mask_h=H)
+    cases = [
+        (q, k, v, (1, 1, 128, 128)),
+        (q, k, v, (2, 2, 128, 128)),
+        (q, k, v, (2, 1, 128, 128)),
+        (q, k, v, (1, 2, 128, 128)),
+        (q1, k1, v1, (1, 2, 128, 128)),
+    ]
+    for qq, kk, vv, mshape in cases:
+        mask = jnp.asarray(rs.randn(*mshape).astype(np.float32) * 0.5)
+
+        def loss_pallas(m):
+            os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+            try:
+                return flash_attention_array(qq, kk, vv, mask=m).sum()
+            finally:
+                del os.environ["PADDLE_TPU_PALLAS_INTERPRET"]
+
+        def loss_xla(m):
+            return _attention_xla(qq, kk, vv, mask=m).sum()
+
+        g_pallas = jax.grad(loss_pallas)(mask)
+        g_xla = jax.grad(loss_xla)(mask)
+        assert g_pallas.shape == mask.shape
+        assert np.abs(np.asarray(g_xla)).max() > 1e-4  # non-trivial gradient
+        assert np.allclose(np.asarray(g_pallas), np.asarray(g_xla), atol=2e-3), mshape

@@ -128,6 +128,36 @@ class Test1F1B:
                 np.asarray(grads[k]), np.asarray(rgs[k]), rtol=1e-4, atol=1e-6
             )
 
+    def test_interleaved_microbatches_not_multiple_of_stages(self):
+        """M % P != 0 must still schedule every backward (the scan length
+        accounts for the partial final block)."""
+        mesh = init_mesh({"pp": 2})
+        vstages = _mlp_stages(4, seed=2)  # V=2 x P=2
+        x_all, labs_all = _data(3)
+        m = 5  # odd vs P=2
+        x, labs = x_all[:m], labs_all[:m]
+        loss, grads = interleaved_one_f_one_b(
+            _stage_fn, _loss_fn, stack_interleaved_params(vstages, 2), x, labs,
+            mesh, n_chunks=2,
+        )
+
+        def ref(stages_list):
+            tot = 0.0
+            for i in range(m):
+                h = x[i]
+                for p in stages_list:
+                    h = _stage_fn(p, h)
+                tot = tot + _loss_fn(h, labs[i])
+            return tot / m
+
+        rl, rg = jax.value_and_grad(ref)(vstages)
+        np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+        rgs = stack_interleaved_params(rg, 2)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(rgs[k]), rtol=1e-4, atol=1e-6
+            )
+
 
 class TestGPTSchedules:
     def _train(self, degrees, sched, steps=3):
