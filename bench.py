@@ -91,6 +91,14 @@ def main():
     lr = jnp.asarray(1e-4, jnp.float32)
     rs = np.random.RandomState(0)
 
+    # host snapshot: donation invalidates device buffers, so any retry after
+    # a mid-step failure must re-materialize state from host copies
+    snap = jax.tree_util.tree_map(np.asarray, (params, buffers, opt_state))
+
+    def restore_state():
+        nonlocal params, buffers, opt_state
+        params, buffers, opt_state = jax.tree_util.tree_map(jnp.asarray, snap)
+
     def run(batch, iters):
         nonlocal params, buffers, opt_state
         ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
@@ -109,15 +117,42 @@ def main():
         return batch * seq * iters / dt
 
     sweep = {}
-    batches = (8, 16, 32) if on_tpu else (2,)
+    errors = []
+    batches = (16, 32, 64) if on_tpu else (2,)
     iters = 20 if on_tpu else 3
+    max_attempts = 3
+    oom = False
     for b in batches:
-        try:
-            sweep[b] = run(b, iters)
-        except Exception:  # OOM at large batch: keep what we have
-            if not sweep:
-                raise
+        for attempt in range(max_attempts):
+            try:
+                sweep[b] = run(b, iters)
+                break
+            except Exception as e:  # noqa: BLE001 — a red bench gate helps no one
+                msg = f"{type(e).__name__}: {e}"
+                errors.append(f"batch={b} attempt={attempt + 1}: {msg[:300]}")
+                restore_state()
+                if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+                    oom = True
+                    break  # OOM is deterministic — larger batches will too
+                # transient (remote-compile transport, tunnel resets): back
+                # off and retry; the compile cache makes retries cheap
+                time.sleep(5.0 * (attempt + 1))
+        if oom:
             break
+
+    if not sweep:
+        print(
+            json.dumps(
+                {
+                    "metric": "gpt_train_tokens_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "tokens/sec",
+                    "vs_baseline": 0.0,
+                    "errors": errors,
+                }
+            )
+        )
+        return 1
     best_batch = max(sweep, key=sweep.get)
     tokens_per_sec = sweep[best_batch]
 
@@ -125,19 +160,19 @@ def main():
     peak = _peak_flops(jax.devices()[0])
     mfu = tokens_per_sec * flops_per_token / peak
 
-    print(
-        json.dumps(
-            {
-                "metric": "gpt_train_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/sec",
-                "vs_baseline": 1.0,
-                "mfu": round(mfu, 4),
-                "batch": best_batch,
-                "sweep": {str(k): round(v, 1) for k, v in sweep.items()},
-            }
-        )
-    )
+    out = {
+        "metric": "gpt_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+        "mfu": round(mfu, 4),
+        "batch": best_batch,
+        "sweep": {str(k): round(v, 1) for k, v in sweep.items()},
+    }
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":

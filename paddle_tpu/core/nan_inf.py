@@ -1,0 +1,77 @@
+"""FLAGS_check_nan_inf: numerical guards on layer outputs.
+
+Reference parity: /root/reference/paddle/fluid/framework/operator.cc:1666 and
+details/nan_inf_utils_detail.cc:177 hook every op output when the flag is on.
+
+TPU-native design: the check hooks `nn.Layer.__call__` (every layer's output,
+eager AND traced — under jit the layer forward runs inside the trace, so the
+guard compiles into the step). Concrete arrays are checked on the spot with a
+clear RuntimeError naming the layer; traced arrays go through
+`jax.debug.callback`, whose raised error surfaces when the compiled step
+synchronizes. Debug mode only — the callback forces a host round-trip per
+guarded value.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_float(arr) -> bool:
+    try:
+        return jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(
+            arr.dtype, jnp.complexfloating
+        )
+    except Exception:
+        return False
+
+
+def _host_check(name, value):
+    arr = np.asarray(value)
+    try:
+        finite = np.isfinite(arr)  # native dtype: complex checks both parts,
+        # f64 is not squashed to f32 (1e200 is finite)
+    except TypeError:  # dtypes numpy can't isfinite (e.g. exotic ml_dtypes)
+        finite = np.isfinite(arr.astype(np.float32))
+    if not finite.all():
+        isnan = np.isnan(arr)
+        n_nan = int(isnan.sum())
+        n_inf = int((~finite).sum()) - n_nan
+        raise RuntimeError(
+            f"FLAGS_check_nan_inf: non-finite values in {name} "
+            f"(shape {list(value.shape)}: {n_nan} nan, {n_inf} inf)"
+        )
+
+
+def check_array(arr, name: str):
+    """Raise (eager) or register a compiled-in check (traced) if non-finite."""
+    if not _is_float(arr):
+        return arr
+    if isinstance(arr, jax.core.Tracer):
+        jax.debug.callback(_host_check, name, arr)
+        return arr
+    _host_check(name, arr)
+    return arr
+
+
+def check_layer_outputs(layer, outputs):
+    """Post-forward hook body: guard every float Tensor/array output."""
+    from .tensor import Tensor
+
+    name = type(layer).__name__
+    ln = getattr(layer, "_full_name", None) or getattr(layer, "_name", None)
+    label = f"{name}({ln})" if ln else name
+
+    def visit(x):
+        if isinstance(x, Tensor):
+            check_array(x._array, f"{label} output")
+        elif isinstance(x, jax.Array):
+            check_array(x, f"{label} output")
+        return x
+
+    jax.tree_util.tree_map(
+        visit, outputs, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    return outputs

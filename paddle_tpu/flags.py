@@ -14,8 +14,10 @@ _DEFS = {
     "FLAGS_benchmark": (False, "synchronize after each eager op (timing mode)"),
     "FLAGS_eager_delete_tensor_gb": (0.0, "no-op on TPU (XLA owns buffers)"),
     "FLAGS_use_pallas_attention": (True, "route attention through the Pallas flash kernel"),
-    "FLAGS_pallas_block_q": (128, "flash attention q tile"),
-    "FLAGS_pallas_block_k": (128, "flash attention k tile"),
+    # tuned on v5e: large k tiles amortize per-grid-step overhead; the
+    # bf16-multiply/f32-accumulate MXU path needs no input upcast
+    "FLAGS_pallas_block_q": (256, "flash attention q tile"),
+    "FLAGS_pallas_block_k": (1024, "flash attention k tile"),
     "FLAGS_log_compiles": (False, "log XLA compilations"),
     "FLAGS_allocator_strategy": ("auto_growth", "accepted for parity; PjRt allocates"),
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, "accepted for parity"),
@@ -58,6 +60,7 @@ def set_flags(flags: dict):
             raise ValueError(f"unknown flag {n}")
         default, _ = _DEFS[n]
         _VALUES[n] = type(default)(v) if not isinstance(default, bool) else bool(v)
+    _CACHE.clear()
     # apply side effects
     if flags.get("FLAGS_log_compiles") is not None:
         import jax
@@ -65,5 +68,15 @@ def set_flags(flags: dict):
         jax.config.update("jax_log_compiles", bool(flags["FLAGS_log_compiles"]))
 
 
+_CACHE = {}
+
+
 def flag(name):
-    return get_flags(name)[name]
+    """Cached single-flag read — safe for per-op hot paths (Layer.__call__).
+    The cache is invalidated by set_flags; env-var changes after the first
+    read are not observed (process-level flags, reference gflags semantics)."""
+    if name in _CACHE:
+        return _CACHE[name]
+    v = get_flags(name)[name]
+    _CACHE[name] = v
+    return v

@@ -14,6 +14,7 @@ import numpy as np
 
 from ..core.dtypes import convert_dtype, get_default_dtype
 from ..core.tensor import Parameter, Tensor
+from ..flags import flag as _flag
 from ..framework.param_attr import ParamAttr
 from . import initializer as I
 
@@ -237,6 +238,10 @@ class Layer:
             result = hook(self, inputs, outputs)
             if result is not None:
                 outputs = result
+        if _flag("FLAGS_check_nan_inf"):
+            from ..core.nan_inf import check_layer_outputs
+
+            check_layer_outputs(self, outputs)
         return outputs
 
     def forward(self, *inputs, **kwargs):

@@ -81,7 +81,10 @@ def _use_pallas():
 
 def _tile_scores(q, kt, qi, kj, *, scale, causal, off, bq, bk, mask_tile):
     """s tile (bq, bk) in f32 with scaling + causal (bottom-right) + additive
-    mask applied."""
+    mask applied. Inputs stay in their storage dtype (bf16 on TPU): the MXU's
+    fast path is low-precision multiply with f32 accumulation
+    (preferred_element_type) — upcasting inputs first would force full-f32
+    multiplies at a fraction of peak."""
     s = jax.lax.dot_general(
         q, kt, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -134,8 +137,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        kt = k_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        kt = k_ref[0]
         mask_tile = mask_ref[0] if has_mask else None
         s = _tile_scores(q, kt, qi, kj, scale=scale, causal=causal, off=off,
                          bq=bq, bk=bk, mask_tile=mask_tile)
@@ -152,9 +155,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         alpha = jnp.exp(m_prev - m_new)
         # l tracks the TRUE softmax normalizer (pre-dropout p)
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        vt = v_ref[0].astype(jnp.float32)
+        vt = v_ref[0]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p_use, vt, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_use.astype(vt.dtype), vt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
 
@@ -249,10 +253,10 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        kt = k_ref[0].astype(jnp.float32)
-        vt = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        kt = k_ref[0]
+        vt = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, :][:, None]
         delta = delta_ref[0, 0, :][:, None]
         mask_tile = mask_ref[0] if has_mask else None
@@ -272,11 +276,13 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dv_p = p
         # dV += (D o P)^T @ dO
         dva_ref[:] += jax.lax.dot_general(
-            dv_p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            dv_p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
         dka_ref[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(qi == nq - 1)
@@ -303,10 +309,10 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        kt = k_ref[0].astype(jnp.float32)
-        vt = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        kt = k_ref[0]
+        vt = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, :][:, None]
         delta = delta_ref[0, 0, :][:, None]
         mask_tile = mask_ref[0] if has_mask else None
@@ -322,7 +328,8 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dp = dp * jnp.where(keep, 1.0 / (1.0 - dropout_p), 0.0)
         ds = p * (dp - delta) * scale
         dqa_ref[:] += jax.lax.dot_general(
-            ds, kt, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(kt.dtype), kt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(kj == nk - 1)
@@ -509,13 +516,29 @@ def _flash_custom(causal, bq, bk, dropout_p, has_mask, mask_b, mask_h, interpret
 
 def flash_attention_array(
     q, k, v, mask=None, causal=False, dropout_p=0.0, dropout_key=None,
-    block_q=128, block_k=128,
+    block_q=None, block_k=None,
 ):
     """Dispatch: Pallas kernels on TPU (streamed K/V, fused mask/dropout,
-    Pallas backward); XLA fallback elsewhere or for unsupported shapes."""
+    Pallas backward); XLA fallback elsewhere or for unsupported shapes.
+    Tile sizes default to FLAGS_pallas_block_q/k (tunable per chip)."""
+    if block_q is None or block_k is None:
+        from ...flags import flag as _flag
+
+        block_q = block_q or _flag("FLAGS_pallas_block_q")
+        block_k = block_k or _flag("FLAGS_pallas_block_k")
     sq, sk = q.shape[1], k.shape[1]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+
+    def _fit_block(b, s):
+        # largest power-halving of the requested tile that divides the
+        # sequence, so odd-length-but-divisible shapes keep the kernel
+        # instead of silently dropping to the XLA fallback
+        b = min(b, s)
+        while b > 8 and s % b:
+            b //= 2
+        return b
+
+    bq = _fit_block(block_q, sq)
+    bk = _fit_block(block_k, sk)
     mask_ok = True
     mf = None
     if mask is not None:

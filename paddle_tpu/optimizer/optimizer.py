@@ -247,7 +247,14 @@ class Optimizer:
                     k = f"{nm}_{slot}"
                     if k in state_dict:
                         v = state_dict[k]
-                        slots[slot] = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+                        arr = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+                        # a positional or name match must still be the right
+                        # parameter: moments carry the param's shape (scalar
+                        # slots like beta pows are exempt) — mismatches fall
+                        # through rather than silently corrupting training
+                        if arr.size > 1 and tuple(arr.shape) != tuple(p._array.shape):
+                            continue
+                        slots[slot] = arr
                         break
             if slots:
                 st = self._init_slots(p._array)
