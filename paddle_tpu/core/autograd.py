@@ -28,6 +28,8 @@ class _TLS(threading.local):
     def __init__(self):
         self.grad_enabled = True
         self.trace_mode = False  # True inside functional_call: tape off, pure trace
+        self.apply_depth = 0  # >0 while an op's fn executes (nested applies)
+        self.capture = None  # active static.Program op-log (program_guard)
 
 
 _tls = _TLS()
@@ -120,24 +122,42 @@ def apply(fn, *tensors, name=None, num_outputs=None):
     """Run `fn` (a jnp-level function over arrays, differentiable in all
     positional args) on the arrays inside `tensors`, recording a tape node if
     gradients are required. Returns raw output arrays plus the node and the
-    stop_gradient flag for outputs; Tensor wrapping happens in tensor.py."""
+    stop_gradient flag for outputs; Tensor wrapping happens in tensor.py.
+
+    Static-graph capture (paddle.static.program_guard): every TOP-LEVEL op
+    application is also appended to the active Program's op log — nested
+    applies fired while an outer op's fn executes (e.g. ops inside a
+    while_loop body being traced) are part of that op's own function and are
+    skipped. The log replays under jax.jit in Executor.run."""
     arrays = tuple(t._array for t in tensors)
     record = (
         _tls.grad_enabled
         and not _tls.trace_mode
         and any(not t.stop_gradient for t in tensors)
     )
-    if not record:
-        out = fn(*arrays)
-        return out, None
-    out, vjp_fn = jax.vjp(fn, *arrays)
-    if isinstance(out, (tuple, list)):
-        avals = [(o.shape, o.dtype) for o in out]
-        multi = True
-    else:
-        avals = [(out.shape, out.dtype)]
-        multi = False
-    node = GradNode(vjp_fn, tensors, avals, multi, name or getattr(fn, "__name__", "op"))
+    depth = _tls.apply_depth
+    _tls.apply_depth += 1
+    try:
+        if not record:
+            out = fn(*arrays)
+            node = None
+        else:
+            out, vjp_fn = jax.vjp(fn, *arrays)
+            if isinstance(out, (tuple, list)):
+                avals = [(o.shape, o.dtype) for o in out]
+                multi = True
+            else:
+                avals = [(out.shape, out.dtype)]
+                multi = False
+            node = GradNode(
+                vjp_fn, tensors, avals, multi, name or getattr(fn, "__name__", "op")
+            )
+    finally:
+        _tls.apply_depth -= 1
+    # trace_mode excluded: ops fired inside functional_call/jit tracing carry
+    # tracer arrays that would poison the op log
+    if depth == 0 and _tls.capture is not None and not _tls.trace_mode:
+        _tls.capture._record_op(fn, tensors, arrays, out)
     return out, node
 
 

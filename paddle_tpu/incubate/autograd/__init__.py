@@ -14,9 +14,45 @@ from ...core.tensor import Tensor
 
 
 def forward_grad(outputs, inputs, grad_inputs=None):
-    raise NotImplementedError(
-        "static prim system is trace-native here: use paddle_tpu.autograd.jvp"
-    )
+    """Reference incubate/autograd/primapi.py forward_grad: forward-mode
+    derivatives of captured-program outputs w.r.t. inputs. The op log built
+    under static.program_guard replays as a pure function and jax.jvp
+    pushes the tangents through it — the reference's linearize-pass role."""
+    import jax.numpy as jnp
+
+    from ...core import autograd as ag
+    from ...static.program import default_main_program
+
+    prog = ag._tls.capture or default_main_program()
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    input_aids = [id(t._array) for t in ins]
+    fetch_ids = [id(t._array) for t in outs]
+    externals, run = prog._plan_arrays(input_aids, fetch_ids)
+    ext_vals = prog._external_values(externals)
+    n_in = len(ins)
+    if grad_inputs is None:
+        gs = []
+    else:
+        gs = grad_inputs if isinstance(grad_inputs, (list, tuple)) else [grad_inputs]
+
+    # one tape/op-log node: under program_guard the jvp becomes part of the
+    # program (evaluated at feed values by Executor.run), and in eager mode
+    # it evaluates at the inputs' current values
+    def f_jvp(*arrs):
+        xs, ts = arrs[:n_in], arrs[n_in:]
+        if not ts:
+            ts = tuple(jnp.ones_like(x) for x in xs)
+
+        def f(*vals):
+            return tuple(run(list(vals), ext_vals))
+
+        _, tang = jax.jvp(f, xs, ts)
+        return tang
+
+    out, node = ag.apply(f_jvp, *ins, *gs, name="forward_grad")
+    result = [Tensor._from_op(o, node, i) for i, o in enumerate(out)]
+    return result if isinstance(outputs, (list, tuple)) else result[0]
 
 
 def jvp(func, xs, v=None):

@@ -23,9 +23,113 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     return _linear(x, weight, bias)
 
 
-def fused_multi_head_attention(*args, **kwargs):
-    raise NotImplementedError("use incubate.nn.FusedMultiHeadAttention layer")
+def fused_multi_head_attention(
+    x, qkv_weight, linear_weight, pre_layer_norm=False, pre_ln_scale=None,
+    pre_ln_bias=None, ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+    qkv_bias=None, linear_bias=None, cache_kv=None, attn_mask=None,
+    dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+    mode="upscale_in_train", ring_id=-1, add_residual=True, num_heads=-1,
+    transpose_qkv_wb=False, name=None,
+):
+    """Reference incubate/nn/functional/fused_transformer.py
+    fused_multi_head_attention: the whole pre_ln -> qkv -> attention -> proj
+    -> dropout -> residual -> ln block from raw weights.
+    qkv_weight: [3, num_heads, head_dim, embed_dim]. On TPU the attention
+    routes through the Pallas flash kernel; XLA fuses the rest."""
+    import jax.numpy as jnp
+
+    from ...core import autograd
+    from ...core.tensor import Tensor
+    from ...ops import common_nn as F
+    from ...ops._helpers import T
+    from ...ops.norm_ops import layer_norm as _layer_norm
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention: cache_kv (incremental decode) is not "
+            "supported — use nn.MultiHeadAttention with its cache API"
+        )
+    if mode != "upscale_in_train":
+        raise NotImplementedError(
+            f"fused_multi_head_attention: dropout mode {mode!r} not supported"
+        )
+    xt = T(x)
+    b, s, e = xt.shape
+    qkv_w = T(qkv_weight)
+    if transpose_qkv_wb:
+        from ...ops.manipulation import reshape, transpose
+
+        nh = num_heads
+        qkv_w = transpose(reshape(qkv_w, [e, 3, nh, e // nh]), [1, 2, 3, 0])
+    _, n_heads, head_dim, _ = qkv_w.shape
+
+    h = xt
+    if pre_layer_norm:
+        h = _layer_norm(
+            h, [e], T(pre_ln_scale) if pre_ln_scale is not None else None,
+            T(pre_ln_bias) if pre_ln_bias is not None else None, pre_ln_epsilon,
+        )
+
+    def qkv_fn(ha, wa, *bias_arr):
+        out = jnp.einsum("bse,khde->kbshd", ha, wa)
+        if bias_arr:
+            out = out + bias_arr[0][:, None, None]
+        return out
+
+    args = (h, qkv_w) + ((T(qkv_bias),) if qkv_bias is not None else ())
+    qkv_arr, node = autograd.apply(qkv_fn, *args, name="fused_qkv")
+    qkv = Tensor._from_op(qkv_arr, node)
+    q, k, v = qkv[0], qkv[1], qkv[2]  # [b, s, h, d]
+
+    ctx = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        is_causal=False, training=training,
+    )
+    from ...ops.manipulation import reshape as R
+
+    ctx = R(ctx, [b, s, n_heads * head_dim])
+    out = _linear(
+        ctx, T(linear_weight), T(linear_bias) if linear_bias is not None else None
+    )
+    if training and dropout_rate:
+        out = F.dropout(out, dropout_rate, training=True)
+    if add_residual:
+        out = xt + out
+    if not pre_layer_norm:
+        out = _layer_norm(
+            out, [e], T(ln_scale) if ln_scale is not None else None,
+            T(ln_bias) if ln_bias is not None else None, ln_epsilon,
+        )
+    return out
 
 
 def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias, act_type="gelu"):
-    raise NotImplementedError("use incubate.nn.FusedEcMoe layer")
+    """Reference incubate/nn/functional/fused_ec_moe.py: gate-weighted
+    mixture of expert FFNs. x [b,s,d]; gate [b,s,e]; bmm0 [e,d,f];
+    bmm0_bias [e,1,f]; bmm1 [e,f,d]; bmm1_bias [e,1,d]. Dense evaluation —
+    every expert runs and the gate softmax weights the sum (XLA batches the
+    expert matmuls on the MXU; the sparse-dispatch variant is
+    distributed.moe.MoELayer's all-to-all path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core import autograd
+    from ...core.tensor import Tensor
+    from ...ops._helpers import T
+
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"unsupported act_type {act_type}")
+
+    def f(xa, ga, w0, b0, w1, b1):
+        hidden = jnp.einsum("bsd,edf->ebsf", xa, w0) + b0[:, None]
+        hidden = jax.nn.gelu(hidden) if act_type == "gelu" else jax.nn.relu(hidden)
+        expert_out = jnp.einsum("ebsf,efd->ebsd", hidden, w1) + b1[:, None]
+        weights = jax.nn.softmax(ga, axis=-1)  # [b, s, e]
+        return jnp.einsum("ebsd,bse->bsd", expert_out, weights)
+
+    out, node = autograd.apply(
+        f, T(x), T(gate), T(bmm0_weight), T(bmm0_bias), T(bmm1_weight), T(bmm1_bias),
+        name="fused_ec_moe",
+    )
+    return Tensor._from_op(out, node)

@@ -35,5 +35,67 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None, nam
     return send_u_recv(x, src_index, dst_index, pool_type, out_size)
 
 
-def graph_khop_sampler(*args, **kwargs):
-    raise NotImplementedError("graph sampling: host-side; planned")
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Reference incubate/operators/graph_khop_sampler.py. Neighbor sampling
+    is index-chasing, not math: it runs host-side on numpy (the reference's
+    CPU kernel role) and the sampled subgraph feeds the compiled model.
+
+    CSC inputs: row[i] are in-neighbors of node n in
+    row[colptr[n]:colptr[n+1]]. Returns (edge_src, edge_dst, sample_index,
+    reindex_nodes[, edge_eids]) with edges reindexed into sample_index."""
+    import numpy as _np
+
+    from ..core.tensor import Tensor
+
+    def _np_of(x):
+        return _np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+    row_np = _np_of(row).astype(_np.int64)
+    colptr_np = _np_of(colptr).astype(_np.int64)
+    seeds = _np_of(input_nodes).astype(_np.int64).reshape(-1)
+    eids_np = _np_of(sorted_eids).astype(_np.int64) if sorted_eids is not None else None
+
+    from ..core import rng as _rng
+
+    # derive the host sampler stream from the framework seed (paddle.seed)
+    # so sampled subgraphs are reproducible like every other randomized op
+    key = _rng.next_key()
+    rng = _np.random.default_rng(int(_np.asarray(jax.random.key_data(key)).sum()))
+    srcs, dsts, eids = [], [], []
+    frontier = seeds
+    seen = dict((int(n), i) for i, n in enumerate(seeds))
+    order = list(seeds)
+    for k in sample_sizes:
+        nxt = []
+        for n in frontier:
+            lo, hi = int(colptr_np[n]), int(colptr_np[n + 1])
+            deg = hi - lo
+            if deg == 0:
+                continue
+            if k < 0 or deg <= k:
+                picked = _np.arange(lo, hi)
+            else:
+                picked = lo + rng.choice(deg, size=k, replace=False)
+            for e in picked:
+                u = int(row_np[e])
+                if u not in seen:
+                    seen[u] = len(order)
+                    order.append(u)
+                    nxt.append(u)
+                srcs.append(u)
+                dsts.append(int(n))
+                if eids_np is not None:
+                    eids.append(int(eids_np[e]))
+        frontier = _np.asarray(nxt, _np.int64)
+    sample_index = _np.asarray(order, _np.int64)
+    reindex = {int(n): i for i, n in enumerate(order)}
+    edge_src = Tensor(_np.asarray([reindex[s] for s in srcs], _np.int64))
+    edge_dst = Tensor(_np.asarray([reindex[d] for d in dsts], _np.int64))
+    out = (edge_src, edge_dst, Tensor(sample_index),
+           Tensor(_np.asarray([reindex[int(n)] for n in seeds], _np.int64)))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids=True requires sorted_eids")
+        out = out + (Tensor(_np.asarray(eids, _np.int64)),)
+    return out

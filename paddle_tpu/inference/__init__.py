@@ -80,19 +80,31 @@ class PredictorTensor:
 
 class Predictor:
     def __init__(self, config: Config):
-        if config._model_factory is None:
-            raise ValueError(
-                "Config.set_model_factory(...) is required: TPU inference "
-                "re-traces the model and AOT-compiles it (no ProgramDesc)"
-            )
-        self.config = config
-        self.model = config._model_factory()
-        if config.params_path:
-            from ..framework.io import load
+        import os
 
-            self.model.set_state_dict(load(config.params_path))
-        self.model.eval()
-        self._params, self._buffers = state_dict_arrays(self.model)
+        self.config = config
+        self._artifact = None
+        if config._model_factory is not None:
+            self.model = config._model_factory()
+            if config.params_path:
+                from ..framework.io import load
+
+                self.model.set_state_dict(load(config.params_path))
+            self.model.eval()
+            self._params, self._buffers = state_dict_arrays(self.model)
+        elif config.model_path and os.path.exists(config.model_path + ".pdmodel"):
+            # deployment artifact from jit.save: serialized StableHLO +
+            # weights, no Python model class needed (reference
+            # analysis_predictor loading a saved inference program)
+            from ..jit.api import load as jit_load
+
+            self._artifact = jit_load(config.model_path)
+            self.model = None
+        else:
+            raise ValueError(
+                "either Config.set_model_factory(...) or a jit.save'd "
+                "artifact at Config(model_path=...) is required"
+            )
         self._compiled = {}
         self._inputs = {}
         self._outputs = {}
@@ -155,8 +167,16 @@ class Predictor:
             real_n = n if real_n is None else real_n
             arrays.append(padded)
         key = tuple((a.shape, str(a.dtype)) for a in arrays)
-        fwd = self._get_compiled(key, len(arrays))
-        out = fwd(self._params, rng.next_key(), *[np.asarray(a) for a in arrays])
+        if self._artifact is not None:
+            out = self._artifact(*arrays)
+            out = jax.tree_util.tree_map(
+                lambda t: t._array if isinstance(t, Tensor) else t,
+                out,
+                is_leaf=lambda t: isinstance(t, Tensor),
+            )
+        else:
+            fwd = self._get_compiled(key, len(arrays))
+            out = fwd(self._params, rng.next_key(), *[np.asarray(a) for a in arrays])
         outs = out if isinstance(out, (list, tuple)) else [out]
         results = []
         for i, o in enumerate(outs):

@@ -153,41 +153,137 @@ def not_to_static(fn):
     return fn
 
 
-def save(layer, path, input_spec=None, **configs):
-    """jit.save parity: persist state_dict + class info + input spec.
+def _input_avals(input_spec, scope):
+    """InputSpec list -> jax ShapeDtypeStructs; None/-1 dims become shared
+    symbolic dims (jax.export shape polymorphism), so one artifact serves
+    any batch size."""
+    avals = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, Tensor):
+            s = InputSpec(s.shape, s.dtype)
+        dims = []
+        for j, d in enumerate(s.shape or []):
+            if d is None or int(d) < 0:
+                # every unknown dim is independent (reference InputSpec
+                # semantics) — inputs whose batches must agree still work,
+                # they just don't enforce equality at call time
+                (dim,) = jax.export.symbolic_shape(f"_d{i}_{j}", scope=scope)
+                dims.append(dim)
+            else:
+                dims.append(int(d))
+        avals.append(jax.ShapeDtypeStruct(tuple(dims), np.dtype(s.dtype)))
+    return avals
 
-    The reference serializes a ProgramDesc (jit/translated_layer.py); here the
-    program is re-traced from the layer class on load (weights + config are
-    the durable artifact; XLA recompiles for the target hardware — stronger
-    portability than a serialized graph)."""
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: persist an EXECUTABLE program artifact + weights.
+
+    Reference parity: jit/translated_layer.py + static/io.py:442
+    (save/load_inference_model) serialize a ProgramDesc; the TPU-native
+    artifact is serialized StableHLO from jax.export — `jit.load` in a fresh
+    process (no model class available) deserializes and runs it bit-equal.
+    Weights ship alongside as arguments (not baked), so the artifact is
+    update-able and the program re-usable across checkpoints."""
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects an nn.Layer")
+    if not input_spec:
+        raise ValueError(
+            "jit.save requires input_spec=[InputSpec(shape, dtype), ...] "
+            "(or example Tensors) to trace the program artifact"
+        )
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     from ..framework.io import save as fsave
 
-    state = layer.state_dict() if isinstance(layer, Layer) else {}
-    fsave(state, path + ".pdparams")
-    meta = {
-        "class_module": type(layer).__module__,
-        "class_name": type(layer).__name__,
-        "input_spec": [
-            (s.shape, np.dtype(s.dtype).name) if isinstance(s, InputSpec) else None
-            for s in (input_spec or [])
-        ],
-    }
-    with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(meta, f)
+    was_training = layer.training
+    layer.eval()
+    try:
+        params, buffers = state_dict_arrays(layer)
+
+        def fwd(params, buffers, *inputs):
+            out, _ = functional_call(
+                layer, params, buffers, args=inputs, training=False
+            )
+            return out
+
+        scope = jax.export.SymbolicScope()
+        avals = _input_avals(list(input_spec), scope)
+        exp = jax.export.export(
+            jax.jit(fwd),
+            disabled_checks=[
+                jax.export.DisabledSafetyCheck.custom_call("tpu_custom_call"),
+                jax.export.DisabledSafetyCheck.custom_call("Sharding"),
+            ],
+        )(params, buffers, *avals)
+        artifact = {
+            "format": "paddle_tpu.stablehlo.v1",
+            "stablehlo": exp.serialize(),
+            "class_module": type(layer).__module__,
+            "class_name": type(layer).__name__,
+        }
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump(artifact, f)
+        fsave(
+            {"params": dict(params), "buffers": dict(buffers)},
+            path + ".pdiparams",
+        )
+        # plain state_dict too (framework save/load interop)
+        fsave(layer.state_dict(), path + ".pdparams")
+    finally:
+        layer.train() if was_training else layer.eval()
+
+
+class TranslatedLayer:
+    """A loaded program artifact, callable like the original layer with no
+    access to its Python class (reference jit/translated_layer.py)."""
+
+    def __init__(self, exported, params, buffers):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+        self.training = False
+
+    def __call__(self, *inputs):
+        arrays = tuple(
+            i._array if isinstance(i, Tensor) else jax.numpy.asarray(np.asarray(i))
+            for i in inputs
+        )
+        out = self._exported.call(self._params, self._buffers, *arrays)
+        from ..core.functional import tree_to_tensors
+
+        return tree_to_tensors(out)
+
+    forward = __call__
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError("a loaded inference artifact cannot be trained")
+
+    def state_dict(self):
+        return {k: Tensor._from_op(v) for k, v in self._params.items()}
+
+    def set_state_dict(self, state_dict):
+        """Swap weights without re-exporting (same shapes/dtypes)."""
+        for k, v in state_dict.items():
+            if k in self._params:
+                arr = v._array if isinstance(v, Tensor) else jax.numpy.asarray(v)
+                self._params[k] = arr.astype(self._params[k].dtype)
 
 
 def load(path, **configs):
-    import importlib
-
+    """jit.load: deserialize and run the saved program — no model class
+    needed (the reference's TranslatedLayer contract)."""
     from ..framework.io import load as fload
 
     with open(path + ".pdmodel", "rb") as f:
-        meta = pickle.load(f)
-    mod = importlib.import_module(meta["class_module"])
-    cls = getattr(mod, meta["class_name"])
-    layer = cls.__new__(cls)
-    raise NotImplementedError(
-        "jit.load requires reconstructable layers; use paddle_tpu.load + "
-        "set_state_dict for weights, or the inference predictor."
-    )
+        artifact = pickle.load(f)
+    if artifact.get("format") != "paddle_tpu.stablehlo.v1":
+        raise ValueError(f"unrecognized jit artifact: {artifact.get('format')}")
+    exported = jax.export.deserialize(artifact["stablehlo"])
+    blob = fload(path + ".pdiparams")
+    to_arr = lambda v: v._array if isinstance(v, Tensor) else jax.numpy.asarray(v)
+    params = {k: to_arr(v) for k, v in blob["params"].items()}
+    buffers = {k: to_arr(v) for k, v in blob["buffers"].items()}
+    return TranslatedLayer(exported, params, buffers)

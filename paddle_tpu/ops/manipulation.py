@@ -21,8 +21,17 @@ from ._helpers import T, nondiff, op, op_multi
 def _resolve_shape(shape, x):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
-    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
-    return shape
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            try:
+                out.append(int(s))
+            except Exception:
+                # symbolic dim (jax.export shape polymorphism) passes through
+                out.append(s)
+    return out
 
 
 def reshape(x, shape, name=None):

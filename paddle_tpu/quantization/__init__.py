@@ -72,6 +72,74 @@ class QuantedLinear(Layer):
         return Tensor._from_op(out, node)
 
 
+class Int8Linear(Layer):
+    """The EMITTED quantized layer: int8 weights (per-output-channel scales)
+    + static int8 activation quant, computed as an int8xint8->int32
+    `dot_general` — true quantized compute (the MXU multiplies int8 natively),
+    not a fake-quant simulation. Reference emission:
+    static/quantization/post_training_quantization.py."""
+
+    def __init__(self, q_weight_i8, w_scales, a_scale, bias, a_bits=8, w_bits=8):
+        super().__init__()
+        # registered buffers so state_dict round-trips the quantized model
+        self.register_buffer("q_weight", Tensor(np.asarray(q_weight_i8, np.int8)))
+        self.register_buffer("w_scales", Tensor(np.asarray(w_scales, np.float32)))
+        self.register_buffer("a_scale_t", Tensor(np.float32(a_scale)))
+        self.bias = bias  # Parameter or None
+        self.a_qmax = 2.0 ** (a_bits - 1) - 1
+        self.w_qmax = 2.0 ** (w_bits - 1) - 1
+
+    @property
+    def a_scale(self):
+        return float(np.asarray(self.a_scale_t._array))
+
+    def forward(self, x):
+        qw = self.q_weight._array
+        wsc = self.w_scales._array
+        asc = self.a_scale
+        a_qmax, w_qmax = self.a_qmax, self.w_qmax
+
+        def f(xa, *b):
+            xq = jnp.clip(
+                jnp.round(xa.astype(jnp.float32) / asc * a_qmax), -a_qmax, a_qmax
+            ).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, qw, (((xa.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            out = acc.astype(jnp.float32) * (asc / a_qmax) * (wsc / w_qmax)
+            if b:
+                out = out + b[0]
+            return out.astype(xa.dtype)
+
+        args = (x,) + ((self.bias,) if self.bias is not None else ())
+        out, node = autograd.apply(f, *args, name="int8_linear")
+        return Tensor._from_op(out, node)
+
+
+def _emit_int8(model, a_bits=8, w_bits=8):
+    """Replace calibrated QuantedLinear layers with Int8Linear."""
+
+    def convert(layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, QuantedLinear):
+                w = np.asarray(sub.inner.weight._array, np.float32)  # [in, out]
+                w_qmax = 2.0 ** (w_bits - 1) - 1
+                w_scales = np.maximum(np.abs(w).max(axis=0), 1e-8)  # per out-ch
+                qw = np.clip(
+                    np.round(w / w_scales[None, :] * w_qmax), -w_qmax, w_qmax
+                ).astype(np.int8)
+                layer._sub_layers[name] = Int8Linear(
+                    qw, w_scales, sub.act_observer.scale(), sub.inner.bias,
+                    a_bits=a_bits, w_bits=w_bits,
+                )
+            else:
+                convert(sub)
+
+    convert(model)
+    return model
+
+
 class QAT:
     """Reference quantization/qat.py:23 — wraps a model for quant-aware
     training by swapping Linear layers for fake-quant versions."""
@@ -97,11 +165,17 @@ class QAT:
         return model
 
     def convert(self, model, inplace=False):
-        return model
+        """Emit the deployable int8 model from the trained fake-quant one."""
+        return _emit_int8(
+            model,
+            self.config.activation.get("bits", 8),
+            self.config.weight.get("bits", 8),
+        )
 
 
 class PTQ:
-    """Post-training quantization: calibrate observers over sample data."""
+    """Post-training quantization: run sample data through the quantized
+    model (observers calibrate), then `convert` emits int8 layers."""
 
     def __init__(self, config: QuantConfig = None):
         self.config = config or QuantConfig()
@@ -111,4 +185,8 @@ class PTQ:
         return QAT(self.config).quantize(model, inplace)
 
     def convert(self, model, inplace=False):
-        return model
+        return _emit_int8(
+            model,
+            self.config.activation.get("bits", 8),
+            self.config.weight.get("bits", 8),
+        )

@@ -38,31 +38,14 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={np.dtype(self.dtype).name}, name={self.name})"
 
 
-class Program:
-    """Placeholder parity shim: compiled programs are jax executables."""
-
-    def __init__(self):
-        self._compiled = None
-
-
-def default_main_program():
-    return Program()
-
-
-def default_startup_program():
-    return Program()
-
-
-class Executor:
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None):
-        raise NotImplementedError(
-            "TPU-native execution is trace-based: use paddle_tpu.jit.to_static "
-            "or Model.fit (whole-program XLA), not ProgramDesc execution."
-        )
-
-
-def data(name, shape, dtype="float32"):
-    return InputSpec(shape, dtype, name)
+from . import nn  # noqa: E402,F401
+from .program import (  # noqa: E402,F401
+    CompiledProgram,
+    Executor,
+    Program,
+    data,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    scope_guard,
+)

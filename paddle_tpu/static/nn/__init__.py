@@ -1,0 +1,165 @@
+"""paddle.static.nn: control flow + graph-building layers.
+
+Reference parity: python/paddle/static/nn/control_flow.py:401 (while_loop),
+cond/case/switch_case, and the conditional_block/while C++ ops
+(paddle/fluid/operators/controlflow/while_op.cc, conditional_block_op.cc).
+
+TPU-native lowering:
+- `while_loop` -> ONE `jax.lax.while_loop` op on the tape/op-log, with the
+  user's cond/body traced as pure functions of the loop vars. XLA has no
+  reverse-mode rule for unbounded loops, so while_loop is forward-only
+  (outputs carry stop_gradient=True) — the reference's while_grad builds a
+  reverse block; the XLA-idiomatic differentiable loop is lax.scan, which
+  backs `jit.to_static`-traced Python loops of static trip count.
+- `cond`/`case`/`switch_case` -> both branches evaluate and a `where`
+  select routes values AND gradients (differentiable; under jit XLA merges
+  or conditionalizes the branches). This is the SPMD-friendly form — a
+  data-dependent single-branch execution cannot be compiled into one static
+  program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd
+from ...core.tensor import Tensor
+from ...ops.manipulation import where as _where
+
+__all__ = ["cond", "case", "switch_case", "while_loop", "fc"]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _select(pred_t, a, b):
+    """where(pred, a, b) over matching pytrees of Tensors."""
+    if isinstance(a, (list, tuple)):
+        if not isinstance(b, (list, tuple)) or len(a) != len(b):
+            raise ValueError(
+                "cond branches must return the same structure "
+                f"(got {type(a).__name__} of {len(a)} vs {type(b).__name__})"
+            )
+        return type(a)(_select(pred_t, x, y) for x, y in zip(a, b))
+    at, bt = _as_tensor(a), _as_tensor(b)
+    if tuple(at.shape) != tuple(bt.shape):
+        raise ValueError(
+            f"cond branches must return matching shapes, got {at.shape} vs {bt.shape}"
+        )
+    cond_b = pred_t.astype("bool")
+    # broadcast scalar pred over the value shape
+    from ...ops.manipulation import broadcast_to
+
+    if tuple(cond_b.shape) != tuple(at.shape):
+        cond_b = broadcast_to(cond_b.reshape([1] * max(at.ndim, 1)), at.shape) \
+            if at.ndim else cond_b.reshape([])
+    return _where(cond_b, at, bt)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Reference static/nn/control_flow.py cond. Both branches run; `where`
+    selects outputs (and routes gradients to the taken branch only)."""
+    pred_t = _as_tensor(pred)
+    if true_fn is None or false_fn is None:
+        raise ValueError("cond requires both true_fn and false_fn")
+    t_out = true_fn()
+    f_out = false_fn()
+    if t_out is None and f_out is None:
+        return None
+    return _select(pred_t, t_out, f_out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First matching predicate wins (reference static.nn.case)."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    if default is None:
+        # reference semantics: last fn is the fallback
+        pred_fn_pairs, default = pred_fn_pairs[:-1], pred_fn_pairs[-1][1]
+    result = default()
+    for pred, fn in reversed(list(pred_fn_pairs)):
+        result = _select(_as_tensor(pred), fn(), result)
+    return result
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference static.nn.switch_case: select a branch by integer index."""
+    idx = _as_tensor(branch_index).astype("int32")
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = [
+            p if isinstance(p, (tuple, list)) else (i, p)
+            for i, p in enumerate(branch_fns)
+        ]
+    if default is None:
+        default = pairs[-1][1]
+    result = default()
+    for i, fn in reversed(pairs):
+        result = _select(idx.equal(_as_tensor(np.int32(i))), fn(), result)
+    return result
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Reference static/nn/control_flow.py:401. Lowers to ONE
+    jax.lax.while_loop whose carry is the flat list of loop vars; the
+    user's cond/body run on Tensor-wrapped tracers (tape off) so ordinary
+    paddle ops build the loop body. Forward-only: XLA cannot
+    reverse-differentiate an unbounded loop (outputs are stop_gradient;
+    use a static-trip-count Python loop under jit.to_static for a
+    differentiable scan)."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    tensors = [_as_tensor(v) for v in loop_vars]
+
+    def f(*arrays):
+        def wrap(vals):
+            return [Tensor._from_op(v) for v in vals]
+
+        def c(vals):
+            with autograd.trace_mode():
+                r = cond(*wrap(list(vals)))
+            arr = r._array if isinstance(r, Tensor) else jnp.asarray(r)
+            return jnp.squeeze(arr).astype(bool)
+
+        def b(vals):
+            with autograd.trace_mode():
+                outs = body(*wrap(list(vals)))
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            if len(outs) != len(vals):
+                raise ValueError(
+                    f"while_loop body returned {len(outs)} vars, expected {len(vals)}"
+                )
+            return tuple(
+                (o._array if isinstance(o, Tensor) else jnp.asarray(o)).astype(
+                    v.dtype
+                ).reshape(v.shape)
+                for o, v in zip(outs, vals)
+            )
+
+        return jax.lax.while_loop(c, b, tuple(arrays))
+
+    with autograd.no_grad():
+        out, _ = autograd.apply(f, *tensors, name="while_loop")
+    return [Tensor._from_op(o) for o in out]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference static.nn.fc — a Linear built at graph-construction time."""
+    from ... import nn
+
+    xt = _as_tensor(x)
+    in_features = int(np.prod(xt.shape[num_flatten_dims:]))
+    layer = nn.Linear(in_features, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    flat = xt.reshape(list(xt.shape[:num_flatten_dims]) + [in_features])
+    out = layer(flat)
+    if activation:
+        from ...ops import common_nn as F
+
+        out = getattr(F, activation)(out)
+    return out

@@ -1,0 +1,215 @@
+"""Static graph as a captured op log compiled to one XLA program.
+
+Reference parity: Program/Executor
+(/root/reference/python/paddle/fluid/framework.py:5355 Program,
+fluid/executor.py:921 Executor, run:1394) and the instruction-based
+InterpreterCore (new_executor/interpretercore.cc:181).
+
+TPU-native design: there is no ProgramDesc interpreter. Under
+`program_guard`, every top-level eager op application (the single funnel
+`core.autograd.apply`) appends (fn, inputs, outputs) to the Program's op
+log while still executing eagerly on placeholder values — capture IS a
+shape-correct dry run. `Executor.run` replays the log as a pure function of
+(feed values, external values) and jit-compiles it: the whole program
+becomes ONE cached XLA executable (the SURVEY §7 step-4 north star), with
+parameters passed as arguments so eager updates flow in without recompiles.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+
+_prog_ids = itertools.count()
+
+
+class Program:
+    """An op log: the captured "static graph"."""
+
+    def __init__(self):
+        self.id = next(_prog_ids)
+        self.version = 0  # bumped per recorded op — part of the compile key
+        self._ops = []  # (fn, [(array_id, tensor_or_None)], [out_array_ids])
+        self._feeds = {}  # name -> placeholder array id
+        self._keepalive = []  # captured arrays (id stability)
+        self.random_seed = None
+
+    # ---- capture ----------------------------------------------------------
+    def _record_op(self, fn, tensors, arrays, out):
+        ins = [(id(a), t) for a, t in zip(arrays, tensors)]
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        self._ops.append((fn, ins, [id(o) for o in outs]))
+        self._keepalive.extend(arrays)
+        self._keepalive.extend(outs)
+        self.version += 1
+
+    def _register_feed(self, name, placeholder_array):
+        self._feeds[name] = id(placeholder_array)
+        self._keepalive.append(placeholder_array)
+        self.version += 1
+
+    # ---- introspection (parity helpers) -----------------------------------
+    def num_ops(self):
+        return len(self._ops)
+
+    def __repr__(self):
+        return f"<static.Program id={self.id} ops={len(self._ops)} feeds={list(self._feeds)}>"
+
+    # ---- replay -----------------------------------------------------------
+    def _plan(self, feed_names, fetch_ids):
+        return self._plan_arrays([self._feeds[n] for n in feed_names], fetch_ids)
+
+    def _plan_arrays(self, input_aids, fetch_ids):
+        """(externals, runner): externals are (tensor, capture_aid) whose
+        CURRENT values are passed as jit arguments each run. input_aids are
+        capture-time array ids treated as the runner's positional inputs
+        (feeds, or any program-interior tensors for jvp/grad replays)."""
+        feed_ids = {aid: i for i, aid in enumerate(input_aids)}
+        produced = set(feed_ids)
+        externals = []  # (aid, tensor_or_array)
+        ext_index = {}
+        for fn, ins, outs in self._ops:
+            for aid, tref in ins:
+                if aid not in produced and aid not in ext_index:
+                    ext_index[aid] = len(externals)
+                    externals.append((aid, tref))
+            produced.update(outs)
+        for fid in fetch_ids:
+            if fid not in produced and fid not in ext_index:
+                raise ValueError(
+                    "fetch target was not produced by this program (was it "
+                    "created outside program_guard?)"
+                )
+        ops = list(self._ops)  # snapshot: a replay op recorded later (e.g.
+        # forward_grad's jvp node) must not re-enter itself
+
+        def run(feed_vals, ext_vals):
+            env = {}
+            for aid, i in feed_ids.items():
+                env[aid] = feed_vals[i]
+            for (aid, _), v in zip(externals, ext_vals):
+                env[aid] = v
+            for fn, ins, outs in ops:
+                vals = [env[aid] for aid, _ in ins]
+                res = fn(*vals)
+                res = list(res) if isinstance(res, (tuple, list)) else [res]
+                for oid, v in zip(outs, res):
+                    env[oid] = v
+            return [env[fid] for fid in fetch_ids]
+
+        return externals, run
+
+    def _external_values(self, externals):
+        vals = []
+        for aid, tref in externals:
+            if isinstance(tref, Tensor):
+                vals.append(tref._array)  # CURRENT value (params update)
+            else:
+                vals.append(tref)
+        return vals
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class program_guard:
+    """Capture ops built in the body into `main_program` (reference
+    static.program_guard)."""
+
+    def __init__(self, main_program, startup_program=None):
+        self._prog = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        self._prev = autograd._tls.capture
+        autograd._tls.capture = self._prog
+        return self._prog
+
+    def __exit__(self, *exc):
+        autograd._tls.capture = self._prev
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference static.data): a Tensor holding zeros of
+    the declared shape (None/-1 dims become 1 for the capture dry run; the
+    compiled program re-traces per concrete feed shape)."""
+    shp = [1 if (d is None or int(d) < 0) else int(d) for d in (shape or [])]
+    arr = jnp.zeros(tuple(shp), convert_dtype(dtype))
+    t = Tensor._from_op(arr)
+    t.name = name
+    t.stop_gradient = False
+    prog = autograd._tls.capture
+    if prog is None:
+        prog = _default_main
+    prog._register_feed(name, arr)
+    return t
+
+
+class Executor:
+    """Compile-and-run for captured Programs (reference Executor.run:1394 →
+    one XLA executable per (program version, feed signature, fetches))."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        prog = program if program is not None else _default_main
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        feed_names = tuple(sorted(feed))
+        fetch_ids = tuple(
+            id(t._array) if isinstance(t, Tensor) else id(t) for t in fetch_list
+        )
+        feed_vals = [
+            f._array if isinstance(f, Tensor) else jnp.asarray(np.asarray(f))
+            for f in (feed[n] for n in feed_names)
+        ]
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
+        key = (prog.id, prog.version, feed_names, sig, fetch_ids)
+        entry = self._cache.get(key)
+        if entry is None:
+            externals, run = prog._plan(feed_names, fetch_ids)
+            entry = (externals, jax.jit(run))
+            self._cache[key] = entry
+        externals, jrun = entry
+        outs = jrun(feed_vals, prog._external_values(externals))
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return outs
+
+    def close(self):
+        self._cache.clear()
+
+
+def scope_guard(scope):
+    import contextlib
+
+    return contextlib.nullcontext(scope)
+
+
+class CompiledProgram:
+    """Parity alias: every executed Program is compiled (whole-program XLA)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)

@@ -128,6 +128,129 @@ def test_quantization_qat():
     assert ql.inner.weight.grad is not None
 
 
+def test_ptq_convert_emits_int8_model():
+    """PTQ calibrate -> convert must emit a real int8 model whose outputs
+    track the fp model (reference post_training_quantization.py)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization import Int8Linear, PTQ, QuantConfig
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    xs = [paddle.randn([4, 8]) for _ in range(8)]
+    ref = [net(x).numpy() for x in xs]
+
+    ptq = PTQ(QuantConfig())
+    qnet = ptq.quantize(net)
+    for x in xs:  # calibration pass
+        qnet(x)
+    inet = ptq.convert(qnet)
+
+    i0 = inet._sub_layers["0"]
+    assert isinstance(i0, Int8Linear)
+    assert i0.q_weight.dtype == jnp.int8  # genuinely quantized storage
+
+    for x, r in zip(xs, ref):
+        out = inet(x).numpy()
+        assert out.shape == r.shape
+        # int8 static-activation quant keeps outputs close on tame data
+        denom = np.abs(r).max() + 1e-6
+        assert np.abs(out - r).max() / denom < 0.1, np.abs(out - r).max()
+
+    # quantized weights/scales must survive a state_dict round trip
+    sd = {k: paddle.to_tensor(np.asarray(v.numpy())) for k, v in inet.state_dict().items()}
+    assert any("q_weight" in k for k in sd)
+    ref_out = inet(xs[0]).numpy()
+    i0.q_weight.set_value(np.zeros_like(np.asarray(i0.q_weight.numpy())))
+    assert not np.allclose(inet(xs[0]).numpy(), ref_out)  # clobbered
+    inet.set_state_dict(sd)  # restore
+    assert np.allclose(inet(xs[0]).numpy(), ref_out)
+
+
+def test_incubate_fused_mha_functional():
+    from paddle_tpu.incubate.nn.functional import fused_multi_head_attention
+
+    paddle.seed(0)
+    b, s, e, h = 2, 8, 16, 4
+    x = paddle.randn([b, s, e])
+    qkv_w = paddle.randn([3, h, e // h, e]) * 0.2
+    qkv_b = paddle.zeros([3, h, e // h])
+    lin_w = paddle.randn([e, e]) * 0.2
+    lin_b = paddle.zeros([e])
+    ln_s = paddle.ones([e])
+    ln_b = paddle.zeros([e])
+    out = fused_multi_head_attention(
+        x, qkv_w, lin_w, qkv_bias=qkv_b, linear_bias=lin_b,
+        ln_scale=ln_s, ln_bias=ln_b, dropout_rate=0.0, attn_dropout_rate=0.0,
+        training=False,
+    )
+    assert out.shape == [b, s, e]
+    assert np.isfinite(out.numpy()).all()
+    # post-LN output is normalized
+    assert abs(out.numpy().mean()) < 0.1
+
+
+def test_incubate_fused_ec_moe():
+    from paddle_tpu.incubate.nn.functional import fused_ec_moe
+
+    paddle.seed(1)
+    b, s, d, f, e = 2, 4, 8, 16, 3
+    x = paddle.randn([b, s, d])
+    gate = paddle.randn([b, s, e])
+    w0 = paddle.randn([e, d, f]) * 0.2
+    b0 = paddle.zeros([e, 1, f])
+    w1 = paddle.randn([e, f, d]) * 0.2
+    b1 = paddle.zeros([e, 1, d])
+    out = fused_ec_moe(x, gate, w0, b0, w1, b1)
+    assert out.shape == [b, s, d]
+    # matches the dense numpy mixture
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    hid = np.einsum("bsd,edf->ebsf", x.numpy(), w0.numpy())
+    hid = np.asarray(jnn.gelu(jnp.asarray(hid)))
+    eo = np.einsum("ebsf,efd->ebsd", hid, w1.numpy())
+    wts = np.asarray(jnn.softmax(jnp.asarray(gate.numpy()), axis=-1))
+    ref = np.einsum("ebsd,bse->bsd", eo, wts)
+    assert np.allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_incubate_graph_khop_sampler():
+    from paddle_tpu.incubate.operators import graph_khop_sampler
+
+    # graph: 0<-{1,2}, 1<-{2,3}, 2<-{3}, 3<-{}  (CSC: in-neighbors)
+    colptr = np.array([0, 2, 4, 5, 5], np.int64)
+    row = np.array([1, 2, 2, 3, 3], np.int64)
+    src, dst, sample_index, reindex = graph_khop_sampler(
+        row, colptr, np.array([0], np.int64), [2, 2]
+    )
+    nodes = sample_index.numpy()
+    assert nodes[0] == 0
+    assert set(nodes).issubset({0, 1, 2, 3})
+    # every edge endpoint indexes into sample_index
+    assert src.numpy().max() < len(nodes)
+    assert dst.numpy().max() < len(nodes)
+    # dst of hop-1 edges is node 0 (reindexed 0)
+    assert 0 in dst.numpy()
+
+
+def test_incubate_forward_grad():
+    from paddle_tpu import static
+    from paddle_tpu.incubate.autograd import forward_grad
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [3], "float32")
+        y = (x * x).sum() * 2.0
+        dy = forward_grad(y, x)
+    exe = static.Executor()
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    out = exe.run(prog, feed={"x": xv}, fetch_list=[y, dy])
+    assert abs(float(out[0]) - 28.0) < 1e-5
+    # d/dx sum(2x^2) . ones = sum(4x) = 24, evaluated at the FED x
+    assert abs(float(out[1]) - 24.0) < 1e-5
+
+
 def test_inference_predictor(tmp_path):
     from paddle_tpu.inference import Config, create_predictor
     from paddle_tpu.vision.models import LeNet

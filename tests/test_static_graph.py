@@ -1,0 +1,203 @@
+"""Static graph: Program capture, Executor.run, control flow (VERDICT
+round-2 items 6/7; reference fluid/framework.py Program, executor.py:1394,
+static/nn/control_flow.py:401)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static.nn import case, cond, switch_case, while_loop
+
+
+class TestProgramExecutor:
+    def test_capture_and_run(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            w = paddle.to_tensor(np.ones((4, 3), np.float32) * 2.0)
+            y = paddle.matmul(x, w)
+            z = (y + 1.0).sum()
+        exe = static.Executor()
+        out = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[y, z])
+        assert np.allclose(out[0], 8.0)
+        assert abs(float(out[1]) - 54.0) < 1e-5
+
+    def test_param_update_without_recompile(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 2], "float32")
+            w = paddle.to_tensor(np.eye(2, dtype=np.float32))
+            y = paddle.matmul(x, w)
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 2), np.float32)}
+        out1 = exe.run(prog, feed=feed, fetch_list=[y])
+        w.set_value(np.eye(2, dtype=np.float32) * 3.0)
+        out2 = exe.run(prog, feed=feed, fetch_list=[y])
+        assert np.allclose(out2[0], out1[0] * 3.0)
+        assert len(exe._cache) == 1  # same executable, new weight argument
+
+    def test_feed_shape_recompiles(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 3], "float32")
+            y = x * 2.0
+        exe = static.Executor()
+        o1 = exe.run(prog, feed={"x": np.ones((1, 3), np.float32)}, fetch_list=[y])
+        o2 = exe.run(prog, feed={"x": np.ones((5, 3), np.float32)}, fetch_list=[y])
+        assert o1[0].shape == (1, 3) and o2[0].shape == (5, 3)
+
+    def test_fetch_outside_program_raises(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [1], "float32")
+            _ = x + 1.0
+        stray = paddle.to_tensor(np.ones(1, np.float32)) * 2  # outside guard
+        with pytest.raises(ValueError, match="not produced"):
+            static.Executor().run(prog, feed={"x": np.ones(1, np.float32)}, fetch_list=[stray])
+
+    def test_default_main_program_guard(self):
+        before = static.default_main_program().num_ops()
+        with static.program_guard(static.default_main_program()):
+            x = static.data("dmp_x", [1], "float32")
+            y = x + 1.0
+        assert static.default_main_program().num_ops() > before
+        out = static.Executor().run(
+            feed={"dmp_x": np.array([41.0], np.float32)}, fetch_list=[y]
+        )
+        assert np.allclose(out[0], 42.0)
+
+
+class TestCond:
+    def test_value_and_grad_through_taken_branch(self):
+        a = paddle.to_tensor(np.array(3.0, np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.array(5.0, np.float32), stop_gradient=False)
+        r = cond(a < b, lambda: a * 2, lambda: b * 3)
+        assert float(r.numpy()) == 6.0
+        r.backward()
+        assert float(a.grad.numpy()) == 2.0
+        assert b.grad is None or float(b.grad.numpy()) == 0.0
+
+    def test_false_branch(self):
+        a = paddle.to_tensor(np.array(7.0, np.float32))
+        b = paddle.to_tensor(np.array(5.0, np.float32))
+        r = cond(a < b, lambda: a * 2, lambda: b * 3)
+        assert float(r.numpy()) == 15.0
+
+    def test_nested_structure(self):
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        outs = cond(
+            paddle.to_tensor(True),
+            lambda: [a, a + 1],
+            lambda: [a * 0, a * 0],
+        )
+        assert np.allclose(outs[0].numpy(), [1, 2])
+        assert np.allclose(outs[1].numpy(), [2, 3])
+
+    def test_mismatched_branches_raise(self):
+        a = paddle.to_tensor(np.ones(2, np.float32))
+        with pytest.raises(ValueError, match="structure|shape"):
+            cond(paddle.to_tensor(True), lambda: [a], lambda: [a, a])
+
+    def test_case_and_switch_case(self):
+        a = paddle.to_tensor(np.array(1.0, np.float32))
+        b = paddle.to_tensor(np.array(2.0, np.float32))
+        r = case(
+            [(paddle.to_tensor(False), lambda: a), (paddle.to_tensor(True), lambda: b)],
+            default=lambda: a * 0,
+        )
+        assert float(r.numpy()) == 2.0
+        r = switch_case(paddle.to_tensor(np.int32(0)), [lambda: a, lambda: b])
+        assert float(r.numpy()) == 1.0
+        r = switch_case(
+            paddle.to_tensor(np.int32(9)), {0: (lambda: a), 1: (lambda: b)},
+            default=lambda: a + b,
+        )
+        assert float(r.numpy()) == 3.0
+
+
+class TestWhileLoop:
+    def test_counts(self):
+        i = paddle.to_tensor(np.array(0, np.int32))
+        s = paddle.to_tensor(np.array(0.0, np.float32))
+        iv, sv = while_loop(lambda i, s: i < 10, lambda i, s: [i + 1, s + 2.0], [i, s])
+        assert int(iv.numpy()) == 10
+        assert float(sv.numpy()) == 20.0
+
+    def test_data_dependent_trip_count(self):
+        n = paddle.to_tensor(np.array(7, np.int32))
+        i = paddle.to_tensor(np.array(0, np.int32))
+        v = paddle.to_tensor(np.array(1.0, np.float32))
+        _, vv = while_loop(lambda i, v: i < n, lambda i, v: [i + 1, v * 2.0], [i, v])
+        assert float(vv.numpy()) == 2.0**7
+
+    def test_under_program_capture(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [1], "float32")
+            i = paddle.to_tensor(np.array(0, np.int32))
+            iv, xv = while_loop(lambda i, v: i < 5, lambda i, v: [i + 1, v * 2.0], [i, x])
+        out = static.Executor().run(
+            prog, feed={"x": np.array([1.5], np.float32)}, fetch_list=[xv]
+        )
+        assert np.allclose(out[0], 1.5 * 32)
+
+    def test_under_to_static(self):
+        from paddle_tpu import jit
+
+        @jit.to_static
+        def f(x):
+            i = paddle.to_tensor(np.array(0, np.int32))
+            _, out = while_loop(
+                lambda i, v: i < 3, lambda i, v: [i + 1, v + v], [i, x]
+            )
+            return out
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        assert np.allclose(f(x).numpy(), [8.0, 16.0])
+
+    def test_body_structure_mismatch_raises(self):
+        i = paddle.to_tensor(np.array(0, np.int32))
+        with pytest.raises(ValueError, match="body returned"):
+            while_loop(lambda i: i < 3, lambda i: [i + 1, i], [i])
+
+
+def test_capture_ignores_traced_interior_ops():
+    """jit-traced calls inside program_guard must not poison the op log with
+    tracer arrays (functional_call interiors are part of their own op)."""
+    from paddle_tpu import nn
+
+    net = nn.Linear(4, 2)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4], "float32")
+        y = x * 2.0
+        # a compiled-path call mid-capture (runs under trace_mode inside jit)
+        from paddle_tpu import jit as pjit
+
+        traced = pjit.to_static(lambda t: t + 1)
+        _ = traced(paddle.to_tensor(np.ones((1, 4), np.float32)))
+        z = y + 1.0
+    out = static.Executor().run(
+        prog, feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[z]
+    )
+    assert np.allclose(out[0], 3.0)
+
+
+def test_cond_under_to_static_grad():
+    """cond inside a traced function differentiates through the select."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import autograd
+    from paddle_tpu.core.tensor import Tensor
+
+    def loss(arr):
+        with autograd.trace_mode():
+            t = Tensor._from_op(arr)
+            r = cond(t.sum() > 0, lambda: (t * 2).sum(), lambda: (t * 3).sum())
+        return r._array if isinstance(r, Tensor) else r
+
+    g_pos = jax.grad(loss)(jnp.array([1.0, 1.0]))
+    g_neg = jax.grad(loss)(jnp.array([-1.0, -1.0]))
+    assert np.allclose(np.asarray(g_pos), 2.0)
+    assert np.allclose(np.asarray(g_neg), 3.0)
