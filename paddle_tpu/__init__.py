@@ -97,9 +97,13 @@ from .autograd.functional import grad  # noqa: F401
 # paddle.flops / summary
 from .hapi.summary import flops, summary  # noqa: F401
 
-disable_static = lambda *a, **k: None  # dygraph is the default; parity no-op
-enable_static = lambda *a, **k: None
+from .static.program import disable_static, enable_static  # noqa: F401
 
-in_dynamic_mode = lambda: True
 
-__version__ = "0.1.0"
+def in_dynamic_mode():
+    from .static.program import in_static_mode
+
+    return not in_static_mode()
+
+
+__version__ = "0.3.0"

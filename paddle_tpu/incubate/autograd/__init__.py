@@ -21,9 +21,14 @@ def forward_grad(outputs, inputs, grad_inputs=None):
     import jax.numpy as jnp
 
     from ...core import autograd as ag
-    from ...static.program import default_main_program
 
-    prog = ag._tls.capture or default_main_program()
+    prog = ag._tls.capture
+    if prog is None:
+        raise RuntimeError(
+            "forward_grad reads the captured op log: build the ops under "
+            "static.program_guard (or paddle.enable_static()); for eager "
+            "forward-mode AD use paddle_tpu.incubate.autograd.jvp"
+        )
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     input_aids = [id(t._array) for t in ins]
@@ -36,9 +41,8 @@ def forward_grad(outputs, inputs, grad_inputs=None):
     else:
         gs = grad_inputs if isinstance(grad_inputs, (list, tuple)) else [grad_inputs]
 
-    # one tape/op-log node: under program_guard the jvp becomes part of the
-    # program (evaluated at feed values by Executor.run), and in eager mode
-    # it evaluates at the inputs' current values
+    # one op-log node: the jvp becomes part of the program, evaluated at
+    # feed values by Executor.run
     def f_jvp(*arrs):
         xs, ts = arrs[:n_in], arrs[n_in:]
         if not ts:

@@ -57,10 +57,17 @@ def fused_multi_head_attention(
     b, s, e = xt.shape
     qkv_w = T(qkv_weight)
     if transpose_qkv_wb:
+        if num_heads <= 0:
+            raise ValueError(
+                "fused_multi_head_attention: transpose_qkv_wb=True requires "
+                "num_heads > 0 (weight is [embed_dim, 3*embed_dim])"
+            )
         from ...ops.manipulation import reshape, transpose
 
         nh = num_heads
         qkv_w = transpose(reshape(qkv_w, [e, 3, nh, e // nh]), [1, 2, 3, 0])
+        if qkv_bias is not None and len(T(qkv_bias).shape) == 1:
+            qkv_bias = reshape(T(qkv_bias), [3, nh, e // nh])
     _, n_heads, head_dim, _ = qkv_w.shape
 
     h = xt

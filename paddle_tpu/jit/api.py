@@ -262,14 +262,19 @@ class TranslatedLayer:
         raise RuntimeError("a loaded inference artifact cannot be trained")
 
     def state_dict(self):
-        return {k: Tensor._from_op(v) for k, v in self._params.items()}
+        out = {k: Tensor._from_op(v) for k, v in self._params.items()}
+        out.update({k: Tensor._from_op(v) for k, v in self._buffers.items()})
+        return out
 
     def set_state_dict(self, state_dict):
-        """Swap weights without re-exporting (same shapes/dtypes)."""
+        """Swap weights AND buffers (e.g. BatchNorm running stats) without
+        re-exporting (same shapes/dtypes)."""
         for k, v in state_dict.items():
+            arr = v._array if isinstance(v, Tensor) else jax.numpy.asarray(v)
             if k in self._params:
-                arr = v._array if isinstance(v, Tensor) else jax.numpy.asarray(v)
                 self._params[k] = arr.astype(self._params[k].dtype)
+            elif k in self._buffers:
+                self._buffers[k] = arr.astype(self._buffers[k].dtype)
 
 
 def load(path, **configs):

@@ -117,8 +117,12 @@ class Int8Linear(Layer):
         return Tensor._from_op(out, node)
 
 
-def _emit_int8(model, a_bits=8, w_bits=8):
+def _emit_int8(model, a_bits=8, w_bits=8, inplace=True):
     """Replace calibrated QuantedLinear layers with Int8Linear."""
+    if not inplace:
+        import copy
+
+        model = copy.deepcopy(model)
 
     def convert(layer):
         for name, sub in list(layer._sub_layers.items()):
@@ -170,6 +174,7 @@ class QAT:
             model,
             self.config.activation.get("bits", 8),
             self.config.weight.get("bits", 8),
+            inplace=inplace,
         )
 
 
@@ -189,4 +194,5 @@ class PTQ:
             model,
             self.config.activation.get("bits", 8),
             self.config.weight.get("bits", 8),
+            inplace=inplace,
         )

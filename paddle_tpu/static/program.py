@@ -146,18 +146,37 @@ class program_guard:
         return False
 
 
+def enable_static():
+    """Reference paddle.enable_static: globally capture subsequent ops into
+    the default main program (equivalent to an open-ended program_guard)."""
+    autograd._tls.capture = _default_main
+
+
+def disable_static():
+    autograd._tls.capture = None
+
+
+def in_static_mode():
+    return autograd._tls.capture is not None
+
+
 def data(name, shape, dtype="float32", lod_level=0):
     """Feed placeholder (reference static.data): a Tensor holding zeros of
     the declared shape (None/-1 dims become 1 for the capture dry run; the
     compiled program re-traces per concrete feed shape)."""
+    prog = autograd._tls.capture
+    if prog is None:
+        raise RuntimeError(
+            "static.data requires an active static graph: wrap graph "
+            "construction in `with static.program_guard(prog):` or call "
+            "paddle.enable_static() first (ops built outside are not "
+            "recorded, so Executor.run could never fetch them)"
+        )
     shp = [1 if (d is None or int(d) < 0) else int(d) for d in (shape or [])]
     arr = jnp.zeros(tuple(shp), convert_dtype(dtype))
     t = Tensor._from_op(arr)
     t.name = name
     t.stop_gradient = False
-    prog = autograd._tls.capture
-    if prog is None:
-        prog = _default_main
     prog._register_feed(name, arr)
     return t
 

@@ -60,14 +60,40 @@ def load(name, sources, extra_cxx_flags=None, verbose=False, build_directory=Non
         return lib
 
 
-_REPO_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc")
+def _find_csrc():
+    """Locate the native sources: next to the package in a source checkout
+    or sdist install; wheels ship Python-only (csrc is in the sdist via
+    MANIFEST.in), so give a clear error instead of a missing-file crash."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates = [
+        os.path.join(os.path.dirname(pkg_root), "csrc"),  # repo / sdist root
+        os.path.join(pkg_root, "csrc"),  # packaged alongside (future)
+    ]
+    for c in candidates:
+        if os.path.isdir(c):
+            return c
+    raise FileNotFoundError(
+        "paddle_tpu native sources (csrc/) not found next to the installed "
+        "package. Wheels are Python-only; install from the sdist or a source "
+        "checkout to build the native runtime (tcp_store, data_feed)."
+    )
+
+
+_REPO_CSRC = None
+
+
+def _csrc():
+    global _REPO_CSRC
+    if _REPO_CSRC is None:
+        _REPO_CSRC = _find_csrc()
+    return _REPO_CSRC
 
 
 def load_native():
     """Build + load the framework's native runtime library (csrc/)."""
     sources = [
-        os.path.join(_REPO_CSRC, "tcp_store.cc"),
-        os.path.join(_REPO_CSRC, "data_feed.cc"),
+        os.path.join(_csrc(), "tcp_store.cc"),
+        os.path.join(_csrc(), "data_feed.cc"),
     ]
     lib = load("paddle_tpu_native", sources)
     _declare(lib)

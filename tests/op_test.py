@@ -1,0 +1,153 @@
+"""OpTest: the systematic numpy-reference + numeric-gradient parity harness.
+
+Reference parity: /root/reference/python/paddle/fluid/tests/unittests/
+op_test.py:326 — declare an op + numpy inputs + a numpy reference; the
+harness checks forward outputs against the reference and gradients by
+central-difference numeric differentiation against the autograd tape.
+Tolerance exemptions live in op_test_whitelist.py (reference
+white_list/op_accuracy_white_list.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpCase:
+    """One enrolled op.
+
+    op: the paddle_tpu function under test (Tensor -> Tensor/list).
+    make_inputs: rng -> tuple of numpy arrays (positional op inputs).
+    ref: numpy reference taking the same positional numpy inputs.
+    kwargs: extra keyword args passed to op AND ref (ref may ignore).
+    grad: check gradients for float inputs (central difference vs tape).
+    grad_idx: which input positions get grad-checked (default: all float).
+    rtol/atol: forward tolerances; gtol: gradient tolerance.
+    ref_raw: if True, ref receives kwargs too.
+    """
+
+    def __init__(self, name, op, make_inputs, ref, kwargs=None, grad=True,
+                 grad_idx=None, rtol=1e-5, atol=1e-6, gtol=2e-3, ref_kwargs=False):
+        self.name = name
+        self.op = op
+        self.make_inputs = make_inputs
+        self.ref = ref
+        self.kwargs = kwargs or {}
+        self.grad = grad
+        self.grad_idx = grad_idx
+        self.rtol = rtol
+        self.atol = atol
+        self.gtol = gtol
+        self.ref_kwargs = ref_kwargs
+
+    def __repr__(self):
+        return f"OpCase({self.name})"
+
+
+def _to_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _run_op(case, np_inputs, requires_grad=False):
+    tensors = []
+    for a in np_inputs:
+        t = paddle.to_tensor(a)
+        if requires_grad and np.issubdtype(a.dtype, np.floating):
+            t.stop_gradient = False
+        tensors.append(t)
+    outs = _to_list(case.op(*tensors, **case.kwargs))
+    outs = [o for o in outs if isinstance(o, Tensor)]
+    return tensors, outs
+
+
+def check_output(case, seed=0):
+    rs = np.random.RandomState(seed)
+    np_inputs = tuple(np.asarray(a) for a in case.make_inputs(rs))
+    _, outs = _run_op(case, np_inputs)
+    if case.ref_kwargs:
+        ref_out = case.ref(*np_inputs, **case.kwargs)
+    else:
+        ref_out = case.ref(*np_inputs)
+    ref_outs = _to_list(ref_out)
+    assert len(outs) == len(ref_outs), (
+        f"{case.name}: op returned {len(outs)} outputs, reference {len(ref_outs)}"
+    )
+    for i, (o, r) in enumerate(zip(outs, ref_outs)):
+        got = np.asarray(o.numpy())
+        want = np.asarray(r)
+        assert got.shape == want.shape, (
+            f"{case.name} out[{i}]: shape {got.shape} != ref {want.shape}"
+        )
+        if np.issubdtype(want.dtype, np.floating) or np.issubdtype(
+            want.dtype, np.complexfloating
+        ):
+            np.testing.assert_allclose(
+                got, want, rtol=case.rtol, atol=case.atol,
+                err_msg=f"{case.name} out[{i}]",
+            )
+        else:
+            np.testing.assert_array_equal(got, want, err_msg=f"{case.name} out[{i}]")
+
+
+def _loss_np(case, np_inputs, projs):
+    """Scalar projection of op outputs, computed by running the REAL op —
+    the numeric-diff target (matches reference OpTest's numeric grad)."""
+    _, outs = _run_op(case, np_inputs)
+    total = 0.0
+    for o, p in zip(outs, projs):
+        total += float(np.sum(np.asarray(o.numpy(), np.float64) * p))
+    return total
+
+
+def check_grad(case, seed=0, eps=1e-3):
+    rs = np.random.RandomState(seed + 1)
+    np_inputs = tuple(
+        np.asarray(a, np.float64).astype(a.dtype) for a in case.make_inputs(rs)
+    )
+    # promote float inputs to float64? tape runs the op in its native dtype;
+    # use float32 inputs as declared, numeric diff in float64 arithmetic.
+    tensors, outs = _run_op(case, np_inputs, requires_grad=True)
+    projs = [rs.uniform(-1, 1, size=np.asarray(o.numpy()).shape) for o in outs]
+
+    # analytic: tape backward of sum(out * proj)
+    loss = None
+    for o, p in zip(outs, projs):
+        term = (o * paddle.to_tensor(p.astype(np.asarray(o.numpy()).dtype))).sum()
+        loss = term if loss is None else loss + term
+    loss.backward()
+
+    idxs = case.grad_idx
+    if idxs is None:
+        idxs = [
+            i for i, a in enumerate(np_inputs)
+            if np.issubdtype(np.asarray(a).dtype, np.floating)
+        ]
+    for i in idxs:
+        t = tensors[i]
+        assert t.grad is not None, f"{case.name}: no grad reached input {i}"
+        analytic = np.asarray(t.grad.numpy(), np.float64)
+        a = np_inputs[i]
+        numeric = np.zeros(a.shape, np.float64)
+        flat = a.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            step = eps * max(1.0, abs(float(orig)))
+            plus = list(np_inputs)
+            minus = list(np_inputs)
+            ap = a.copy().reshape(-1)
+            ap[j] = orig + step
+            plus[i] = ap.reshape(a.shape).astype(a.dtype)
+            am = a.copy().reshape(-1)
+            am[j] = orig - step
+            minus[i] = am.reshape(a.shape).astype(a.dtype)
+            numeric.reshape(-1)[j] = (
+                _loss_np(case, tuple(plus), projs)
+                - _loss_np(case, tuple(minus), projs)
+            ) / (2 * step)
+        denom = max(np.abs(numeric).max(), np.abs(analytic).max(), 1.0)
+        np.testing.assert_allclose(
+            analytic / denom, numeric / denom, rtol=case.gtol, atol=case.gtol,
+            err_msg=f"{case.name} grad wrt input {i}",
+        )

@@ -107,6 +107,38 @@ def test_loaded_artifact_weight_swap(tmp_path):
     assert np.allclose(loaded(x).numpy(), net2(x).numpy(), atol=1e-6)
 
 
+def test_artifact_buffer_swap_batchnorm(tmp_path):
+    """set_state_dict on a loaded artifact must swap BUFFERS too (BatchNorm
+    running stats), not only parameters."""
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(4, 6), nn.BatchNorm1D(6))
+    # train a few steps so running stats move away from init
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    for _ in range(3):
+        net.train()
+        out = net(paddle.to_tensor(np.random.RandomState(0).rand(8, 4).astype(np.float32)))
+        out.sum().backward()
+        opt.step()
+        opt.clear_grad()
+    net.eval()
+    path = str(tmp_path / "bnmodel")
+    jit.save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = jit.load(path)
+
+    # second model with different running stats
+    paddle.seed(9)
+    net2 = nn.Sequential(nn.Linear(4, 6), nn.BatchNorm1D(6))
+    for _ in range(5):
+        net2.train()
+        out = net2(paddle.to_tensor(np.random.RandomState(7).rand(8, 4).astype(np.float32) * 3))
+        out.sum().backward()
+    net2.eval()
+
+    loaded.set_state_dict(net2.state_dict())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    assert np.allclose(loaded(x).numpy(), net2(x).numpy(), atol=1e-5)
+
+
 def test_conv_model_symbolic_batch(tmp_path):
     """Conv+flatten models (shape math over symbolic dims) export too."""
     from paddle_tpu.vision.models import LeNet

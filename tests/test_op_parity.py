@@ -1,0 +1,381 @@
+"""Systematic op parity sweep: numpy forward reference + numeric-vs-autodiff
+gradient checks over the op library (VERDICT round-2 item 5; reference
+unittests/op_test.py:326). One OpCase per enrolled op; exemptions in
+op_test_whitelist.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import (
+    activation as A,
+    common_nn as CN,
+    conv_pool as CP,
+    creation as CR,
+    linalg as L,
+    logic as LG,
+    loss_ops as LO,
+    manipulation as MA,
+    math as M,
+    norm_ops as NO,
+    search as S,
+)
+
+from op_test import OpCase, check_grad, check_output
+from op_test_whitelist import FWD_RTOL, GRAD_TOL, NO_GRAD_CHECK
+
+try:
+    from scipy import special as sps
+except Exception:  # pragma: no cover
+    sps = None
+
+
+# ---- input makers -----------------------------------------------------------
+
+def n(*shape, lo=-1.0, hi=1.0, dtype=np.float32):
+    def make(rs):
+        return (rs.uniform(lo, hi, shape).astype(dtype),)
+    return make
+
+
+def n2(*shape, lo=-1.0, hi=1.0):
+    def make(rs):
+        return (
+            rs.uniform(lo, hi, shape).astype(np.float32),
+            rs.uniform(lo, hi, shape).astype(np.float32),
+        )
+    return make
+
+
+def pos(*shape, lo=0.2, hi=2.0):
+    return n(*shape, lo=lo, hi=hi)
+
+
+def unit(*shape):  # open interval (0, 1) away from endpoints
+    return n(*shape, lo=0.05, hi=0.95)
+
+
+def ints(*shape, lo=0, hi=8):
+    def make(rs):
+        return (rs.randint(lo, hi, shape).astype(np.int32),)
+    return make
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _spd(rs, k):
+    a = rs.uniform(-1, 1, (k, k)).astype(np.float32)
+    return (a @ a.T + k * np.eye(k, dtype=np.float32),)
+
+
+# ---- the enrolment table ----------------------------------------------------
+
+CASES = [
+    # math: elementwise binary
+    OpCase("add", M.add, n2(3, 4), np.add),
+    OpCase("subtract", M.subtract, n2(3, 4), np.subtract),
+    OpCase("multiply", M.multiply, n2(3, 4), np.multiply),
+    OpCase("divide", M.divide, lambda rs: (rs.uniform(-1, 1, (3, 4)).astype(np.float32), rs.uniform(0.5, 2, (3, 4)).astype(np.float32)), np.divide),
+    OpCase("pow", M.pow, lambda rs: (rs.uniform(0.5, 2, (3, 4)).astype(np.float32), np.float32(2.3)), lambda a, b: a ** b),
+    OpCase("maximum", M.maximum, n2(3, 4), np.maximum),
+    OpCase("minimum", M.minimum, n2(3, 4), np.minimum),
+    OpCase("fmax", M.fmax, n2(3, 4), np.fmax),
+    OpCase("fmin", M.fmin, n2(3, 4), np.fmin),
+    OpCase("mod", M.mod, lambda rs: (rs.uniform(0, 4, (6,)).astype(np.float32), rs.uniform(1, 3, (6,)).astype(np.float32)), np.mod),
+    OpCase("floor_divide", M.floor_divide, lambda rs: (rs.uniform(1, 9, (6,)).astype(np.float32), rs.uniform(1, 3, (6,)).astype(np.float32)), np.floor_divide, grad=False),
+    OpCase("atan2", M.atan2, n2(3, 4), np.arctan2),
+    OpCase("copysign", M.copysign, n2(3, 4), np.copysign, grad=False),
+    OpCase("hypot", M.hypot, lambda rs: (rs.uniform(0.5, 2, (5,)).astype(np.float32), rs.uniform(0.5, 2, (5,)).astype(np.float32)), np.hypot),
+    OpCase("logaddexp", M.logaddexp, n2(3, 4), np.logaddexp),
+    OpCase("heaviside", M.heaviside, n2(3, 4), np.heaviside),
+    OpCase("nextafter", M.nextafter, n2(4,), np.nextafter, grad=False),
+    OpCase("lerp", M.lerp, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(3, 4).astype(np.float32), np.float32(0.3)), lambda a, b, w: a + w * (b - a)),
+    OpCase("gcd", M.gcd, lambda rs: (rs.randint(1, 40, (6,)), rs.randint(1, 40, (6,))), np.gcd, grad=False),
+    OpCase("lcm", M.lcm, lambda rs: (rs.randint(1, 12, (6,)), rs.randint(1, 12, (6,))), np.lcm, grad=False),
+    # math: elementwise unary
+    OpCase("abs", M.abs, n(3, 4, lo=0.2, hi=1.0), np.abs),
+    OpCase("neg", M.neg, n(3, 4), np.negative),
+    OpCase("exp", M.exp, n(3, 4), np.exp),
+    OpCase("expm1", M.expm1, n(3, 4), np.expm1),
+    OpCase("log", M.log, pos(3, 4), np.log),
+    OpCase("log2", M.log2, pos(3, 4), np.log2),
+    OpCase("log10", M.log10, pos(3, 4), np.log10),
+    OpCase("log1p", M.log1p, pos(3, 4), np.log1p),
+    OpCase("sqrt", M.sqrt, pos(3, 4), np.sqrt),
+    OpCase("rsqrt", M.rsqrt, pos(3, 4), lambda a: 1.0 / np.sqrt(a)),
+    OpCase("square", M.square, n(3, 4), np.square),
+    OpCase("reciprocal", M.reciprocal, pos(3, 4), np.reciprocal),
+    OpCase("sin", M.sin, n(3, 4), np.sin),
+    OpCase("cos", M.cos, n(3, 4), np.cos),
+    OpCase("tan", M.tan, n(3, 4), np.tan),
+    OpCase("asin", M.asin, n(3, 4, lo=-0.8, hi=0.8), np.arcsin),
+    OpCase("acos", M.acos, n(3, 4, lo=-0.8, hi=0.8), np.arccos),
+    OpCase("atan", M.atan, n(3, 4), np.arctan),
+    OpCase("sinh", M.sinh, n(3, 4), np.sinh),
+    OpCase("cosh", M.cosh, n(3, 4), np.cosh),
+    OpCase("tanh", M.tanh, n(3, 4), np.tanh),
+    OpCase("asinh", M.asinh, n(3, 4), np.arcsinh),
+    OpCase("acosh", M.acosh, n(3, 4, lo=1.5, hi=3.0), np.arccosh),
+    OpCase("atanh", M.atanh, n(3, 4, lo=-0.7, hi=0.7), np.arctanh),
+    OpCase("floor", M.floor, n(3, 4, lo=-3, hi=3), np.floor),
+    OpCase("ceil", M.ceil, n(3, 4, lo=-3, hi=3), np.ceil),
+    OpCase("round", M.round, n(3, 4, lo=-3, hi=3), np.round),
+    OpCase("trunc", M.trunc, n(3, 4, lo=-3, hi=3), np.trunc),
+    OpCase("frac", M.frac, n(3, 4, lo=-3, hi=3), lambda a: a - np.trunc(a)),
+    OpCase("sign", M.sign, n(3, 4), np.sign),
+    OpCase("sigmoid", M.sigmoid, n(3, 4), lambda a: 1 / (1 + np.exp(-a))),
+    OpCase("erf", M.erf, n(3, 4), (lambda a: sps.erf(a)) if sps else None),
+    OpCase("erfinv", M.erfinv, n(3, 4, lo=-0.7, hi=0.7), (lambda a: sps.erfinv(a)) if sps else None),
+    OpCase("lgamma", M.lgamma, pos(3, 4, lo=0.5, hi=3.0), (lambda a: sps.gammaln(a)) if sps else None),
+    OpCase("digamma", M.digamma, pos(3, 4, lo=0.5, hi=3.0), (lambda a: sps.digamma(a)) if sps else None),
+    OpCase("i0", M.i0, n(3, 4), (lambda a: sps.i0(a)) if sps else None),
+    OpCase("i1", M.i1, n(3, 4), (lambda a: sps.i1(a)) if sps else None),
+    OpCase("logit", M.logit, unit(3, 4), (lambda a: sps.logit(a)) if sps else None),
+    OpCase("deg2rad", M.deg2rad, n(5,), np.deg2rad),
+    OpCase("rad2deg", M.rad2deg, n(5,), np.rad2deg),
+    OpCase("isnan", M.isnan, lambda rs: (np.array([1.0, np.nan, np.inf], np.float32),), np.isnan, grad=False),
+    OpCase("isinf", M.isinf, lambda rs: (np.array([1.0, np.nan, np.inf], np.float32),), np.isinf, grad=False),
+    OpCase("isfinite", M.isfinite, lambda rs: (np.array([1.0, np.nan, np.inf], np.float32),), np.isfinite, grad=False),
+    OpCase("nan_to_num", M.nan_to_num, lambda rs: (np.array([1.0, np.nan, np.inf, -np.inf], np.float32),), np.nan_to_num, grad=False),
+    # math: reductions
+    OpCase("sum", M.sum, n(3, 4), np.sum, kwargs={"axis": 1}, ref_kwargs=True),
+    OpCase("mean", M.mean, n(3, 4), np.mean, kwargs={"axis": 0}, ref_kwargs=True),
+    OpCase("max", M.max, n(3, 4), lambda a: np.max(a, axis=1), kwargs={"axis": 1}),
+    OpCase("min", M.min, n(3, 4), lambda a: np.min(a, axis=1), kwargs={"axis": 1}),
+    OpCase("amax", M.amax, n(3, 4), lambda a: np.max(a, axis=1), kwargs={"axis": 1}),
+    OpCase("amin", M.amin, n(3, 4), lambda a: np.min(a, axis=1), kwargs={"axis": 1}),
+    OpCase("prod", M.prod, pos(2, 3), lambda a: np.prod(a, axis=1), kwargs={"axis": 1}),
+    OpCase("std", M.std, n(3, 4), lambda a: np.std(a, ddof=1)),
+    OpCase("var", M.var, n(3, 4), lambda a: np.var(a, ddof=1), gtol=1e-2),
+    OpCase("median", M.median, n(3, 5), np.median),
+    OpCase("nanmean", M.nanmean, lambda rs: (np.where(rs.rand(3, 4) < 0.2, np.nan, rs.rand(3, 4)).astype(np.float32),), np.nanmean, grad=False),
+    OpCase("nansum", M.nansum, lambda rs: (np.where(rs.rand(3, 4) < 0.2, np.nan, rs.rand(3, 4)).astype(np.float32),), np.nansum, grad=False),
+    OpCase("logsumexp", M.logsumexp, n(3, 4), lambda a: np.log(np.sum(np.exp(a)))),
+    OpCase("count_nonzero", M.count_nonzero, lambda rs: (rs.randint(0, 2, (3, 4)).astype(np.float32),), np.count_nonzero, grad=False),
+    OpCase("all", M.all, lambda rs: (rs.randint(0, 2, (3, 4)).astype(bool),), np.all, grad=False),
+    OpCase("any", M.any, lambda rs: (rs.randint(0, 2, (3, 4)).astype(bool),), np.any, grad=False),
+    # math: scans & misc
+    OpCase("cumsum", M.cumsum, n(3, 4), lambda a: np.cumsum(a, axis=1), kwargs={"axis": 1}),
+    OpCase("cumprod", M.cumprod, pos(2, 3), lambda a: np.cumprod(a, axis=1), kwargs={"dim": 1}),
+    OpCase("clip", M.clip, n(3, 4, lo=-2, hi=2), lambda a: np.clip(a, -0.5, 0.5), kwargs={"min": -0.5, "max": 0.5}),
+    OpCase("diff", M.diff, n(2, 5), lambda a: np.diff(a, axis=-1)),
+    OpCase("kron", M.kron, lambda rs: (rs.rand(2, 2).astype(np.float32), rs.rand(2, 3).astype(np.float32)), np.kron),
+    OpCase("trace", M.trace, n(4, 4), np.trace),
+    OpCase("diagonal", M.diagonal, n(3, 4), lambda a: np.diagonal(a)),
+    OpCase("outer", M.outer, lambda rs: (rs.rand(3).astype(np.float32), rs.rand(4).astype(np.float32)), np.outer),
+    OpCase("inner", M.inner, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(2, 4).astype(np.float32)), np.inner),
+    OpCase("scale", M.scale, n(3, 4), lambda a: a * 2.5 + 1.0, kwargs={"scale": 2.5, "bias": 1.0}),
+    OpCase("remainder", M.remainder, lambda rs: (rs.uniform(0, 4, (6,)).astype(np.float32), rs.uniform(1, 3, (6,)).astype(np.float32)), np.remainder),
+    OpCase("real", M.real, lambda rs: ((rs.rand(3, 2) + 1j * rs.rand(3, 2)).astype(np.complex64),), np.real, grad=False),
+    OpCase("imag", M.imag, lambda rs: ((rs.rand(3, 2) + 1j * rs.rand(3, 2)).astype(np.complex64),), np.imag, grad=False),
+    OpCase("conj", M.conj, lambda rs: ((rs.rand(3, 2) + 1j * rs.rand(3, 2)).astype(np.complex64),), np.conj, grad=False),
+    OpCase("angle", M.angle, lambda rs: ((rs.rand(3, 2) + 0.5 + 1j * rs.rand(3, 2)).astype(np.complex64),), np.angle, grad=False),
+    # activation
+    OpCase("relu", A.relu, n(3, 4, lo=0.1, hi=1.0), lambda a: np.maximum(a, 0)),
+    OpCase("leaky_relu", A.leaky_relu, n(3, 4, lo=0.1), lambda a: np.where(a >= 0, a, 0.01 * a)),
+    OpCase("gelu", A.gelu, n(3, 4), (lambda a: a * sps.ndtr(a)) if sps else None),
+    OpCase("silu", A.silu, n(3, 4), lambda a: a / (1 + np.exp(-a))),
+    OpCase("swish", A.swish, n(3, 4), lambda a: a / (1 + np.exp(-a))),
+    OpCase("elu", A.elu, n(3, 4), lambda a: np.where(a > 0, a, np.exp(a) - 1)),
+    OpCase("celu", A.celu, n(3, 4), lambda a: np.maximum(a, 0) + np.minimum(0, np.exp(a) - 1)),
+    OpCase("selu", A.selu, n(3, 4), lambda a: 1.0507009873554805 * np.where(a > 0, a, 1.6732632423543772 * (np.exp(a) - 1))),
+    OpCase("relu6", A.relu6, n(3, 4, lo=-1, hi=7), lambda a: np.minimum(np.maximum(a, 0), 6)),
+    OpCase("softplus", A.softplus, n(3, 4), lambda a: np.log1p(np.exp(a))),
+    OpCase("softsign", A.softsign, n(3, 4), lambda a: a / (1 + np.abs(a))),
+    OpCase("tanhshrink", A.tanhshrink, n(3, 4), lambda a: a - np.tanh(a)),
+    OpCase("hardtanh", A.hardtanh, n(3, 4, lo=-2, hi=2), lambda a: np.clip(a, -1, 1)),
+    OpCase("hardshrink", A.hardshrink, n(3, 4, lo=-2, hi=2), lambda a: np.where(np.abs(a) > 0.5, a, 0)),
+    OpCase("softshrink", A.softshrink, n(3, 4, lo=-2, hi=2), lambda a: np.where(a > 0.5, a - 0.5, np.where(a < -0.5, a + 0.5, 0))),
+    OpCase("hardsigmoid", A.hardsigmoid, n(3, 4, lo=-4, hi=4), lambda a: np.clip(a / 6 + 0.5, 0, 1)),
+    OpCase("hardswish", A.hardswish, n(3, 4, lo=-4, hi=4), lambda a: a * np.clip(a / 6 + 0.5, 0, 1)),
+    OpCase("mish", A.mish, n(3, 4), lambda a: a * np.tanh(np.log1p(np.exp(a)))),
+    OpCase("log_sigmoid", A.log_sigmoid, n(3, 4), lambda a: -np.log1p(np.exp(-a))),
+    OpCase("softmax", A.softmax, n(3, 4), lambda a: _softmax_np(a), kwargs={"axis": -1}),
+    OpCase("log_softmax", A.log_softmax, n(3, 4), lambda a: np.log(_softmax_np(a)), kwargs={"axis": -1}),
+    OpCase("stanh", M.stanh, n(3, 4), lambda a: 1.7159 * np.tanh(0.67 * a)),
+    OpCase("thresholded_relu", A.thresholded_relu, n(3, 4, lo=-2, hi=3), lambda a: np.where(a > 1.0, a, 0)),
+    OpCase("glu", A.glu, n(3, 4), lambda a: a[:, :2] * (1 / (1 + np.exp(-a[:, 2:]))), gtol=1e-2),
+    # linalg
+    OpCase("matmul", L.matmul, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(4, 5).astype(np.float32)), np.matmul),
+    OpCase("bmm", L.bmm, lambda rs: (rs.rand(2, 3, 4).astype(np.float32), rs.rand(2, 4, 5).astype(np.float32)), np.matmul),
+    OpCase("mm", L.mm, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(4, 5).astype(np.float32)), np.matmul),
+    OpCase("mv", L.mv, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(4).astype(np.float32)), np.matmul),
+    OpCase("dot", L.dot, lambda rs: (rs.rand(5).astype(np.float32), rs.rand(5).astype(np.float32)), np.dot),
+    OpCase("cross", L.cross, lambda rs: (rs.rand(4, 3).astype(np.float32), rs.rand(4, 3).astype(np.float32)), lambda a, b: np.cross(a, b)),
+    OpCase("det", L.det, lambda rs: _spd(rs, 3), np.linalg.det),
+    OpCase("slogdet", L.slogdet, lambda rs: _spd(rs, 3), lambda a: np.stack(np.linalg.slogdet(a)), grad=False),
+    OpCase("inv", L.inv, lambda rs: _spd(rs, 3), np.linalg.inv),
+    OpCase("matrix_power", L.matrix_power, lambda rs: _spd(rs, 3), lambda a: np.linalg.matrix_power(a, 2), kwargs={"n": 2}),
+    OpCase("cholesky", L.cholesky, lambda rs: _spd(rs, 3), np.linalg.cholesky),
+    OpCase("solve", L.solve, lambda rs: _spd(rs, 3) + (rs.rand(3, 2).astype(np.float32),), np.linalg.solve),
+    OpCase("norm", L.norm, n(3, 4), np.linalg.norm, gtol=1e-2),
+    OpCase("vector_norm", L.vector_norm, n(6,), np.linalg.norm, gtol=1e-2),
+    OpCase("multi_dot", lambda a, b, c: L.multi_dot([a, b, c]), lambda rs: (rs.rand(2, 3).astype(np.float32), rs.rand(3, 4).astype(np.float32), rs.rand(4, 2).astype(np.float32)), lambda a, b, c: a @ b @ c),
+    OpCase("einsum", lambda a, b: L.einsum("ij,jk->ik", a, b), lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(4, 2).astype(np.float32)), np.matmul),
+    OpCase("pinv", L.pinv, lambda rs: (rs.rand(4, 3).astype(np.float32),), np.linalg.pinv, grad=False, rtol=1e-4, atol=1e-5),
+    OpCase("qr", L.qr, lambda rs: (rs.rand(4, 3).astype(np.float32),), lambda a: list(np.linalg.qr(a)), grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("svd", L.svd, lambda rs: (rs.rand(3, 3).astype(np.float32) + 2 * np.eye(3, dtype=np.float32),), None, grad=False),
+    OpCase("eigvalsh", L.eigvalsh, lambda rs: _spd(rs, 3), np.linalg.eigvalsh, grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("cov", L.cov, n(3, 6), lambda a: np.cov(a), gtol=1e-2),
+    OpCase("corrcoef", L.corrcoef, n(3, 6), lambda a: np.corrcoef(a), grad=False, rtol=1e-4, atol=1e-5),
+    OpCase("dist", L.dist, n2(3, 4), lambda a, b: np.linalg.norm((a - b).ravel())),
+    # manipulation
+    OpCase("reshape", MA.reshape, n(3, 4), lambda a: a.reshape(2, 6), kwargs={"shape": [2, 6]}),
+    OpCase("transpose", MA.transpose, n(2, 3, 4), lambda a: a.transpose(2, 0, 1), kwargs={"perm": [2, 0, 1]}),
+    OpCase("t", MA.t, n(3, 4), lambda a: a.T),
+    OpCase("concat", lambda a, b: MA.concat([a, b], axis=1), n2(3, 4), lambda a, b: np.concatenate([a, b], 1)),
+    OpCase("stack", lambda a, b: MA.stack([a, b], axis=0), n2(3, 4), lambda a, b: np.stack([a, b], 0)),
+    OpCase("split", MA.split, n(4, 6), lambda a: list(np.split(a, 2, 1)), kwargs={"num_or_sections": 2, "axis": 1}),
+    OpCase("chunk", MA.chunk, n(4, 6), lambda a: list(np.split(a, 2, 0)), kwargs={"chunks": 2, "axis": 0}),
+    OpCase("squeeze", MA.squeeze, n(3, 1, 4), lambda a: a.squeeze(1), kwargs={"axis": 1}),
+    OpCase("unsqueeze", MA.unsqueeze, n(3, 4), lambda a: a[:, None], kwargs={"axis": 1}),
+    OpCase("flatten", MA.flatten, n(2, 3, 4), lambda a: a.reshape(2, 12), kwargs={"start_axis": 1, "stop_axis": 2}),
+    OpCase("tile", MA.tile, n(2, 3), lambda a: np.tile(a, (2, 2)), kwargs={"repeat_times": [2, 2]}),
+    OpCase("expand", MA.expand, n(1, 3), lambda a: np.broadcast_to(a, (4, 3)), kwargs={"shape": [4, 3]}),
+    OpCase("broadcast_to", MA.broadcast_to, n(1, 3), lambda a: np.broadcast_to(a, (4, 3)), kwargs={"shape": [4, 3]}),
+    OpCase("roll", MA.roll, n(3, 4), lambda a: np.roll(a, 2), kwargs={"shifts": 2}),
+    OpCase("flip", MA.flip, n(3, 4), lambda a: np.flip(a, 1), kwargs={"axis": 1}),
+    OpCase("rot90", MA.rot90, n(3, 4), lambda a: np.rot90(a)),
+    OpCase("moveaxis", MA.moveaxis, n(2, 3, 4), lambda a: np.moveaxis(a, 0, 2), kwargs={"source": 0, "destination": 2}),
+    OpCase("swapaxes", MA.swapaxes, n(2, 3, 4), lambda a: np.swapaxes(a, 0, 2), kwargs={"axis0": 0, "axis1": 2}),
+    OpCase("pad_manip", MA.pad, n(2, 3), lambda a: np.pad(a, ((1, 1), (2, 2))), kwargs={"pad": [1, 1, 2, 2]}),
+    OpCase("gather", MA.gather, lambda rs: (rs.rand(5, 3).astype(np.float32), np.array([0, 2, 4])), lambda a, i: a[i]),
+    OpCase("index_select", MA.index_select, lambda rs: (rs.rand(5, 3).astype(np.float32), np.array([0, 2])), lambda a, i: a[i], kwargs={"axis": 0}),
+    OpCase("take", MA.take, lambda rs: (rs.rand(3, 4).astype(np.float32), np.array([0, 5, 11])), lambda a, i: np.take(a, i)),
+    OpCase("take_along_axis", MA.take_along_axis, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.randint(0, 4, (3, 2))), lambda a, i: np.take_along_axis(a, i, 1), kwargs={"axis": 1}),
+    OpCase("gather_nd", MA.gather_nd, lambda rs: (rs.rand(3, 4).astype(np.float32), np.array([[0, 1], [2, 3]])), lambda a, i: a[tuple(i.T)]),
+    OpCase("repeat_interleave", MA.repeat_interleave, n(2, 3), lambda a: np.repeat(a, 2, 1), kwargs={"repeats": 2, "axis": 1}),
+    OpCase("unbind", MA.unbind, n(3, 4), lambda a: list(a), kwargs={"axis": 0}),
+    OpCase("unstack", MA.unbind, n(3, 4), lambda a: list(a)),
+    OpCase("slice", MA.slice, n(4, 5), lambda a: a[1:3], kwargs={"axes": [0], "starts": [1], "ends": [3]}),
+    OpCase("strided_slice", MA.strided_slice, n(4, 6), lambda a: a[:, 1:6:2], kwargs={"axes": [1], "starts": [1], "ends": [6], "strides": [2]}),
+    OpCase("crop", MA.crop, n(4, 5), lambda a: a[1:3, 2:5], kwargs={"shape": [2, 3], "offsets": [1, 2]}),
+    OpCase("where_op", MA.where, lambda rs: (rs.rand(3, 4) > 0.5, rs.rand(3, 4).astype(np.float32), rs.rand(3, 4).astype(np.float32)), np.where),
+    OpCase("masked_fill", MA.masked_fill, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(3, 4) > 0.5, np.float32(9.0)), lambda a, m, v: np.where(m, v, a), grad_idx=[0]),
+    OpCase("index_sample", MA.index_sample, lambda rs: (rs.rand(3, 5).astype(np.float32), rs.randint(0, 5, (3, 2))), lambda a, i: np.take_along_axis(a, i, 1)),
+    OpCase("tensordot", MA.tensordot, lambda rs: (rs.rand(2, 3, 4).astype(np.float32), rs.rand(4, 3, 5).astype(np.float32)), lambda a, b: np.tensordot(a, b, axes=1), kwargs={"axes": 1}),
+    OpCase("as_strided_cast", MA.cast, n(3, 4), lambda a: a.astype(np.float64), kwargs={"dtype": "float64"}, grad=False),
+    OpCase("nonzero", MA.nonzero, lambda rs: (np.array([[1.0, 0.0], [0.0, 2.0]], np.float32),), lambda a: np.stack(np.nonzero(a), 1), grad=False),
+    OpCase("unique", MA.unique, lambda rs: (np.array([3, 1, 2, 1, 3], np.int64),), np.unique, grad=False),
+    OpCase("scatter_nd_add", MA.scatter_nd_add, lambda rs: (rs.rand(5, 3).astype(np.float32), np.array([[1], [3]]), rs.rand(2, 3).astype(np.float32)), None, grad=False),
+    # creation (forward-only where random or trivial)
+    OpCase("zeros", lambda: CR.zeros([2, 3]), lambda rs: (), lambda: np.zeros((2, 3), np.float32), grad=False),
+    OpCase("ones", lambda: CR.ones([2, 3]), lambda rs: (), lambda: np.ones((2, 3), np.float32), grad=False),
+    OpCase("full", lambda: CR.full([2, 2], 7.0), lambda rs: (), lambda: np.full((2, 2), 7.0, np.float32), grad=False),
+    OpCase("arange", lambda: CR.arange(0, 10, 2), lambda rs: (), lambda: np.arange(0, 10, 2), grad=False),
+    OpCase("linspace", lambda: CR.linspace(0.0, 1.0, 5), lambda rs: (), lambda: np.linspace(0, 1, 5, dtype=np.float32), grad=False),
+    OpCase("logspace", lambda: CR.logspace(0.0, 2.0, 3), lambda rs: (), lambda: np.logspace(0, 2, 3, dtype=np.float32), grad=False, rtol=1e-4),
+    OpCase("eye", lambda: CR.eye(3, 4), lambda rs: (), lambda: np.eye(3, 4, dtype=np.float32), grad=False),
+    OpCase("tril", CR.tril, n(4, 4), np.tril),
+    OpCase("triu", CR.triu, n(4, 4), np.triu),
+    OpCase("diag", CR.diag, n(4,), np.diag, grad=False),
+    OpCase("diagflat", CR.diagflat, n(4,), np.diagflat, grad=False),
+    OpCase("zeros_like", CR.zeros_like, n(2, 3), np.zeros_like, grad=False),
+    OpCase("ones_like", CR.ones_like, n(2, 3), np.ones_like, grad=False),
+    OpCase("full_like", CR.full_like, n(2, 3), lambda a: np.full_like(a, 5.0), kwargs={"fill_value": 5.0}, grad=False),
+    OpCase("numel", CR.numel, n(2, 3), lambda a: np.int64(a.size), grad=False),
+    OpCase("meshgrid", lambda a, b: CR.meshgrid(a, b), lambda rs: (rs.rand(3).astype(np.float32), rs.rand(2).astype(np.float32)), lambda a, b: list(np.meshgrid(a, b, indexing="ij")), grad=False),
+    OpCase("as_complex", CR.as_complex, n(3, 2), lambda a: (a[..., 0] + 1j * a[..., 1]).astype(np.complex64), grad=False),
+    OpCase("as_real", CR.as_real, lambda rs: ((rs.rand(3) + 1j * rs.rand(3)).astype(np.complex64),), lambda a: np.stack([a.real, a.imag], -1), grad=False),
+    # logic
+    OpCase("equal", LG.equal, lambda rs: (np.array([1, 2, 3], np.int64), np.array([1, 0, 3], np.int64)), np.equal, grad=False),
+    OpCase("not_equal", LG.not_equal, lambda rs: (np.array([1, 2], np.int64), np.array([1, 3], np.int64)), np.not_equal, grad=False),
+    OpCase("greater_than", LG.greater_than, n2(3, 4), np.greater, grad=False),
+    OpCase("greater_equal", LG.greater_equal, n2(3, 4), np.greater_equal, grad=False),
+    OpCase("less_than", LG.less_than, n2(3, 4), np.less, grad=False),
+    OpCase("less_equal", LG.less_equal, n2(3, 4), np.less_equal, grad=False),
+    OpCase("logical_and", LG.logical_and, lambda rs: (rs.rand(4) > 0.5, rs.rand(4) > 0.5), np.logical_and, grad=False),
+    OpCase("logical_or", LG.logical_or, lambda rs: (rs.rand(4) > 0.5, rs.rand(4) > 0.5), np.logical_or, grad=False),
+    OpCase("logical_not", LG.logical_not, lambda rs: (rs.rand(4) > 0.5,), np.logical_not, grad=False),
+    OpCase("logical_xor", LG.logical_xor, lambda rs: (rs.rand(4) > 0.5, rs.rand(4) > 0.5), np.logical_xor, grad=False),
+    OpCase("bitwise_and", LG.bitwise_and, lambda rs: (rs.randint(0, 16, (5,)), rs.randint(0, 16, (5,))), np.bitwise_and, grad=False),
+    OpCase("bitwise_or", LG.bitwise_or, lambda rs: (rs.randint(0, 16, (5,)), rs.randint(0, 16, (5,))), np.bitwise_or, grad=False),
+    OpCase("bitwise_xor", LG.bitwise_xor, lambda rs: (rs.randint(0, 16, (5,)), rs.randint(0, 16, (5,))), np.bitwise_xor, grad=False),
+    OpCase("bitwise_not", LG.bitwise_not, lambda rs: (rs.randint(0, 16, (5,)),), np.bitwise_not, grad=False),
+    OpCase("isclose", LG.isclose, lambda rs: (np.array([1.0, 2.0], np.float32), np.array([1.0, 2.1], np.float32)), np.isclose, grad=False),
+    # search
+    OpCase("argmax", S.argmax, n(3, 4), lambda a: np.argmax(a, 1), kwargs={"axis": 1}, grad=False),
+    OpCase("argmin", S.argmin, n(3, 4), lambda a: np.argmin(a, 1), kwargs={"axis": 1}, grad=False),
+    OpCase("argsort", S.argsort, n(3, 4), lambda a: np.argsort(a, 1, kind="stable"), kwargs={"axis": 1}, grad=False),
+    OpCase("sort", S.sort, n(3, 4), lambda a: np.sort(a, 1), kwargs={"axis": 1}),
+    OpCase("topk", S.topk, n(3, 5), lambda a: [np.sort(a, 1)[:, ::-1][:, :2], np.argsort(-a, 1, kind="stable")[:, :2]], kwargs={"k": 2}, grad=False),
+    OpCase("kthvalue", S.kthvalue, n(3, 5), lambda a: [np.sort(a, 1)[:, 1], np.argsort(a, 1, kind="stable")[:, 1]], kwargs={"k": 2}, grad=False),
+    OpCase("searchsorted", S.searchsorted, lambda rs: (np.array([1.0, 3.0, 5.0, 7.0], np.float32), np.array([2.0, 6.0], np.float32)), np.searchsorted, grad=False),
+    OpCase("bucketize", S.bucketize, lambda rs: (np.array([2.0, 6.0], np.float32), np.array([1.0, 3.0, 5.0, 7.0], np.float32)), lambda x, e: np.searchsorted(e, x), grad=False),
+    OpCase("bincount", S.bincount, lambda rs: (np.array([0, 1, 1, 3], np.int64),), np.bincount, grad=False),
+    OpCase("histogram", S.histogram, lambda rs: (rs.rand(20).astype(np.float32),), lambda a: np.histogram(a, bins=4, range=(0, 1))[0], kwargs={"bins": 4, "min": 0, "max": 1}, grad=False),
+    OpCase("mode", S.mode, lambda rs: (np.array([[1.0, 1.0, 2.0], [3.0, 3.0, 1.0]], np.float32),), lambda a: [np.array([1.0, 3.0], np.float32), np.array([1, 1])], grad=False),
+    # common_nn / norm / conv / pool
+    OpCase("linear", CN.linear, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(4, 2).astype(np.float32), rs.rand(2).astype(np.float32)), lambda x, w, b: x @ w + b),
+    OpCase("one_hot", CN.one_hot, lambda rs: (np.array([0, 2, 1], np.int64),), lambda a: np.eye(4, dtype=np.float32)[a], kwargs={"num_classes": 4}, grad=False),
+    OpCase("embedding", CN.embedding, lambda rs: (np.array([[0, 2], [1, 1]], np.int64), rs.rand(4, 3).astype(np.float32)), lambda i, w: w[i], grad_idx=[1]),
+    OpCase("label_smooth", CN.label_smooth, lambda rs: (np.eye(3, dtype=np.float32)[np.array([0, 2])],), lambda a: a * 0.9 + 0.1 / 3, kwargs={"epsilon": 0.1}),
+    OpCase("cosine_similarity", LO.cosine_similarity, n2(3, 4, lo=0.2, hi=1.0), lambda a, b: np.sum(a * b, 1) / (np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1))),
+    OpCase("normalize", NO.normalize, n(3, 4, lo=0.2, hi=1.0), lambda a: a / np.linalg.norm(a, axis=1, keepdims=True)),
+    OpCase("layer_norm", lambda x, w, b: NO.layer_norm(x, [6], w, b), lambda rs: (rs.rand(2, 6).astype(np.float32), rs.rand(6).astype(np.float32), rs.rand(6).astype(np.float32)), lambda x, w, b: (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5) * w + b),
+    OpCase("rms_norm", NO.rms_norm, lambda rs: (rs.rand(2, 6).astype(np.float32), rs.rand(6).astype(np.float32)), lambda x, w: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w, kwargs={"epsilon": 1e-6}),
+    OpCase("conv2d", CP.conv2d, lambda rs: (rs.rand(1, 2, 4, 4).astype(np.float32), rs.rand(3, 2, 3, 3).astype(np.float32)), None, gtol=1e-2),
+    OpCase("conv2d_transpose", CP.conv2d_transpose, lambda rs: (rs.rand(1, 2, 3, 3).astype(np.float32), rs.rand(2, 3, 2, 2).astype(np.float32)), None, gtol=1e-2),
+    OpCase("max_pool2d", CP.max_pool2d, lambda rs: (rs.rand(1, 2, 4, 4).astype(np.float32),), None, kwargs={"kernel_size": 2}, grad=False),
+    OpCase("avg_pool2d", CP.avg_pool2d, lambda rs: (rs.rand(1, 2, 4, 4).astype(np.float32),), None, kwargs={"kernel_size": 2}),
+    OpCase("adaptive_avg_pool2d", CP.adaptive_avg_pool2d, lambda rs: (rs.rand(1, 2, 4, 4).astype(np.float32),), None, kwargs={"output_size": 2}),
+    OpCase("pixel_shuffle", CP.pixel_shuffle, lambda rs: (rs.rand(1, 4, 2, 2).astype(np.float32),), None, kwargs={"upscale_factor": 2}),
+    # losses
+    OpCase("mse_loss", LO.mse_loss, n2(3, 4), lambda a, b: np.mean((a - b) ** 2)),
+    OpCase("l1_loss", LO.l1_loss, n2(3, 4), lambda a, b: np.mean(np.abs(a - b)), gtol=1e-2),
+    OpCase("smooth_l1_loss", LO.smooth_l1_loss, n2(3, 4), None, gtol=1e-2),
+    OpCase("huber_loss", LO.huber_loss, n2(3, 4), None, gtol=1e-2),
+    OpCase("square_error_cost", LO.square_error_cost, n2(3, 4), lambda a, b: (a - b) ** 2),
+    OpCase("log_loss", LO.log_loss, lambda rs: (rs.uniform(0.1, 0.9, (4, 1)).astype(np.float32), rs.randint(0, 2, (4, 1)).astype(np.float32)), lambda p, y: -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4), grad_idx=[0]),
+    OpCase("binary_cross_entropy", LO.binary_cross_entropy, lambda rs: (rs.uniform(0.1, 0.9, (4,)).astype(np.float32), rs.randint(0, 2, (4,)).astype(np.float32)), lambda p, y: float(np.mean(-y * np.log(p) - (1 - y) * np.log(1 - p))), grad_idx=[0]),
+    OpCase("bce_with_logits", LO.binary_cross_entropy_with_logits, lambda rs: (rs.uniform(-2, 2, (4,)).astype(np.float32), rs.randint(0, 2, (4,)).astype(np.float32)), lambda x, y: float(np.mean(np.maximum(x, 0) - x * y + np.log1p(np.exp(-np.abs(x))))), grad_idx=[0], gtol=1e-2),
+    OpCase("kl_div", LO.kl_div, lambda rs: (np.log(_softmax_np(rs.rand(3, 4).astype(np.float32))), _softmax_np(rs.rand(3, 4).astype(np.float32))), None, grad_idx=[0]),
+    OpCase("nll_loss", LO.nll_loss, lambda rs: (np.log(_softmax_np(rs.rand(3, 4).astype(np.float32))), np.array([0, 2, 1], np.int64)), lambda lp, t: float(-np.mean(lp[np.arange(3), t])), grad_idx=[0], gtol=1e-2),
+    OpCase("cross_entropy", LO.cross_entropy, lambda rs: (rs.rand(3, 4).astype(np.float32), np.array([0, 2, 1], np.int64)), lambda x, t: float(-np.mean(np.log(_softmax_np(x))[np.arange(3), t])), grad_idx=[0]),
+    OpCase("softmax_with_cross_entropy", LO.softmax_with_cross_entropy, lambda rs: (rs.rand(3, 4).astype(np.float32), np.array([[0], [2], [1]], np.int64)), None, grad_idx=[0], gtol=1e-2),
+    OpCase("margin_ranking_loss", LO.margin_ranking_loss, lambda rs: (rs.rand(4).astype(np.float32), rs.rand(4).astype(np.float32), np.sign(rs.rand(4) - 0.5).astype(np.float32)), None, grad=False),
+    OpCase("hinge_embedding_loss", LO.hinge_embedding_loss, lambda rs: (rs.rand(4).astype(np.float32), np.sign(rs.rand(4) - 0.5).astype(np.float32)), None, grad=False),
+    OpCase("sigmoid_focal_loss", LO.sigmoid_focal_loss, lambda rs: (rs.uniform(-2, 2, (4, 1)).astype(np.float32), rs.randint(0, 2, (4, 1)).astype(np.float32)), None, grad_idx=[0]),
+    OpCase("triplet_margin_loss", LO.triplet_margin_loss, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(3, 4).astype(np.float32), rs.rand(3, 4).astype(np.float32)), None, grad=False),
+]
+
+# apply whitelist relaxations / removals
+for c in CASES:
+    if c.name in FWD_RTOL:
+        c.rtol = max(c.rtol, FWD_RTOL[c.name])
+        c.atol = max(c.atol, FWD_RTOL[c.name])
+    if c.name in GRAD_TOL:
+        c.gtol = max(c.gtol, GRAD_TOL[c.name])
+    if c.name in NO_GRAD_CHECK:
+        c.grad = False
+
+_IDS = [c.name for c in CASES]
+assert len(set(_IDS)) == len(_IDS), "duplicate OpCase names"
+
+
+def test_enrollment_count():
+    """The sweep must cover at least 100 ops (VERDICT item 5 bar)."""
+    assert len(CASES) >= 100, len(CASES)
+
+
+@pytest.mark.parametrize("case", CASES, ids=_IDS)
+def test_forward(case):
+    if case.ref is None:
+        if case.name == "svd":
+            # reconstruction check instead of a value reference
+            rs = np.random.RandomState(0)
+            (a,) = case.make_inputs(rs)
+            u, s, vh = [np.asarray(t.numpy()) for t in case.op(paddle.to_tensor(a))]
+            np.testing.assert_allclose(u @ np.diag(s) @ vh, a, atol=1e-4)
+            return
+        pytest.skip("no independent numpy reference (shape/grad-only op)")
+    check_output(case)
+
+
+@pytest.mark.parametrize(
+    "case", [c for c in CASES if c.grad], ids=[c.name for c in CASES if c.grad]
+)
+def test_grad(case):
+    check_grad(case)
