@@ -332,7 +332,8 @@ class Parameter(Tensor):
     sharding spec consumed by the distributed layer (GSPMD annotation — the
     TPU-native replacement for per-parameter placement in the reference)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "sharding_axes")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "sharding_axes", "process_mesh")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -342,6 +343,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.sharding_axes = None  # tuple of mesh-axis names or None per dim
+        self.process_mesh = None  # auto_parallel.ProcessMesh annotation
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

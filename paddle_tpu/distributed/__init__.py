@@ -45,6 +45,17 @@ from .parallel import (  # noqa: F401
 )
 from .fleet.meta_parallel.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import launch  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import ps  # noqa: F401
+from . import rpc  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    load_sharded_model,
+    load_state,
+    save_sharded_model,
+    save_state,
+)
 
 
 class sharding:

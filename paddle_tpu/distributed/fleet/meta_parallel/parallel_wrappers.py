@@ -288,8 +288,18 @@ def _split_batch(x, n):
 
 class HybridParallelOptimizer:
     """Reference hybrid_parallel_optimizer.py:186: wraps the inner optimizer;
-    grad clip stays global-norm-aware across mp/pp shards (GSPMD grads are
-    already global, so the inner clip is correct as-is)."""
+    grad clip stays global-norm-aware across mp/pp shards.
+
+    Why the inner ClipGradByGlobalNorm is exact here, including the explicit
+    compiled-1F1B path: grads land in Parameter._grad as GLOBAL jax.Arrays
+    (the pipeline's stage-sharded grad stack is indexed back per layer in
+    _build_pipe.run, and under the single/multi-controller jax model a
+    sharded jax.Array still has global value semantics — reductions over it
+    compile to the cross-stage psum the reference does by hand in
+    hybrid_parallel_optimizer's _global_norm). The clip's sum of squared
+    norms therefore spans every pipeline stage's parameters. Covered by
+    test_pipeline_schedules.py::test_fleet_pp_global_norm_clip (deliberately
+    skewed per-stage norms, compiled-1F1B == degree-1 fallback)."""
 
     def __init__(self, optimizer, hcg=None, strategy=None):
         self._inner_opt = optimizer

@@ -1,0 +1,191 @@
+"""PS-lite: parameter-server tables + client over the RPC agent.
+
+Reference parity: the brpc parameter server
+(/root/reference/paddle/fluid/distributed/ps/service/ps_client.h,
+ps/table/memory_sparse_table.h, memory_dense_table.h; python runtime
+distributed/ps/the_one_ps.py:1031).
+
+Scope (documented, deliberate): the reference PS is a 53K-LoC C++ system for
+CPU async/geo training with SSD spill, CTR accessors and GNN tables — a
+workload that on TPU is served by GSPMD-sharded embeddings inside the
+compiled step. What a TPU framework still needs PS for is host-side sparse
+state too big or too dynamic for HBM: this module provides exactly that —
+in-memory dense/sparse tables with pull/push + built-in optimizers, hosted
+in any RPC worker (distributed.rpc), with the PSClient call surface. No
+brpc, no SSD tier, no geo-async; those are descoped (see README).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class DenseTable:
+    """memory_dense_table.h role: a dense parameter block with SGD apply."""
+
+    def __init__(self, shape, lr=0.01, init=None, dtype=np.float32):
+        self.value = (
+            np.zeros(shape, dtype) if init is None else np.asarray(init, dtype).copy()
+        )
+        self.lr = float(lr)
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad):
+        with self._lock:
+            self.value -= self.lr * np.asarray(grad, self.value.dtype)
+
+
+class SparseTable:
+    """memory_sparse_table.h role: id -> row embedding with lazy init and a
+    per-row optimizer rule (sgd | adagrad, reference SparseSgdRule /
+    SparseAdaGradSGDRule in ps/table/sparse_sgd_rule.h)."""
+
+    def __init__(self, dim, lr=0.01, optimizer="sgd", init_scale=0.01,
+                 seed=0, dtype=np.float32):
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unsupported sparse optimizer {optimizer!r}")
+        self.rows = {}
+        self.g2 = {}  # adagrad accumulators
+        self._rng = np.random.RandomState(seed)
+        self._init_scale = init_scale
+        self._dtype = dtype
+        self._lock = threading.Lock()
+
+    def _row(self, i):
+        r = self.rows.get(i)
+        if r is None:
+            r = (self._rng.rand(self.dim).astype(self._dtype) - 0.5) * 2 * self._init_scale
+            self.rows[i] = r
+        return r
+
+    def pull(self, ids):
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in np.asarray(ids).reshape(-1)])
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, self._dtype).reshape(len(ids), self.dim)
+        with self._lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                row = self._row(i)
+                if self.optimizer == "adagrad":
+                    acc = self.g2.setdefault(i, np.zeros(self.dim, self._dtype))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+                else:
+                    row -= self.lr * g
+
+    def size(self):
+        with self._lock:
+            return len(self.rows)
+
+    def save(self):
+        with self._lock:
+            return {int(k): v.copy() for k, v in self.rows.items()}
+
+    def load(self, rows):
+        with self._lock:
+            self.rows = {int(k): np.asarray(v, self._dtype) for k, v in rows.items()}
+
+
+# ---- the in-process service (hosted by a server worker) ---------------------
+
+_TABLES = {}
+_TLOCK = threading.Lock()
+
+
+_TABLE_SPECS = {}
+
+
+def _svc_create_table(name, kind, **kw):
+    with _TLOCK:
+        spec = (kind, tuple(sorted(
+            (k, v if not isinstance(v, np.ndarray) else ("<init>", v.shape))
+            for k, v in kw.items()
+        )))
+        if name in _TABLES:
+            if _TABLE_SPECS.get(name) != spec:
+                raise ValueError(
+                    f"table {name!r} already exists with different config "
+                    f"{_TABLE_SPECS.get(name)} (requested {spec})"
+                )
+            return True
+        _TABLES[name] = (SparseTable if kind == "sparse" else DenseTable)(**kw)
+        _TABLE_SPECS[name] = spec
+    return True
+
+
+def _svc_pull_dense(name):
+    return _TABLES[name].pull()
+
+
+def _svc_push_dense(name, grad):
+    _TABLES[name].push(grad)
+    return True
+
+
+def _svc_pull_sparse(name, ids):
+    return _TABLES[name].pull(ids)
+
+
+def _svc_push_sparse(name, ids, grads):
+    _TABLES[name].push(ids, grads)
+    return True
+
+
+def _svc_save(name):
+    return _TABLES[name].save()
+
+
+def _svc_table_size(name):
+    return _TABLES[name].size()
+
+
+class PSClient:
+    """ps_client.h call surface over distributed.rpc: the server worker
+    hosts the tables; every method is one RPC. server=None uses the local
+    process (ps_local_client.h role — single-process tests and the
+    reference's local mode)."""
+
+    def __init__(self, server=None):
+        self.server = server
+
+    def _call(self, fn, *args, **kw):
+        if self.server is None:
+            return fn(*args, **kw)
+        from .. import rpc
+
+        return rpc.rpc_sync(self.server, fn, args=args, kwargs=kw)
+
+    def create_dense_table(self, name, shape, lr=0.01, init=None):
+        return self._call(_svc_create_table, name, "dense", shape=shape, lr=lr, init=init)
+
+    def create_sparse_table(self, name, dim, lr=0.01, optimizer="sgd"):
+        return self._call(_svc_create_table, name, "sparse", dim=dim, lr=lr, optimizer=optimizer)
+
+    def pull_dense(self, name):
+        return self._call(_svc_pull_dense, name)
+
+    def push_dense(self, name, grad):
+        return self._call(_svc_push_dense, name, np.asarray(grad))
+
+    def pull_sparse(self, name, ids):
+        return self._call(_svc_pull_sparse, name, np.asarray(ids))
+
+    def push_sparse(self, name, ids, grads):
+        return self._call(_svc_push_sparse, name, np.asarray(ids), np.asarray(grads))
+
+    def save_table(self, name):
+        return self._call(_svc_save, name)
+
+    def table_size(self, name):
+        return self._call(_svc_table_size, name)

@@ -1,0 +1,219 @@
+"""User-facing RPC: init_rpc / rpc_sync / rpc_async / shutdown.
+
+Reference parity: python/paddle/distributed/rpc/rpc.py:73,141,179 over the
+brpc RpcAgent (paddle/fluid/distributed/rpc/rpc_agent.h) in /root/reference.
+
+TPU-native design: RPC is control-plane (parameter-server pulls, metric
+aggregation, orchestration), never the tensor hot path — tensors move via
+XLA collectives over ICI. So the agent is a plain TCP request/response
+server (multiprocessing.connection: length-framed pickle) with a worker
+registry rendezvoused through the master endpoint, one listener thread per
+process and a thread pool executing incoming calls. Single-process
+world_size=1 loops back in-process (the reference's local mode).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from multiprocessing.connection import Client, Listener
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_state = None
+
+
+class _Agent:
+    def __init__(self, name, rank, world_size, master_addr, master_port):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.master = (master_addr, int(master_port))
+        self.port = int(master_port) + 1 + rank
+        local_only = master_addr in ("127.0.0.1", "localhost")
+        self.ip = master_addr if rank == 0 else _local_ip(master_addr)
+        self.workers = {}  # name -> WorkerInfo
+        self._pool = ThreadPoolExecutor(max_workers=8)
+        self._stop = threading.Event()
+        # Trust model: like the reference's brpc agent (and NCCL/gloo
+        # bootstraps), RPC assumes a private cluster network. We still bind
+        # loopback-only for local jobs, and the authkey — which
+        # multiprocessing uses for HMAC challenge-response, so it never
+        # crosses the wire — comes from PADDLE_RPC_AUTHKEY when set.
+        bind_ip = "127.0.0.1" if local_only else "0.0.0.0"
+        self._authkey = os.environ.get(
+            "PADDLE_RPC_AUTHKEY", f"paddle_tpu_rpc:{master_addr}:{master_port}"
+        ).encode()
+        self._listener = Listener((bind_ip, self.port), authkey=self._authkey)
+        self._serve_thread = threading.Thread(target=self._serve, daemon=True)
+        self._serve_thread.start()
+        self._rendezvous()
+
+    # ---- registry ----------------------------------------------------------
+    def _rendezvous(self):
+        me = WorkerInfo(self.name, self.rank, self.ip, self.port)
+        if self.world_size == 1:
+            self.workers = {self.name: me}
+            return
+        if self.rank == 0:
+            self.workers[self.name] = me
+            while len(self.workers) < self.world_size:
+                time.sleep(0.01)  # filled by _handle REGISTER calls
+            table = dict(self.workers)
+            for info in table.values():
+                if info.rank != 0:
+                    self._call_raw(info, ("TABLE", table))
+        else:
+            master_info = WorkerInfo("@master", 0, self.master[0], self.master[1] + 1)
+            while True:
+                try:
+                    self._call_raw(master_info, ("REGISTER", me))
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(0.05)
+            while len(self.workers) < self.world_size:
+                time.sleep(0.01)
+
+    # ---- server ------------------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            self._pool.submit(self._handle, conn)
+
+    def _handle(self, conn):
+        try:
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                kind = msg[0]
+                if kind == "REGISTER":
+                    info = msg[1]
+                    self.workers[info.name] = info
+                    conn.send(("OK", None))
+                elif kind == "TABLE":
+                    self.workers = msg[1]
+                    conn.send(("OK", None))
+                elif kind == "CALL":
+                    fn_bytes, args, kwargs = msg[1]
+                    try:
+                        fn = pickle.loads(fn_bytes)
+                        result = fn(*args, **(kwargs or {}))
+                        conn.send(("OK", result))
+                    except Exception as e:  # noqa: BLE001 — ship the error back
+                        conn.send(("ERR", e))
+                elif kind == "STOP":
+                    conn.send(("OK", None))
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---- client ------------------------------------------------------------
+    def _call_raw(self, info, msg):
+        with Client((info.ip, info.port), authkey=self._authkey) as conn:
+            conn.send(msg)
+            status, payload = conn.recv()
+        if status == "ERR":
+            raise payload
+        return payload
+
+    def call(self, to, fn, args, kwargs, timeout):
+        if to == self.name:  # loopback without a socket round-trip
+            return fn(*args, **(kwargs or {}))
+        deadline = time.monotonic() + (timeout if timeout and timeout > 0 else 120)
+        while to not in self.workers:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"rpc: unknown worker {to!r}")
+            time.sleep(0.01)
+        msg = ("CALL", (pickle.dumps(fn), args, kwargs))
+        if timeout and timeout > 0:
+            # bound the NETWORK call too, not just discovery: a hung peer
+            # raises TimeoutError instead of blocking forever
+            fut = self._pool.submit(self._call_raw, self.workers[to], msg)
+            return fut.result(timeout=max(0.0, deadline - time.monotonic()))
+        return self._call_raw(self.workers[to], msg)
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            # unblock accept() with a self-connection
+            self._call_raw(WorkerInfo(self.name, self.rank, "127.0.0.1", self.port), ("STOP", None))
+        except Exception:
+            pass
+        self._listener.close()
+        self._pool.shutdown(wait=False)
+
+
+def _local_ip(master_addr):
+    if master_addr in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((master_addr, 1))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Reference rpc.py init_rpc: start this process's agent + rendezvous."""
+    global _state
+    if _state is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (
+        int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) if world_size is None else world_size
+    )
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER_ENDPOINT", "127.0.0.1:29550")
+    addr, port = ep.rsplit(":", 1)
+    _state = _Agent(name, rank, world_size, addr, port)
+    return _state
+
+
+def rpc_sync(to, fn, args=(), kwargs=None, timeout=-1):
+    """Blocking call of fn(*args, **kwargs) on worker `to` (rpc.py:141)."""
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _state.call(to, fn, tuple(args), kwargs, timeout)
+
+
+def rpc_async(to, fn, args=(), kwargs=None, timeout=-1) -> Future:
+    """Future-returning variant (rpc.py:179)."""
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _state._pool.submit(_state.call, to, fn, tuple(args), kwargs, timeout)
+
+
+def get_worker_info(name=None) -> WorkerInfo:
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _state.workers[name or _state.name]
+
+
+def get_all_worker_infos():
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return sorted(_state.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return get_worker_info()
+
+
+def shutdown():
+    global _state
+    if _state is not None:
+        _state.shutdown()
+        _state = None

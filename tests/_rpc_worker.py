@@ -1,0 +1,74 @@
+"""Worker for the 2-process RPC + PS test (run via subprocess).
+
+Usage: python _rpc_worker.py <rank> <nranks> <port>
+rank 0 hosts the PS tables; rank 1 drives pulls/pushes over RPC.
+"""
+import os
+import sys
+
+RANK = int(sys.argv[1])
+NRANKS = int(sys.argv[2])
+PORT = sys.argv[3]
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.ps import PSClient
+
+rpc.init_rpc(
+    f"worker{RANK}", rank=RANK, world_size=NRANKS,
+    master_endpoint=f"127.0.0.1:{PORT}",
+)
+infos = rpc.get_all_worker_infos()
+assert [w.name for w in infos] == [f"worker{r}" for r in range(NRANKS)], infos
+
+# plain RPC: remote computation on the other worker
+peer = f"worker{(RANK + 1) % NRANKS}"
+out = rpc.rpc_sync(peer, pow, args=(2, 10))
+assert out == 1024, out
+fut = rpc.rpc_async(peer, sorted, args=([3, 1, 2],))
+assert fut.result(timeout=30) == [1, 2, 3]
+
+# remote errors propagate
+try:
+    rpc.rpc_sync(peer, int, args=("not-a-number",))
+    raise AssertionError("remote exception did not propagate")
+except ValueError:
+    pass
+
+# PS: rank 0 hosts, rank 1 is the trainer
+if RANK == 1:
+    client = PSClient(server="worker0")
+    client.create_sparse_table("emb", dim=4, lr=0.5)
+    ids = np.array([3, 7, 3])
+    rows0 = client.pull_sparse("emb", ids)
+    assert rows0.shape == (3, 4)
+    np.testing.assert_array_equal(rows0[0], rows0[2])  # same id, same row
+    # push a known gradient twice for id 3 (accumulated server-side)
+    client.push_sparse("emb", np.array([3]), np.ones((1, 4), np.float32))
+    rows1 = client.pull_sparse("emb", np.array([3]))
+    np.testing.assert_allclose(rows1[0], rows0[0] - 0.5, atol=1e-6)
+
+    client.create_dense_table("w", shape=(2, 2), lr=0.1,
+                              init=np.ones((2, 2), np.float32))
+    client.push_dense("w", np.full((2, 2), 2.0, np.float32))
+    np.testing.assert_allclose(client.pull_dense("w"), 0.8)
+    assert client.table_size("emb") == 2
+
+# both sides must stay alive until all RPC traffic is done
+import time
+
+marker = os.environ["RPC_TEST_DIR"] + f"/done_{RANK}"
+open(marker, "w").write("1")
+deadline = time.time() + 60
+while time.time() < deadline:
+    if all(
+        os.path.exists(os.environ["RPC_TEST_DIR"] + f"/done_{r}")
+        for r in range(NRANKS)
+    ):
+        break
+    time.sleep(0.05)
+rpc.shutdown()
+print(f"RPC_OK rank={RANK}", flush=True)

@@ -1,0 +1,161 @@
+"""auto_parallel front-end: ProcessMesh + shard_tensor + Engine (VERDICT
+round-2 item 6; reference auto_parallel/engine.py:57, interface.py:28,
+process_mesh.py:45). Runs on the forced 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel import Engine, ProcessMesh, shard_tensor
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+
+
+class TestProcessMesh:
+    def test_shape_and_jax_mesh(self):
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["dp", "mp"])
+        assert pm.shape == [2, 4]
+        assert pm.dim_names == ["dp", "mp"]
+        assert pm.process_ids == list(range(8))
+        assert dict(pm.jax_mesh.shape) == {"dp": 2, "mp": 4}
+
+    def test_1d(self):
+        pm = ProcessMesh(list(range(8)), dim_names=["x"])
+        assert pm.ndim == 1 and pm.shape == [8]
+
+    def test_bad_dim_names(self):
+        with pytest.raises(ValueError, match="dim_names"):
+            ProcessMesh([[0, 1], [2, 3]], dim_names=["x"])
+
+
+class TestShardTensor:
+    def test_annotates_and_places(self):
+        pm = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+        w = paddle.Parameter(np.ones((8, 4), np.float32))
+        shard_tensor(w, pm, [None, "mp"])
+        assert w.sharding_axes == (None, "mp")
+        shardings = {s for s in [w._array.sharding]}
+        assert len(shardings) == 1  # placed with a concrete sharding
+
+    def test_rejects_indivisible(self):
+        pm = ProcessMesh(list(range(8)), dim_names=["mp"])
+        w = paddle.Parameter(np.ones((6, 4), np.float32))
+        with pytest.raises(ValueError, match="divisible"):
+            shard_tensor(w, pm, ["mp", None])
+
+    def test_rejects_unknown_dim(self):
+        pm = ProcessMesh(list(range(8)), dim_names=["mp"])
+        w = paddle.Parameter(np.ones((8, 4), np.float32))
+        with pytest.raises(ValueError, match="unknown mesh dim"):
+            shard_tensor(w, pm, ["pp", None])
+
+
+class TestEngine:
+    def _data(self, n=32):
+        rs = np.random.RandomState(0)
+        return (rs.rand(n, 8).astype(np.float32), rs.rand(n, 8).astype(np.float32))
+
+    def _run_engine(self, annotate, steps=4, bs=8):
+        net = _mlp()
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["dp", "mp"])
+        if annotate:
+            # Megatron column/row split of the two Linears over mp
+            shard_tensor(net[0].weight, pm, [None, "mp"])
+            shard_tensor(net[0].bias, pm, ["mp"])
+            shard_tensor(net[2].weight, pm, ["mp", None])
+        else:
+            # mesh only; all params replicated
+            shard_tensor(net[0].weight, pm, [None, None])
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+        eng = Engine(net, nn.MSELoss(), opt)
+        xs, ys = self._data(steps * bs)
+
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return len(xs)
+
+            def __getitem__(self, i):
+                return xs[i], ys[i]
+
+        hist = eng.fit(DS(), epochs=1, batch_size=bs)
+        return hist["loss"], eng, net
+
+    def _run_reference(self, steps=4, bs=8):
+        """Hand-specced make_sharded_train_step trajectory (the VERDICT
+        equivalence bar)."""
+        from paddle_tpu.core import rng
+        from paddle_tpu.core.functional import tree_to_tensors
+        from paddle_tpu.parallel.spmd import make_sharded_train_step
+        from jax.sharding import Mesh
+
+        net = _mlp()
+        net[0].weight.sharding_axes = (None, "mp")
+        net[0].bias.sharding_axes = ("mp",)
+        net[2].weight.sharding_axes = ("mp", None)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+        mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "mp"))
+        loss_layer = nn.MSELoss()
+
+        def loss_fn(out_arrays, labels):
+            from paddle_tpu.core import autograd
+            from paddle_tpu.core.tensor import Tensor
+
+            outs = tree_to_tensors(out_arrays)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            with autograd.trace_mode():
+                lv = loss_layer(*outs, Tensor._from_op(labels))
+            return jnp.mean(lv._array)
+
+        step = make_sharded_train_step(net, loss_fn, opt, mesh, batch_specs=(P("dp"), P("dp")))
+        params, buffers, opt_state = step.init_state()
+        xs, ys = self._data(steps * bs)
+        losses = []
+        for i in range(steps):
+            xa, ya = step.shard_batch(xs[i * bs:(i + 1) * bs], ys[i * bs:(i + 1) * bs])
+            lr = jnp.asarray(1e-2, jnp.float32)
+            loss, params, buffers, opt_state = step(
+                params, buffers, opt_state, lr, rng.next_key(), xa, ya
+            )
+            losses.append(float(np.asarray(loss)))
+        return losses
+
+    def test_engine_dp_mp_matches_hand_specced_step(self):
+        ref = self._run_reference()
+        eng_losses, _, _ = self._run_engine(annotate=True)
+        assert len(eng_losses) == len(ref)
+        np.testing.assert_allclose(eng_losses, ref, rtol=1e-5, atol=1e-7)
+
+    def test_engine_trains_and_state_flows_back(self):
+        losses, eng, net = self._run_engine(annotate=False, steps=6)
+        assert losses[-1] < losses[0]  # learning
+        # eager model got the trained weights back
+        ev = eng.evaluate(None, steps=0)  # no data: just exercises the path
+        w = np.asarray(net[0].weight.numpy())
+        assert np.isfinite(w).all()
+        # optimizer accumulators synced (Model.save-style flows work)
+        sd = eng.optimizer.state_dict()
+        assert any("moment1" in k for k in sd)
+
+    def test_engine_save_load_roundtrip(self, tmp_path):
+        losses, eng, net = self._run_engine(annotate=True, steps=2)
+        path = str(tmp_path / "ap" / "ck")
+        import os
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        eng.save(path)
+        net2 = _mlp(seed=3)
+        opt2 = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net2.parameters())
+        eng2 = Engine(net2, nn.MSELoss(), opt2)
+        eng2.load(path)
+        for (k1, v1), (k2, v2) in zip(
+            net.state_dict().items(), net2.state_dict().items()
+        ):
+            np.testing.assert_allclose(np.asarray(v1.numpy()), np.asarray(v2.numpy()))

@@ -1,0 +1,193 @@
+"""Sharded distributed checkpoint with re-shard on load (VERDICT round-2
+item 3; reference incubate/distributed/utils/io/dist_save.py,
+auto_parallel/dist_saver.py). 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core import rng
+from paddle_tpu.distributed.checkpoint import (
+    load_sharded_model,
+    load_state,
+    save_sharded_model,
+    save_state,
+)
+from paddle_tpu.parallel.spmd import make_sharded_train_step
+
+
+def _mesh(**axes):
+    names = tuple(axes)
+    sizes = tuple(axes[n] for n in names)
+    devs = np.asarray(jax.devices()[: int(np.prod(sizes))]).reshape(sizes)
+    return Mesh(devs, names)
+
+
+def test_save_load_reshard_values():
+    """Arrays saved sharded over one mesh reassemble exactly, and re-shard
+    onto a different mesh shape on load."""
+    m1 = _mesh(dp=2, mp=4)
+    rs = np.random.RandomState(0)
+    a = rs.rand(8, 16).astype(np.float32)
+    b = rs.rand(12,).astype(np.float32)
+    state = {
+        "w": jax.device_put(a, NamedSharding(m1, P("dp", "mp"))),
+        "nested": {"v": jax.device_put(b, NamedSharding(m1, P(None)))},
+    }
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        save_state(state, d)
+        # plain host load
+        back = load_state(d)
+        np.testing.assert_array_equal(back["w"], a)
+        np.testing.assert_array_equal(back["nested"]["v"], b)
+        # re-shard onto a DIFFERENT mesh shape
+        m2 = _mesh(dp=8)
+        back2 = load_state(d, shardings={"w": NamedSharding(m2, P("dp")),
+                                         "nested/v": NamedSharding(m2, P())})
+        np.testing.assert_array_equal(np.asarray(back2["w"]), a)
+        assert back2["w"].sharding.spec == P("dp")
+
+
+def test_missing_shard_file_is_loud():
+    import os
+    import tempfile
+
+    m1 = _mesh(dp=2, mp=4)
+    a = np.arange(32, dtype=np.float32).reshape(8, 4)
+    state = {"w": jax.device_put(a, NamedSharding(m1, P("dp")))}
+    with tempfile.TemporaryDirectory() as d:
+        save_state(state, d)
+        # corrupt: rewrite npz without one shard key
+        import json
+
+        with open(os.path.join(d, "index.json")) as f:
+            idx = json.load(f)
+        victim = idx["arrays"]["w"]["shards"][0]
+        data = dict(np.load(os.path.join(d, victim["file"])))
+        del data[victim["key"]]
+        np.savez(os.path.join(d, victim["file"]), **data)
+        with pytest.raises(KeyError):
+            load_state(d)
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 8)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _loss_fn(out_arrays, labels):
+    from paddle_tpu.core import autograd
+    from paddle_tpu.core.functional import tree_to_tensors
+    from paddle_tpu.core.tensor import Tensor
+
+    outs = tree_to_tensors(out_arrays)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    with autograd.trace_mode():
+        lv = nn.MSELoss()(*outs, Tensor._from_op(labels))
+    return jnp.mean(lv._array)
+
+
+def _train(step, state, xs, ys, n, bs):
+    params, buffers, opt_state = state
+    losses = []
+    for i in range(n):
+        xa, ya = step.shard_batch(xs[i * bs:(i + 1) * bs], ys[i * bs:(i + 1) * bs])
+        loss, params, buffers, opt_state = step(
+            params, buffers, opt_state, jnp.asarray(1e-2, jnp.float32),
+            rng.next_key(), xa, ya,
+        )
+        losses.append(float(np.asarray(loss)))
+    return losses, (params, buffers, opt_state)
+
+
+def test_resume_on_different_mesh_matches_trajectory(tmp_path):
+    """Train ZeRO-sharded on mesh {dp:2, sharding:2, mp:2}; save; reload
+    re-sharded onto {dp:4, mp:2}; the continued trajectory equals the
+    uninterrupted one (same data, same steps)."""
+    rs = np.random.RandomState(7)
+    bs, steps = 8, 6
+    xs = rs.rand(bs * steps, 8).astype(np.float32)
+    ys = rs.rand(bs * steps, 8).astype(np.float32)
+
+    def build(mesh, zero, seed=5):
+        paddle.seed(seed)
+        rng.seed(123)
+        net = _MLP()
+        net.fc1.weight.sharding_axes = (None, "mp")
+        net.fc2.weight.sharding_axes = ("mp", None)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+        step = make_sharded_train_step(net, _loss_fn, opt, mesh,
+                                       batch_specs=(P("dp"), P("dp")),
+                                       zero_stage=zero)
+        return net, opt, step
+
+    # uninterrupted on mesh B for all steps (the target trajectory)
+    mesh_b = _mesh(dp=4, mp=2)
+    net_u, _, step_u = build(mesh_b, zero=0)
+    ref_losses, _ = _train(step_u, step_u.init_state(), xs, ys, steps, bs)
+
+    # phase 1: ZeRO-1 on mesh A {dp:2, sharding:2, mp:2} for half the steps
+    mesh_a = _mesh(dp=2, sharding=2, mp=2)
+    net_a, opt_a, step_a = build(mesh_a, zero=1)
+    rng.seed(123)
+    half = steps // 2
+    losses_a, state_a = _train(step_a, step_a.init_state(), xs, ys, half, bs)
+    np.testing.assert_allclose(losses_a, ref_losses[:half], rtol=1e-4, atol=1e-6)
+
+    params_a, buffers_a, opt_state_a = state_a
+    ckpt = str(tmp_path / "dist_ck")
+    save_state({"params": params_a, "buffers": buffers_a, "opt": opt_state_a}, ckpt)
+
+    # phase 2: fresh model on mesh B, re-sharded load, continue
+    net_b, opt_b, step_b = build(mesh_b, zero=0, seed=9)  # different init
+    state = load_state(ckpt)
+    params_b, buffers_b, opt_b_state = step_b.init_state()
+    # re-shard loaded values with mesh-B placements from init_state templates
+    params_b = {k: jax.device_put(np.asarray(state["params"][k]), v.sharding)
+                for k, v in params_b.items()}
+    buffers_b = {k: jax.device_put(np.asarray(state["buffers"][k]), v.sharding)
+                 for k, v in buffers_b.items()}
+    opt_b_state = {
+        k: {s: jax.device_put(np.asarray(state["opt"][k][s]), a.sharding)
+            for s, a in slots.items()}
+        for k, slots in opt_b_state.items()
+    }
+    losses_b, _ = _train(
+        step_b, (params_b, buffers_b, opt_b_state),
+        xs[half * bs:], ys[half * bs:], steps - half, bs,
+    )
+    np.testing.assert_allclose(losses_b, ref_losses[half:], rtol=1e-4, atol=1e-6)
+
+
+def test_save_load_sharded_model_wrappers(tmp_path):
+    paddle.seed(0)
+    net = _MLP()
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    # give the optimizer some state
+    out = net(paddle.to_tensor(np.ones((4, 8), np.float32)))
+    out.sum().backward()
+    opt.step()
+    ckpt = str(tmp_path / "model_ck")
+    save_sharded_model(net, opt, ckpt)
+
+    paddle.seed(3)
+    net2 = _MLP()
+    opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+    load_sharded_model(net2, opt2, ckpt)
+    for (k1, v1), (k2, v2) in zip(net.state_dict().items(), net2.state_dict().items()):
+        np.testing.assert_array_equal(np.asarray(v1.numpy()), np.asarray(v2.numpy()))
+    # optimizer slots restored
+    sd2 = opt2.state_dict()
+    assert any("moment1" in k for k in sd2)

@@ -264,3 +264,48 @@ class TestPipelineLayerDispatch:
         _, t2 = run(True)
         np.testing.assert_allclose(t1, t2, rtol=2e-4)
         assert t1[-1] < t1[0]  # actually training
+
+    def test_fleet_pp_global_norm_clip(self):
+        """Global-norm clipping under pp>1 must span ALL stages' grads
+        (VERDICT round-2 item 8): skew one stage's weights so its grads
+        dominate the global norm, then compiled-1F1B and the degree-1
+        sequential fallback must produce identical clipped trajectories."""
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 4, "sharding_degree": 2,
+        }
+        strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        rs = np.random.RandomState(1)
+        X = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+        Y = paddle.to_tensor(rs.randn(8, 4).astype(np.float32) * 5)
+
+        def run(force_fallback):
+            m = self._build(13)
+            # skew: inflate the LAST trunk stage's weights so its grads
+            # dwarf the others — a per-stage-only norm would clip wrongly
+            trunk = [l for l in m._funcs if isinstance(l, nn.Linear)]
+            big = trunk[-2]
+            big.weight.set_value(np.asarray(big.weight.numpy()) * 20.0)
+            opt = paddle.optimizer.SGD(
+                learning_rate=0.05, parameters=m.parameters(),
+                grad_clip=ClipGradByGlobalNorm(0.5),
+            )
+            wrapped = fleet.fleet.distributed_model(m)
+            opt = fleet.fleet.distributed_optimizer(opt)
+            if force_fallback:
+                wrapped._pipe = False
+            return [
+                float(np.asarray(wrapped.train_batch((X, Y), opt)._array))
+                for _ in range(4)
+            ]
+
+        t1 = run(False)
+        t2 = run(True)
+        np.testing.assert_allclose(t1, t2, rtol=2e-4)
+        assert np.isfinite(t1).all()
