@@ -1,0 +1,274 @@
+// BERT tokenizer: basic (lowercase/punct/CJK split) + WordPiece, C ABI.
+//
+// Reference parity: /root/reference/paddle/fluid/operators/string/
+// faster_tokenizer_op.cc (BertTokenizer over StringTensor inputs) and its
+// faster_tokenizer library backend. In the TPU-native framework tokenization
+// is host-side preprocessing (strings never enter XLA programs); this is the
+// native kernel behind paddle_tpu.text.FasterTokenizer, loaded via the
+// ctypes cpp_extension path like tcp_store.cc / data_feed.cc.
+//
+// Unicode handling: full UTF-8 codepoint iteration; CJK ranges split into
+// single-codepoint tokens; ASCII punctuation + general punctuation blocks
+// split; whitespace collapses. Lowercasing covers ASCII (the reference
+// delegates full case-folding to ICU — out of scope for parity tests).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+  std::unordered_map<std::string, int> vocab;
+  int unk_id = -1;
+  int cls_id = -1;
+  int sep_id = -1;
+  int pad_id = -1;
+  int max_word_chars = 100;
+};
+
+// ---- utf-8 ----------------------------------------------------------------
+
+// decode one codepoint at s[i]; advances i past it
+uint32_t NextCodepoint(const std::string& s, size_t* i) {
+  unsigned char c = s[*i];
+  uint32_t cp = 0;
+  int extra = 0;
+  if (c < 0x80) {
+    cp = c;
+  } else if ((c >> 5) == 0x6) {
+    cp = c & 0x1F;
+    extra = 1;
+  } else if ((c >> 4) == 0xE) {
+    cp = c & 0x0F;
+    extra = 2;
+  } else if ((c >> 3) == 0x1E) {
+    cp = c & 0x07;
+    extra = 3;
+  } else {  // invalid byte: treat as replacement
+    (*i)++;
+    return 0xFFFD;
+  }
+  (*i)++;
+  for (int k = 0; k < extra && *i < s.size(); ++k, (*i)++) {
+    cp = (cp << 6) | (s[*i] & 0x3F);
+  }
+  return cp;
+}
+
+void AppendCodepoint(uint32_t cp, std::string* out) {
+  if (cp < 0x80) {
+    out->push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
+bool IsWhitespace(uint32_t cp) {
+  return cp == ' ' || cp == '\t' || cp == '\n' || cp == '\r' || cp == 0xA0 ||
+         cp == 0x2028 || cp == 0x2029 || (cp >= 0x2000 && cp <= 0x200A);
+}
+
+bool IsCJK(uint32_t cp) {
+  return (cp >= 0x4E00 && cp <= 0x9FFF) || (cp >= 0x3400 && cp <= 0x4DBF) ||
+         (cp >= 0x20000 && cp <= 0x2A6DF) || (cp >= 0x2A700 && cp <= 0x2B73F) ||
+         (cp >= 0x2B740 && cp <= 0x2B81F) || (cp >= 0x2B820 && cp <= 0x2CEAF) ||
+         (cp >= 0xF900 && cp <= 0xFAFF) || (cp >= 0x2F800 && cp <= 0x2FA1F);
+}
+
+bool IsPunct(uint32_t cp) {
+  // BERT rule: ASCII non-alnum printable is punctuation, plus the general
+  // punctuation blocks
+  if ((cp >= 33 && cp <= 47) || (cp >= 58 && cp <= 64) ||
+      (cp >= 91 && cp <= 96) || (cp >= 123 && cp <= 126)) {
+    return true;
+  }
+  return (cp >= 0x2000 && cp <= 0x206F) || (cp >= 0x3000 && cp <= 0x303F);
+}
+
+bool IsControl(uint32_t cp) {
+  if (cp == '\t' || cp == '\n' || cp == '\r') return false;  // ws elsewhere
+  return cp < 0x20 || cp == 0x7F;
+}
+
+// ---- basic tokenizer -------------------------------------------------------
+
+std::vector<std::string> BasicTokenize(const std::string& text, bool lower) {
+  std::vector<std::string> out;
+  std::string cur;
+  auto flush = [&]() {
+    if (!cur.empty()) {
+      out.push_back(cur);
+      cur.clear();
+    }
+  };
+  size_t i = 0;
+  while (i < text.size()) {
+    uint32_t cp = NextCodepoint(text, &i);
+    if (cp == 0 || cp == 0xFFFD || IsControl(cp)) continue;
+    if (IsWhitespace(cp)) {
+      flush();
+      continue;
+    }
+    if (IsPunct(cp) || IsCJK(cp)) {
+      flush();
+      std::string one;
+      AppendCodepoint(cp, &one);
+      out.push_back(one);
+      continue;
+    }
+    if (lower && cp >= 'A' && cp <= 'Z') cp += 32;
+    AppendCodepoint(cp, &cur);
+  }
+  flush();
+  return out;
+}
+
+// ---- wordpiece -------------------------------------------------------------
+
+void WordPiece(const Tokenizer& tok, const std::string& word,
+               std::vector<int>* ids) {
+  // count codepoints for the max_word_chars rule
+  size_t n_cp = 0;
+  for (size_t i = 0; i < word.size();) {
+    NextCodepoint(word, &i);
+    n_cp++;
+  }
+  if (static_cast<int>(n_cp) > tok.max_word_chars) {
+    ids->push_back(tok.unk_id);
+    return;
+  }
+  std::vector<int> pieces;
+  size_t start = 0;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int cur_id = -1;
+    while (start < end) {
+      std::string sub = word.substr(start, end - start);
+      if (start > 0) sub = "##" + sub;
+      auto it = tok.vocab.find(sub);
+      if (it != tok.vocab.end()) {
+        cur_id = it->second;
+        break;
+      }
+      // shrink by one CODEPOINT from the right
+      size_t last = start;
+      for (size_t i = start; i < end;) {
+        last = i;
+        NextCodepoint(word, &i);
+        if (i >= end) break;
+      }
+      end = last;
+    }
+    if (cur_id < 0) {
+      ids->push_back(tok.unk_id);
+      return;  // whole word becomes [UNK] (BERT greedy failure rule)
+    }
+    pieces.push_back(cur_id);
+    start = end;
+  }
+  ids->insert(ids->end(), pieces.begin(), pieces.end());
+}
+
+void Encode(const Tokenizer& tok, const char* text, bool lower,
+            std::vector<int>* ids) {
+  for (const std::string& w : BasicTokenize(text ? text : "", lower)) {
+    WordPiece(tok, w, ids);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tok_create(const char* vocab_data, int vocab_len) {
+  auto* tok = new Tokenizer();
+  std::string data(vocab_data, vocab_len);
+  size_t pos = 0;
+  int id = 0;
+  while (pos <= data.size()) {
+    size_t nl = data.find('\n', pos);
+    if (nl == std::string::npos) nl = data.size();
+    std::string token = data.substr(pos, nl - pos);
+    if (!token.empty() && token.back() == '\r') token.pop_back();
+    if (!token.empty()) {
+      tok->vocab.emplace(token, id);
+      if (token == "[UNK]") tok->unk_id = id;
+      if (token == "[CLS]") tok->cls_id = id;
+      if (token == "[SEP]") tok->sep_id = id;
+      if (token == "[PAD]") tok->pad_id = id;
+      id++;
+    }
+    if (nl == data.size()) break;
+    pos = nl + 1;
+  }
+  if (tok->unk_id < 0) tok->unk_id = 0;
+  return tok;
+}
+
+void tok_free(void* handle) { delete static_cast<Tokenizer*>(handle); }
+
+int tok_vocab_size(void* handle) {
+  return static_cast<int>(static_cast<Tokenizer*>(handle)->vocab.size());
+}
+
+int tok_token_id(void* handle, const char* token) {
+  auto* tok = static_cast<Tokenizer*>(handle);
+  auto it = tok->vocab.find(token);
+  return it == tok->vocab.end() ? -1 : it->second;
+}
+
+// Encode text (and optional pair) BERT-style:
+//   [CLS] A [SEP]            /  [CLS] A [SEP] B [SEP]
+// Writes up to max_len ids/type-ids (truncating the tail like the
+// reference's longest_first at the segment level); returns the count.
+int tok_encode(void* handle, const char* text, const char* pair, int do_lower,
+               int max_len, int* out_ids, int* out_type_ids) {
+  auto* tok = static_cast<Tokenizer*>(handle);
+  std::vector<int> a, b;
+  Encode(*tok, text, do_lower != 0, &a);
+  if (pair && pair[0]) Encode(*tok, pair, do_lower != 0, &b);
+
+  std::vector<int> ids, types;
+  ids.push_back(tok->cls_id);
+  types.push_back(0);
+  for (int v : a) {
+    ids.push_back(v);
+    types.push_back(0);
+  }
+  ids.push_back(tok->sep_id);
+  types.push_back(0);
+  if (!b.empty()) {
+    for (int v : b) {
+      ids.push_back(v);
+      types.push_back(1);
+    }
+    ids.push_back(tok->sep_id);
+    types.push_back(1);
+  }
+  int n = static_cast<int>(ids.size());
+  if (max_len > 0 && n > max_len) {
+    n = max_len;
+    ids[n - 1] = tok->sep_id;  // keep a terminating [SEP] after truncation
+    // type id of the final SEP follows whatever segment was cut into
+  }
+  for (int i = 0; i < n; ++i) {
+    out_ids[i] = ids[i];
+    if (out_type_ids) out_type_ids[i] = types[i];
+  }
+  return n;
+}
+
+}  // extern "C"

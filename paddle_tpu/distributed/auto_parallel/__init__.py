@@ -24,9 +24,30 @@ from ...core.tensor import Tensor
 
 
 class ProcessMesh:
-    """An n-dimensional mesh of processes/devices with named dims
-    (reference process_mesh.py:45). Wraps a jax.sharding.Mesh built from
-    the local device list indexed by the given process ids."""
+    """An n-dimensional mesh of devices with named dims (reference
+    process_mesh.py:45). The ids index jax.devices() — the GLOBAL, ordering-
+    consistent device list, so every process in a multi-controller job
+    builds the same mesh. In the reference one process drives one device,
+    making these the same as its process ids; on TPU one process drives
+    several chips, so pass device ids (or use `from_processes`, which
+    expands each process id to all of that process's devices along the
+    LAST mesh dim)."""
+
+    @staticmethod
+    def from_processes(process_ids, dim_names=None):
+        """Expand process ids into their devices: result shape
+        [len(process_ids), devices_per_process]."""
+        devices = jax.devices()
+        rows = []
+        for p in process_ids:
+            row = [d for d in devices if d.process_index == int(p)]
+            if not row:
+                raise ValueError(f"process {p} owns no devices")
+            rows.append([devices.index(d) for d in row])
+        if len({len(r) for r in rows}) != 1:
+            raise ValueError("processes own unequal device counts")
+        names = dim_names or ["proc", "dev"]
+        return ProcessMesh(rows, dim_names=names)
 
     def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
         if mesh is not None:

@@ -60,16 +60,24 @@ def _shard_slices(shard_index, shape):
     return out
 
 
-def save_state(state, path):
+def save_state(state, path, save_id=None):
     """Save a (nested-dict) pytree of jax arrays as a sharded checkpoint.
 
     Every process calls this; each writes shard_<rank>.npz with its
-    addressable shards and rank 0 writes index.json (the shard map is
-    derivable identically on every process from the shardings)."""
+    addressable shards and rank 0 consolidates index.json. `save_id`
+    (e.g. the global step) MUST be passed — the same value on every rank —
+    when re-saving to the same path from multiple processes: rank 0 waits
+    for the other ranks' index files to carry the matching save_id, which
+    is what distinguishes this save's files from a previous save's."""
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state)
     rank = jax.process_index()
-    index = {"format": _FORMAT, "world": jax.process_count(), "arrays": {}}
+    index = {
+        "format": _FORMAT,
+        "world": jax.process_count(),
+        "save_id": save_id,
+        "arrays": {},
+    }
     payload = {}
     for key, arr in flat.items():
         arr = jnp.asarray(arr)
@@ -93,9 +101,12 @@ def save_state(state, path):
         index["arrays"][key] = entry
     np.savez(os.path.join(path, f"shard_{rank}.npz"), **payload)
     # multi-process: every rank's shard list differs; merge via per-rank
-    # index files + rank-0 consolidation
-    with open(os.path.join(path, f"index_{rank}.json"), "w") as f:
+    # index files + rank-0 consolidation. All json writes are atomic
+    # (tmp + replace) so a reader never sees a half-written file.
+    my_index = os.path.join(path, f"index_{rank}.json")
+    with open(my_index + ".tmp", "w") as f:
         json.dump(index, f)
+    os.replace(my_index + ".tmp", my_index)
     if rank == 0:
         import time
 
@@ -103,31 +114,40 @@ def save_state(state, path):
         for r in range(1, jax.process_count()):
             other = os.path.join(path, f"index_{r}.json")
             # no collective barrier here by design (save_state must work
-            # outside an initialized comm world): wait for the file, loudly
+            # outside an initialized comm world): wait for THIS save's
+            # file — matching save_id — not a stale one from a prior save
             deadline = time.monotonic() + 120.0
-            while not os.path.exists(other):
+            oidx = None
+            while True:
+                if os.path.exists(other):
+                    with open(other) as f:
+                        cand = json.load(f)
+                    if cand.get("save_id") == save_id:
+                        oidx = cand
+                        break
                 if time.monotonic() > deadline:
                     raise RuntimeError(
-                        f"save_state: rank {r} never wrote {other} — "
-                        "did all processes call save_state on the same path?"
+                        f"save_state: rank {r} never wrote {other} with "
+                        f"save_id={save_id!r} — did all processes call "
+                        "save_state on the same path with the same save_id?"
                     )
                 time.sleep(0.05)
-            with open(other) as f:
-                oidx = json.load(f)
             for k, e in oidx["arrays"].items():
                 have = {tuple(map(tuple, s["index"])) for s in merged["arrays"][k]["shards"]}
                 for s in e["shards"]:
                     if tuple(map(tuple, s["index"])) not in have:
                         merged["arrays"][k]["shards"].append(s)
-        with open(os.path.join(path, "index.json"), "w") as f:
+        final = os.path.join(path, "index.json")
+        with open(final + ".tmp", "w") as f:
             json.dump(merged, f, indent=1)
+        os.replace(final + ".tmp", final)
 
 
 def _assemble(path, key, entry):
     shape = tuple(entry["shape"])
     dtype = np.dtype(entry["dtype"])
     out = np.empty(shape, dtype)
-    filled = np.zeros(shape, bool) if entry["shards"] else None
+    filled = np.zeros(shape, bool)
     cache = {}
     for s in entry["shards"]:
         fn = os.path.join(path, s["file"])
@@ -137,7 +157,7 @@ def _assemble(path, key, entry):
         sl = tuple(slice(a, b) for a, b in s["index"])
         out[sl] = data
         filled[sl] = True
-    if filled is not None and not filled.all():
+    if not filled.all():  # includes the zero-shards case: empty != complete
         raise ValueError(
             f"checkpoint {path!r}: array {key!r} has missing regions — "
             "were all ranks' shard files copied?"

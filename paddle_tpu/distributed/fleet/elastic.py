@@ -1,0 +1,207 @@
+"""Elastic / fault-tolerant training manager.
+
+Reference parity: fleet/elastic/manager.py:126 (ElasticManager: node registry
+with TTL heartbeats, watch:611 detecting joins/exits, endpoint rewrite,
+LauncherInterface:54 kill+relaunch) and the epoch-level auto-checkpoint
+(fluid/incubate/checkpoint/auto_checkpoint.py:72) in /root/reference.
+
+TPU-native design: the registry is the framework's own TCPStore (csrc
+tcp_store.cc) instead of etcd — the launcher's master process hosts it.
+The TPU failure model differs from NCCL's per-rank elasticity: a slice
+failure takes the whole XLA program down, so recovery = detect (heartbeat
+staleness or child exit) -> rewrite endpoints for survivors/replacements ->
+relaunch from the newest checkpoint. Epoch skipping on resume comes from
+`train_epoch_range`, which records completed epochs next to the checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+ELASTIC_TIMEOUT = 30.0
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Node registry + heartbeat + world-change watch over a TCPStore."""
+
+    def __init__(self, job_id, rank, nnodes, store=None, host="127.0.0.1",
+                 port=None, heartbeat_interval=2.0, timeout=ELASTIC_TIMEOUT,
+                 endpoint=None):
+        from ..store import TCPStore
+
+        self.job_id = job_id
+        self.rank = int(rank)
+        self.nnodes = int(nnodes)
+        self.timeout = float(timeout)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.endpoint = endpoint or f"{host}:{port or 0}"
+        if store is not None:
+            self.store = store
+        else:
+            self.store = TCPStore(
+                host=host, port=port, is_master=(self.rank == 0),
+                world_size=self.nnodes,
+            )
+        self._stop = threading.Event()
+        self._hb_thread = None
+
+    # ---- registry ----------------------------------------------------------
+    def _node_key(self, rank):
+        return f"elastic/{self.job_id}/node/{rank}"
+
+    def register(self):
+        """Announce this node + start the TTL heartbeat (manager.py pre_hook
+        role)."""
+        self._beat()
+        self.store.set(
+            f"elastic/{self.job_id}/endpoint/{self.rank}", self.endpoint.encode()
+        )
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _beat(self):
+        self.store.set(self._node_key(self.rank), str(time.time()).encode())
+
+    def _hb_loop(self):
+        while not self._stop.is_set():
+            self._beat()
+            self._stop.wait(self.heartbeat_interval)
+
+    def node_heartbeats(self):
+        """rank -> seconds since last heartbeat (inf if never seen)."""
+        now = time.time()
+        out = {}
+        for r in range(self.nnodes):
+            key = self._node_key(r)
+            if self.store.check(key):
+                out[r] = now - float(self.store.get(key).decode())
+            else:
+                out[r] = float("inf")
+        return out
+
+    def dead_nodes(self):
+        return [r for r, age in self.node_heartbeats().items() if age > self.timeout]
+
+    def all_alive(self):
+        return not self.dead_nodes()
+
+    # ---- endpoints ---------------------------------------------------------
+    def endpoints(self):
+        out = {}
+        for r in range(self.nnodes):
+            key = f"elastic/{self.job_id}/endpoint/{r}"
+            if self.store.check(key):
+                out[r] = self.store.get(key).decode()
+        return out
+
+    def rewrite_endpoints(self, replacements: dict):
+        """Record replacement endpoints for failed ranks (manager.py's
+        DISTRIBUTED_TRAINER_ENDPOINTS rewrite); every survivor reads the new
+        table from the store before relaunching."""
+        for r, ep in replacements.items():
+            self.store.set(f"elastic/{self.job_id}/endpoint/{int(r)}", ep.encode())
+        self.store.set(
+            f"elastic/{self.job_id}/generation",
+            str(self.generation() + 1).encode(),
+        )
+
+    def generation(self):
+        key = f"elastic/{self.job_id}/generation"
+        return int(self.store.get(key).decode()) if self.store.check(key) else 0
+
+    def export_env(self, env=None):
+        """The env a relaunched trainer should see."""
+        env = dict(os.environ if env is None else env)
+        eps = self.endpoints()
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(
+            eps.get(r, "") for r in range(self.nnodes)
+        )
+        env["PADDLE_ELASTIC_GENERATION"] = str(self.generation())
+        env["PADDLE_TRAINER_ID"] = str(self.rank)
+        env["PADDLE_TRAINERS_NUM"] = str(self.nnodes)
+        return env
+
+    # ---- watch (manager.py watch:611) --------------------------------------
+    def watch_once(self, child_alive=True):
+        if not child_alive:
+            return ElasticStatus.RESTART
+        dead = self.dead_nodes()
+        if dead:
+            return ElasticStatus.RESTART if self.rank not in dead else ElasticStatus.ERROR
+        return ElasticStatus.HOLD
+
+    def exit(self):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+
+
+# ---- epoch-level auto checkpoint (auto_checkpoint.py:72) --------------------
+
+class AutoCheckpoint:
+    """Snapshot model+optimizer per epoch; on restart, resume from the last
+    completed epoch. State lives under `save_dir/<job_id>/`."""
+
+    def __init__(self, job_id, save_dir, model=None, optimizer=None):
+        self.job_id = job_id
+        self.dir = os.path.join(save_dir, str(job_id))
+        os.makedirs(self.dir, exist_ok=True)
+        self.model = model
+        self.optimizer = optimizer
+
+    def _status_path(self):
+        return os.path.join(self.dir, "status.json")
+
+    def _read(self):
+        if os.path.exists(self._status_path()):
+            with open(self._status_path()) as f:
+                return json.load(f)
+        return {"last_epoch": -1}
+
+    def last_epoch(self):
+        return int(self._read()["last_epoch"])
+
+    def save_epoch(self, epoch):
+        from ...framework.io import save as fsave
+
+        ck = os.path.join(self.dir, "ckpt")
+        if self.model is not None:
+            fsave(self.model.state_dict(), ck + ".pdparams")
+        if self.optimizer is not None:
+            fsave(self.optimizer.state_dict(), ck + ".pdopt")
+        tmp = self._status_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"last_epoch": int(epoch), "time": time.time()}, f)
+        os.replace(tmp, self._status_path())  # atomic: a crash mid-save keeps
+        # the previous consistent status
+
+    def restore(self):
+        """Load the snapshot if one exists; returns the next epoch to run."""
+        from ...framework.io import load as fload
+
+        ck = os.path.join(self.dir, "ckpt")
+        last = self.last_epoch()
+        if last >= 0:
+            if self.model is not None and os.path.exists(ck + ".pdparams"):
+                self.model.set_state_dict(fload(ck + ".pdparams"))
+            if self.optimizer is not None and os.path.exists(ck + ".pdopt"):
+                self.optimizer.set_state_dict(fload(ck + ".pdopt"))
+        return last + 1
+
+    def train_epoch_range(self, max_epoch):
+        """Reference train_epoch_range: iterate epochs, skipping completed
+        ones after a restart; each completed epoch is snapshotted."""
+        start = self.restore()
+        for epoch in range(start, max_epoch):
+            yield epoch
+            self.save_epoch(epoch)

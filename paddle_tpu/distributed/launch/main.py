@@ -31,6 +31,13 @@ def launch_main(argv=None):
     parser.add_argument("--log_dir", default="log")
     parser.add_argument("--max_restarts", type=int, default=0)
     parser.add_argument("--devices", default=None, help="unused on TPU (SPMD)")
+    parser.add_argument(
+        "--elastic_level", type=int, default=0,
+        help="0: restart-on-exit only; 1: also heartbeat-register in the "
+        "master TCPStore and restart when a peer node goes stale "
+        "(reference fleet/elastic/manager.py)",
+    )
+    parser.add_argument("--job_id", default=os.getenv("PADDLE_ELASTIC_JOB_ID", "default"))
     parser.add_argument("script", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
@@ -47,22 +54,94 @@ def launch_main(argv=None):
         env["PADDLE_MASTER"] = args.master
     os.makedirs(args.log_dir, exist_ok=True)
 
+    manager = None
+    if args.elastic_level >= 1:
+        from ..fleet.elastic import ElasticManager
+
+        host, port = (args.master or "127.0.0.1:29600").rsplit(":", 1)
+        manager = ElasticManager(
+            args.job_id, args.rank, args.nnodes,
+            host=host, port=int(port) + 7,  # registry beside the coordinator
+            endpoint=f"{host}:{port}",
+        )
+        manager.register()
+
+    _PEER_RESTART = -1001  # sentinel: peer-triggered, does not burn a restart
+
     restarts = 0
+    try:
+        while True:
+            if manager is not None:
+                env = manager.export_env(env)
+            log_path = os.path.join(args.log_dir, f"workerlog.{args.rank}")
+            with open(log_path, "ab") as logf:
+                proc = subprocess.Popen(
+                    [sys.executable] + script, env=env,
+                    stdout=logf, stderr=subprocess.STDOUT,
+                )
+                code = _watch(proc, manager, _PEER_RESTART)
+            if code == 0:
+                return 0
+            if code == _PEER_RESTART:
+                # a PEER died: hold until the world is whole again (the peer
+                # rejoins, or the scheduler rewrites its endpoint), THEN
+                # relaunch — this restart is not the local trainer's fault
+                # and does not count against --max_restarts
+                _hold_until_whole(manager)
+                continue
+            if restarts >= args.max_restarts:
+                print(f"worker exited with {code}; giving up after {restarts} restarts")
+                return code
+            restarts += 1
+            print(f"worker exited with {code}; restart {restarts}/{args.max_restarts}")
+            time.sleep(3)
+    finally:
+        if manager is not None:
+            manager.exit()
+
+
+def _hold_until_whole(manager, log_every=30.0):
+    gen0 = manager.generation()
+    last_log = 0.0
     while True:
-        log_path = os.path.join(args.log_dir, f"workerlog.{args.rank}")
-        with open(log_path, "ab") as logf:
-            proc = subprocess.Popen(
-                [sys.executable] + script, env=env, stdout=logf, stderr=subprocess.STDOUT
-            )
-            code = proc.wait()
-        if code == 0:
-            return 0
-        if restarts >= args.max_restarts:
-            print(f"worker exited with {code}; giving up after {restarts} restarts")
+        if manager.all_alive():
+            print("elastic: world whole again — relaunching")
+            return
+        if manager.generation() != gen0:
+            print("elastic: endpoints rewritten — relaunching")
+            return
+        now = time.monotonic()
+        if now - last_log > log_every:
+            print(f"elastic: holding for dead nodes {manager.dead_nodes()} "
+                  "(waiting for rejoin or endpoint rewrite)")
+            last_log = now
+        time.sleep(manager.heartbeat_interval)
+
+
+def _watch(proc, manager, peer_restart_code):
+    """Wait on the child; under elastic mode also watch peer heartbeats and
+    kill+restart when another node goes stale (manager.py watch:611)."""
+    if manager is None:
+        return proc.wait()
+    from ..fleet.elastic import ElasticStatus
+
+    while True:
+        code = None
+        try:
+            code = proc.wait(timeout=manager.heartbeat_interval)
+        except subprocess.TimeoutExpired:
+            pass
+        if code is not None:
             return code
-        restarts += 1
-        print(f"worker exited with {code}; restart {restarts}/{args.max_restarts}")
-        time.sleep(3)
+        if manager.watch_once(child_alive=True) == ElasticStatus.RESTART:
+            print("elastic: peer node heartbeat stale — stopping local trainer")
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            return peer_restart_code
 
 
 if __name__ == "__main__":

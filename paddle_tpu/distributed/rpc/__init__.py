@@ -36,7 +36,11 @@ class _Agent:
         local_only = master_addr in ("127.0.0.1", "localhost")
         self.ip = master_addr if rank == 0 else _local_ip(master_addr)
         self.workers = {}  # name -> WorkerInfo
-        self._pool = ThreadPoolExecutor(max_workers=8)
+        # separate pools: server threads run incoming handlers, client
+        # threads run outgoing async calls — sharing one pool would let 8
+        # blocked callers starve the very handlers that must answer them
+        self._pool = ThreadPoolExecutor(max_workers=8)  # server handlers
+        self._client_pool = ThreadPoolExecutor(max_workers=8)
         self._stop = threading.Event()
         # Trust model: like the reference's brpc agent (and NCCL/gloo
         # bootstraps), RPC assumes a private cluster network. We still bind
@@ -53,14 +57,20 @@ class _Agent:
         self._rendezvous()
 
     # ---- registry ----------------------------------------------------------
-    def _rendezvous(self):
+    def _rendezvous(self, timeout=120.0):
         me = WorkerInfo(self.name, self.rank, self.ip, self.port)
+        deadline = time.monotonic() + timeout
         if self.world_size == 1:
             self.workers = {self.name: me}
             return
         if self.rank == 0:
             self.workers[self.name] = me
             while len(self.workers) < self.world_size:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rpc rendezvous: only {sorted(self.workers)} of "
+                        f"{self.world_size} workers registered within {timeout}s"
+                    )
                 time.sleep(0.01)  # filled by _handle REGISTER calls
             table = dict(self.workers)
             for info in table.values():
@@ -73,8 +83,18 @@ class _Agent:
                     self._call_raw(master_info, ("REGISTER", me))
                     break
                 except (ConnectionError, OSError):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"rpc rendezvous: master {master_info.ip}:"
+                            f"{master_info.port} unreachable for {timeout}s"
+                        )
                     time.sleep(0.05)
             while len(self.workers) < self.world_size:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "rpc rendezvous: worker table never arrived "
+                        f"within {timeout}s"
+                    )
                 time.sleep(0.01)
 
     # ---- server ------------------------------------------------------------
@@ -137,10 +157,24 @@ class _Agent:
             time.sleep(0.01)
         msg = ("CALL", (pickle.dumps(fn), args, kwargs))
         if timeout and timeout > 0:
-            # bound the NETWORK call too, not just discovery: a hung peer
-            # raises TimeoutError instead of blocking forever
-            fut = self._pool.submit(self._call_raw, self.workers[to], msg)
-            return fut.result(timeout=max(0.0, deadline - time.monotonic()))
+            # bound the NETWORK call too, not just discovery, on a FRESH
+            # thread (not a shared pool, which nested waiters could starve)
+            box = {}
+
+            def run():
+                try:
+                    box["v"] = self._call_raw(self.workers[to], msg)
+                except BaseException as e:  # noqa: BLE001 — relayed below
+                    box["e"] = e
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+            if th.is_alive():
+                raise TimeoutError(f"rpc to {to!r} timed out after {timeout}s")
+            if "e" in box:
+                raise box["e"]
+            return box["v"]
         return self._call_raw(self.workers[to], msg)
 
     def shutdown(self):
@@ -152,6 +186,7 @@ class _Agent:
             pass
         self._listener.close()
         self._pool.shutdown(wait=False)
+        self._client_pool.shutdown(wait=False)
 
 
 def _local_ip(master_addr):
@@ -193,7 +228,7 @@ def rpc_async(to, fn, args=(), kwargs=None, timeout=-1) -> Future:
     """Future-returning variant (rpc.py:179)."""
     if _state is None:
         raise RuntimeError("call init_rpc first")
-    return _state._pool.submit(_state.call, to, fn, tuple(args), kwargs, timeout)
+    return _state._client_pool.submit(_state.call, to, fn, tuple(args), kwargs, timeout)
 
 
 def get_worker_info(name=None) -> WorkerInfo:

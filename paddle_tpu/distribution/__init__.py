@@ -319,19 +319,150 @@ class LogNormal(Distribution):
         )
 
 
+# ---- KL registry (reference distribution/kl.py register_kl) ----------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a closed-form KL(p || q) for a type pair; the
+    dispatcher picks the most specific registered match by MRO distance."""
+
+    def decorator(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return decorator
+
+
 def kl_divergence(p, q):
-    if isinstance(p, Normal) and isinstance(q, Normal):
-        var_ratio = (p.scale / q.scale) ** 2
-        t1 = ((p.loc - q.loc) / q.scale) ** 2
-        return Tensor._from_op(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
-    if isinstance(p, Categorical) and isinstance(q, Categorical):
-        logp = jax.nn.log_softmax(p.logits, -1)
-        logq = jax.nn.log_softmax(q.logits, -1)
-        return Tensor._from_op(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
-    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
-        pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
-        qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
-        return Tensor._from_op(
-            pp * (jnp.log(pp) - jnp.log(qq)) + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq))
+    best, best_score = None, None
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            score = type(p).__mro__.index(pc) + type(q).__mro__.index(qc)
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    if best is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__}): no "
+            "registered rule — add one with @register_kl"
         )
-    raise NotImplementedError(f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return best(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor._from_op(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor._from_op(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor._from_op(
+        pp * (jnp.log(pp) - jnp.log(qq)) + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq))
+    )
+
+
+@register_kl(Uniform, Uniform)
+def _kl_unif_unif(p, q):
+    # finite iff [p.low, p.high] within [q.low, q.high]
+    ratio = (q.high - q.low) / (p.high - p.low)
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    return Tensor._from_op(jnp.where(inside, jnp.log(ratio), jnp.inf))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_expo_expo(p, q):
+    r = p.rate / q.rate
+    return Tensor._from_op(jnp.log(r) + q.rate / p.rate - 1.0)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs = jnp.abs(p.loc - q.loc) / q.scale
+    return Tensor._from_op(
+        -jnp.log(scale_ratio) + scale_ratio * jnp.exp(-loc_abs / scale_ratio)
+        + loc_abs - 1.0
+    )
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    import jax.scipy.special as jss
+
+    a_p, b_p = p.concentration, p.rate
+    a_q, b_q = q.concentration, q.rate
+    return Tensor._from_op(
+        (a_p - a_q) * jss.digamma(a_p)
+        - jss.gammaln(a_p) + jss.gammaln(a_q)
+        + a_q * (jnp.log(b_p) - jnp.log(b_q))
+        + a_p * (b_q - b_p) / b_p
+    )
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    import jax.scipy.special as jss
+
+    a_p, b_p = p.alpha, p.beta
+    a_q, b_q = q.alpha, q.beta
+    s_p = a_p + b_p
+    return Tensor._from_op(
+        jss.gammaln(s_p) - jss.gammaln(a_p) - jss.gammaln(b_p)
+        - (jss.gammaln(a_q + b_q) - jss.gammaln(a_q) - jss.gammaln(b_q))
+        + (a_p - a_q) * jss.digamma(a_p)
+        + (b_p - b_q) * jss.digamma(b_p)
+        + (a_q + b_q - s_p) * jss.digamma(s_p)
+    )
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dir_dir(p, q):
+    import jax.scipy.special as jss
+
+    a_p, a_q = p.concentration, q.concentration
+    s_p = jnp.sum(a_p, -1, keepdims=True)
+    t = (a_p - a_q) * (jss.digamma(a_p) - jss.digamma(s_p))
+    return Tensor._from_op(
+        jss.gammaln(s_p[..., 0])
+        - jnp.sum(jss.gammaln(a_p), -1)
+        - jss.gammaln(jnp.sum(a_q, -1))
+        + jnp.sum(jss.gammaln(a_q), -1)
+        + jnp.sum(t, -1)
+    )
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    # same as the underlying normals
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor._from_op(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftplusTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)

@@ -1,0 +1,253 @@
+"""Probability transforms + TransformedDistribution.
+
+Reference parity: python/paddle/distribution/transform.py (Transform base
+with forward/inverse/log-det-jacobian contracts, AffineTransform,
+ExpTransform, SigmoidTransform, TanhTransform, PowerTransform,
+SoftplusTransform?, ChainTransform) and transformed_distribution.py in
+/root/reference.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import Distribution, _arr
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.BIJECTION
+
+    def forward(self, x):
+        return Tensor._from_op(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor._from_op(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor._from_op(self._forward_log_det_jacobian(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        ya = _arr(y)
+        return Tensor._from_op(-self._forward_log_det_jacobian(self._inverse(ya)))
+
+    def forward_shape(self, shape):
+        return list(shape)
+
+    def inverse_shape(self, shape):
+        return list(shape)
+
+    @property
+    def type(self):
+        return self._type
+
+    # array-level hooks subclasses implement
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log 2 - x - softplus(-2x)), numerically safe
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0)."""
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1.0)))
+
+
+class SoftplusTransform(Transform):
+    """y = softplus(x) = log(1 + exp(x))."""
+
+    def _forward(self, x):
+        return jax.nn.softplus(x)
+
+    def _inverse(self, y):
+        return y + jnp.log(-jnp.expm1(-y))
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x)
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # one branch of the preimage (reference semantics)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class ChainTransform(Transform):
+    """Composition: y = fN(...f1(x))."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Reinterprets the rightmost `reinterpreted_batch_rank` dims as event
+    dims: the log-det sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class TransformedDistribution(Distribution):
+    """Reference transformed_distribution.py: push a base distribution
+    through a chain of transforms; log_prob by change of variables."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transform = (
+            transforms if isinstance(transforms, Transform)
+            else ChainTransform(list(transforms))
+        )
+        super().__init__(tuple(base.batch_shape), tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        ya = _arr(value)
+        xa = self.transform._inverse(ya)
+        base_lp = _arr(self.base.log_prob(Tensor._from_op(xa)))
+        return Tensor._from_op(
+            base_lp - self.transform._forward_log_det_jacobian(xa)
+        )
+
+    def prob(self, value):
+        return Tensor._from_op(jnp.exp(_arr(self.log_prob(value))))

@@ -118,3 +118,4 @@ class ViterbiDecoder:
 
         paths, scores = jax.vmap(decode_one)(pots)
         return Tensor._from_op(scores), Tensor._from_op(paths)
+from .tokenizer import BertTokenizer, FasterTokenizer  # noqa: F401,E402

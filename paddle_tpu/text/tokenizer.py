@@ -1,0 +1,109 @@
+"""In-graph-style BERT tokenization backed by the native C++ kernel.
+
+Reference parity: paddle/fluid/operators/string/faster_tokenizer_op.cc (the
+FasterTokenizer op over StringTensor) in /root/reference. On TPU, strings
+never enter XLA programs — tokenization is host-side preprocessing feeding
+int ids to the compiled step — so the op surface is a Layer whose forward
+maps python strings to id Tensors, with the hot loop (UTF-8 walk, basic
+split, WordPiece longest-match) in csrc/tokenizer.cc via ctypes.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        from ..utils.cpp_extension import _csrc, load
+
+        lib = load("paddle_tpu_tokenizer", [os.path.join(_csrc(), "tokenizer.cc")])
+        lib.tok_create.restype = ctypes.c_void_p
+        lib.tok_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tok_free.argtypes = [ctypes.c_void_p]
+        lib.tok_vocab_size.restype = ctypes.c_int
+        lib.tok_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.tok_token_id.restype = ctypes.c_int
+        lib.tok_token_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tok_encode.restype = ctypes.c_int
+        lib.tok_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        _lib = lib
+    return _lib
+
+
+class BertTokenizer:
+    """vocab: path to a BERT vocab.txt (one token per line) or a list of
+    tokens. Must contain [UNK]/[CLS]/[SEP] (and [PAD] for padding)."""
+
+    def __init__(self, vocab, do_lower_case=True):
+        lib = _load()
+        if isinstance(vocab, (list, tuple)):
+            data = "\n".join(vocab).encode()
+        else:
+            with open(vocab, "rb") as f:
+                data = f.read()
+        self._h = ctypes.c_void_p(lib.tok_create(data, len(data)))
+        self.do_lower_case = do_lower_case
+        self.vocab_size = lib.tok_vocab_size(self._h)
+        self.pad_token_id = max(lib.tok_token_id(self._h, b"[PAD]"), 0)
+
+    def __del__(self):
+        try:
+            if self._h:
+                _load().tok_free(self._h)
+        except Exception:
+            pass
+
+    def token_id(self, token):
+        return _load().tok_token_id(self._h, token.encode())
+
+    def encode(self, text, text_pair=None, max_seq_len=512):
+        lib = _load()
+        ids = (ctypes.c_int * max_seq_len)()
+        types = (ctypes.c_int * max_seq_len)()
+        n = lib.tok_encode(
+            self._h, text.encode(), (text_pair or "").encode(),
+            1 if self.do_lower_case else 0, max_seq_len, ids, types,
+        )
+        return list(ids[:n]), list(types[:n])
+
+
+class FasterTokenizer(Layer):
+    """The op-surface parity layer: __call__(text[, text_pair]) returns
+    (input_ids, token_type_ids) Tensors, padded to the longest item in the
+    batch with [PAD] (reference faster_tokenizer_op output contract)."""
+
+    def __init__(self, vocab, do_lower_case=True, is_split_into_words=False):
+        super().__init__()
+        self.tokenizer = BertTokenizer(vocab, do_lower_case)
+
+    def forward(self, text, text_pair=None, max_seq_len=512, pad_to_max_seq_len=False):
+        texts = [text] if isinstance(text, str) else list(text)
+        pairs = (
+            [text_pair] if isinstance(text_pair, str)
+            else (list(text_pair) if text_pair is not None else [None] * len(texts))
+        )
+        if len(pairs) != len(texts):
+            raise ValueError("text and text_pair batch sizes differ")
+        encoded = [
+            self.tokenizer.encode(t, p, max_seq_len) for t, p in zip(texts, pairs)
+        ]
+        width = max_seq_len if pad_to_max_seq_len else max(len(e[0]) for e in encoded)
+        pad = self.tokenizer.pad_token_id
+        ids = np.full((len(encoded), width), pad, np.int64)
+        types = np.zeros((len(encoded), width), np.int64)
+        for i, (e_ids, e_types) in enumerate(encoded):
+            ids[i, : len(e_ids)] = e_ids
+            types[i, : len(e_types)] = e_types
+        return Tensor(ids), Tensor(types)

@@ -104,10 +104,146 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_rati
     return op(f, xt, name="roi_align")
 
 
-def deform_conv2d(*args, **kwargs):
-    raise NotImplementedError("deform_conv2d: planned (gather-based Pallas kernel)")
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference vision/ops.py deform_conv2d,
+    CUDA kernel deformable_conv_op.cu).
+
+    TPU-native lowering: one dense bilinear-gather + einsum — every kernel
+    tap samples x at (base + offset) via vectorized gather, the modulation
+    mask (DCNv2) scales the samples, and the contraction over
+    (C_in, kh, kw) runs on the MXU. No scatter, no per-position loops.
+
+    x [N, Cin, H, W]; offset [N, 2*G*kh*kw, Ho, Wo] as (dy, dx) pairs;
+    weight [Cout, Cin/groups, kh, kw]; mask [N, G*kh*kw, Ho, Wo] or None.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import autograd
+    from ..core.tensor import Tensor
+    from ..ops._helpers import T
+
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    xt, ot, wt = T(x), T(offset), T(weight)
+    n, cin, h, w_in = xt.shape
+    cout, cin_g, kh, kw = wt.shape
+    g_def = deformable_groups
+    k = kh * kw
+    ho = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (w_in + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def f(xa, off, wa, *rest):
+        mask_a = rest[0] if mask is not None else None
+        bias_a = rest[-1] if bias is not None else None
+        # base sampling grid per tap: [K, Ho, Wo]
+        iy = jnp.arange(kh) * dh
+        ix = jnp.arange(kw) * dw
+        base_y = (jnp.arange(ho) * sh - ph)[None, :, None] + \
+            jnp.repeat(iy, kw)[:, None, None]
+        base_x = (jnp.arange(wo) * sw - pw)[None, None, :] + \
+            jnp.tile(ix, kh)[:, None, None]
+        off = off.reshape(n, g_def, k, 2, ho, wo)
+        py = base_y[None, None] + off[:, :, :, 0]  # [N, G, K, Ho, Wo]
+        px = base_x[None, None] + off[:, :, :, 1]
+
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+
+        def gather(yi, xi):
+            # zero outside the input (the reference's im2col boundary rule)
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w_in)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w_in - 1).astype(jnp.int32)
+            flat = yc * w_in + xc  # [N, G, K, Ho, Wo]
+            xg = xa.reshape(n, g_def, cin // g_def, h * w_in)
+            vals = jnp.take_along_axis(
+                xg[:, :, None, :, :].reshape(n, g_def, 1, cin // g_def, h * w_in),
+                flat[:, :, :, None, :, :].reshape(n, g_def, k, 1, ho * wo),
+                axis=-1,
+            )  # broadcasting gather: [N, G, K, Cin/G, Ho*Wo]
+            vals = vals.reshape(n, g_def, k, cin // g_def, ho, wo)
+            return vals * valid[:, :, :, None, :, :]
+
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x0 + 1)
+        v10 = gather(y0 + 1, x0)
+        v11 = gather(y0 + 1, x0 + 1)
+        wy_ = wy[:, :, :, None]
+        wx_ = wx[:, :, :, None]
+        sampled = (
+            v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+            + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_
+        )  # [N, G, K, Cin/G, Ho, Wo]
+        if mask_a is not None:
+            m = mask_a.reshape(n, g_def, k, 1, ho, wo)
+            sampled = sampled * m
+        # [N, G, K, C/G, Ho, Wo] -> [N, C, K, Ho, Wo] (channel-major)
+        sampled = jnp.transpose(sampled, (0, 1, 3, 2, 4, 5)).reshape(
+            n, cin, k, ho, wo
+        )
+        # contraction on the MXU: weight [Cout, Cin/groups, kh*kw]
+        wk = wa.reshape(cout, cin_g, k)
+        if groups == 1:
+            out = jnp.einsum("nckhw,ock->nohw", sampled, wk)
+        else:
+            sg = sampled.reshape(n, groups, cin // groups, k, ho, wo)
+            wg = wk.reshape(groups, cout // groups, cin_g, k)
+            out = jnp.einsum("ngckhw,gock->ngohw", sg, wg).reshape(n, cout, ho, wo)
+        if bias_a is not None:
+            out = out + bias_a[None, :, None, None]
+        return out
+
+    args = (xt, ot, wt)
+    if mask is not None:
+        args = args + (T(mask),)
+    if bias is not None:
+        args = args + (T(bias),)
+    out, node = autograd.apply(f, *args, name="deform_conv2d")
+    return Tensor._from_op(out, node)
 
 
-class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("DeformConv2D: planned")
+from ..nn.layer import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
+    """Layer form (reference vision/ops.py DeformConv2D): a real nn.Layer so
+    its weight/bias show up in parameters()/state_dict of a parent model."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        fan_in = in_channels * kh * kw
+        bound = float(np.sqrt(1.0 / fan_in))
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw),
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound),
+        )
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0),
+            )
+        )
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, self.stride, self.padding,
+            self.dilation, self.deformable_groups, self.groups, mask,
+        )

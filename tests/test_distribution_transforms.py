@@ -1,0 +1,132 @@
+"""Distribution transforms + KL registry (reference distribution/transform.py
+and kl.py register_kl)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _grid():
+    return paddle.to_tensor(np.linspace(-2, 2, 9).astype(np.float32))
+
+
+class TestTransforms:
+    @pytest.mark.parametrize("t,domain", [
+        (D.AffineTransform(1.0, 2.5), None),
+        (D.ExpTransform(), None),
+        (D.SigmoidTransform(), None),
+        (D.TanhTransform(), None),
+        (D.SoftplusTransform(), None),
+        (D.PowerTransform(2.0), "pos"),
+        (D.ChainTransform([D.AffineTransform(0.5, 1.5), D.ExpTransform()]), None),
+    ])
+    def test_inverse_roundtrip_and_jacobian(self, t, domain):
+        x = _grid() if domain is None else paddle.to_tensor(
+            np.linspace(0.2, 2.0, 9).astype(np.float32)
+        )
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-4, atol=1e-5)
+        # numeric check of the log-det-jacobian: d forward / dx
+        eps = 1e-3
+        xp = paddle.to_tensor(x.numpy() + eps)
+        xm = paddle.to_tensor(x.numpy() - eps)
+        dydx = (t.forward(xp).numpy() - t.forward(xm).numpy()) / (2 * eps)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(),
+            np.log(np.abs(dydx)),
+            rtol=5e-3, atol=5e-3,
+        )
+        # inverse_log_det_jacobian = -forward at the preimage
+        np.testing.assert_allclose(
+            t.inverse_log_det_jacobian(y).numpy(),
+            -t.forward_log_det_jacobian(x).numpy(),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_reshape_and_independent(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+        y = t.forward(x)
+        assert y.shape == [2, 2, 2]
+        np.testing.assert_array_equal(t.inverse(y).numpy(), x.numpy())
+
+        it = D.IndependentTransform(D.ExpTransform(), 1)
+        x2 = paddle.to_tensor(np.ones((3, 4), np.float32))
+        ld = it.forward_log_det_jacobian(x2)
+        assert ld.shape == [3]  # summed over the event dim
+        np.testing.assert_allclose(ld.numpy(), 4.0)
+
+
+class TestTransformedDistribution:
+    def test_lognormal_via_exp_transform(self):
+        """TransformedDistribution(Normal, Exp) must equal LogNormal."""
+        paddle.seed(0)
+        base = D.Normal(0.3, 0.8)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ref = D.LogNormal(0.3, 0.8)
+        v = paddle.to_tensor(np.array([0.5, 1.0, 2.5], np.float32))
+        np.testing.assert_allclose(
+            td.log_prob(v).numpy(), ref.log_prob(v).numpy(), rtol=1e-5
+        )
+        s = td.sample([1000])
+        assert (s.numpy() > 0).all()
+
+    def test_affine_of_normal_is_normal(self):
+        base = D.Normal(0.0, 1.0)
+        td = D.TransformedDistribution(base, [D.AffineTransform(2.0, 3.0)])
+        ref = D.Normal(2.0, 3.0)
+        v = paddle.to_tensor(np.array([-1.0, 2.0, 5.0], np.float32))
+        np.testing.assert_allclose(
+            td.log_prob(v).numpy(), ref.log_prob(v).numpy(), rtol=1e-5
+        )
+
+
+def _mc_kl(p, q, n=200_000):
+    paddle.seed(42)
+    x = p.sample([n])
+    return float(np.mean(p.log_prob(x).numpy() - q.log_prob(x).numpy()))
+
+
+class TestKLRegistry:
+    @pytest.mark.parametrize("p,q", [
+        (lambda: D.Normal(0.0, 1.0), lambda: D.Normal(1.0, 2.0)),
+        (lambda: D.Exponential(2.0), lambda: D.Exponential(0.7)),
+        (lambda: D.Laplace(0.0, 1.0), lambda: D.Laplace(0.5, 2.0)),
+        (lambda: D.Gamma(2.0, 3.0), lambda: D.Gamma(3.0, 2.0)),
+        (lambda: D.Beta(2.0, 3.0), lambda: D.Beta(4.0, 2.0)),
+        (lambda: D.Dirichlet(np.array([1.0, 2.0, 3.0], np.float32)),
+         lambda: D.Dirichlet(np.array([2.0, 2.0, 2.0], np.float32))),
+        (lambda: D.LogNormal(0.0, 0.5), lambda: D.LogNormal(0.3, 0.8)),
+    ])
+    def test_closed_form_matches_monte_carlo(self, p, q):
+        pd, qd = p(), q()
+        kl = float(np.asarray(D.kl_divergence(pd, qd).numpy()))
+        mc = _mc_kl(pd, qd)
+        assert kl >= -1e-4
+        assert abs(kl - mc) < max(0.05, 0.1 * abs(mc)), (kl, mc)
+
+    def test_uniform_uniform(self):
+        kl = D.kl_divergence(D.Uniform(0.0, 1.0), D.Uniform(0.0, 2.0))
+        np.testing.assert_allclose(float(kl.numpy()), np.log(2.0), rtol=1e-6)
+        kl_inf = D.kl_divergence(D.Uniform(0.0, 3.0), D.Uniform(0.0, 2.0))
+        assert np.isinf(float(kl_inf.numpy()))
+
+    def test_register_kl_custom_pair(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl_my(p, q):
+            return paddle.to_tensor(np.float32(7.0))
+
+        # most specific rule wins over the Normal/Normal rule
+        assert float(D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0)).numpy()) == 7.0
+        # subclass falls back to the base rule against a plain Normal
+        v = D.kl_divergence(MyDist(0.0, 1.0), D.Normal(1.0, 1.0))
+        np.testing.assert_allclose(float(v.numpy()), 0.5, rtol=1e-5)
+
+    def test_unregistered_pair_raises(self):
+        with pytest.raises(NotImplementedError, match="register_kl"):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))

@@ -331,3 +331,69 @@ def test_onnx_export_writes_stablehlo(tmp_path):
 
     assert os.path.exists(out)
     assert "stablehlo" in open(out).read() or "func" in open(out).read()
+
+
+def test_deform_conv2d():
+    """DCN v1/v2 (reference vision/ops.py deform_conv2d): zero offsets ==
+    plain conv, integer offsets == shifted sampling, mask modulates,
+    gradients reach x/weight/offset, groups work."""
+    from paddle_tpu.ops.conv_pool import conv2d
+    from paddle_tpu.vision.ops import deform_conv2d
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.rand(2, 4, 6, 6).astype(np.float32))
+    w = paddle.to_tensor(rs.rand(3, 4, 3, 3).astype(np.float32) * 0.2)
+    off0 = paddle.to_tensor(np.zeros((2, 18, 4, 4), np.float32))
+    ref = conv2d(x, w)
+    assert np.allclose(deform_conv2d(x, off0, w).numpy(), ref.numpy(), atol=1e-5)
+
+    off1 = paddle.to_tensor(np.ones((2, 18, 4, 4), np.float32))
+    xs = np.zeros_like(x.numpy())
+    xs[:, :, :-1, :-1] = x.numpy()[:, :, 1:, 1:]
+    ref1 = conv2d(paddle.to_tensor(xs), w)
+    assert np.allclose(deform_conv2d(x, off1, w).numpy(), ref1.numpy(), atol=1e-5)
+
+    m = paddle.to_tensor(np.full((2, 9, 4, 4), 0.5, np.float32))
+    assert np.allclose(
+        deform_conv2d(x, off0, w, mask=m).numpy(), ref.numpy() * 0.5, atol=1e-5
+    )
+
+    x.stop_gradient = False
+    w.stop_gradient = False
+    off_t = paddle.to_tensor(np.full((2, 18, 4, 4), 0.3, np.float32))
+    off_t.stop_gradient = False
+    deform_conv2d(x, off_t, w).sum().backward()
+    assert x.grad is not None and w.grad is not None
+    assert np.abs(off_t.grad.numpy()).max() > 0  # offsets are trainable
+
+    xg = paddle.to_tensor(rs.rand(1, 4, 5, 5).astype(np.float32))
+    wg = paddle.to_tensor(rs.rand(4, 2, 3, 3).astype(np.float32))
+    og = paddle.to_tensor(np.zeros((1, 18, 3, 3), np.float32))
+    assert np.allclose(
+        deform_conv2d(xg, og, wg, groups=2).numpy(),
+        conv2d(xg, wg, groups=2).numpy(), atol=1e-5,
+    )
+
+
+def test_deform_conv2d_layer_registration():
+    """DeformConv2D is a real Layer: params visible to parents, distinct
+    initialization per instance."""
+    from paddle_tpu.vision.ops import DeformConv2D
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.dc = DeformConv2D(2, 3, 3)
+
+        def forward(self, x, off):
+            return self.dc(x, off)
+
+    net = Net()
+    names = [k for k, _ in net.named_parameters()]
+    assert any("dc" in n and "weight" in n for n in names), names
+    assert any("dc" in k for k in net.state_dict())
+    d1, d2 = DeformConv2D(2, 3, 3), DeformConv2D(2, 3, 3)
+    assert not np.allclose(d1.weight.numpy(), d2.weight.numpy())
+    x = paddle.to_tensor(np.random.rand(1, 2, 5, 5).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 18, 3, 3), np.float32))
+    assert net(x, off).shape == [1, 3, 3, 3]

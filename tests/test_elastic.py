@@ -1,0 +1,157 @@
+"""Elastic manager + auto-checkpoint (VERDICT round-2 item 7; reference
+fleet/elastic/manager.py:126, incubate/checkpoint/auto_checkpoint.py:72)."""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.elastic import (
+    AutoCheckpoint,
+    ElasticManager,
+    ElasticStatus,
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class TestElasticManager:
+    def _mk(self, nnodes=2, timeout=1.0):
+        port = _free_port()
+        m0 = ElasticManager("job1", 0, nnodes, host="127.0.0.1", port=port,
+                            timeout=timeout, endpoint="127.0.0.1:1000",
+                            heartbeat_interval=0.1)
+        m1 = ElasticManager("job1", 1, nnodes, store=None, host="127.0.0.1",
+                            port=port, timeout=timeout, endpoint="127.0.0.1:1001",
+                            heartbeat_interval=0.1)
+        return m0, m1
+
+    def test_register_heartbeat_watch(self):
+        m0, m1 = self._mk()
+        try:
+            m0.register()
+            m1.register()
+            time.sleep(0.1)
+            assert m0.all_alive()
+            assert m0.watch_once() == ElasticStatus.HOLD
+            assert m0.endpoints() == {0: "127.0.0.1:1000", 1: "127.0.0.1:1001"}
+        finally:
+            m0.exit()
+            m1.exit()
+
+    def test_stale_node_detected_and_restart_signal(self):
+        m0, m1 = self._mk(timeout=0.5)
+        try:
+            m0.register()
+            m1.register()
+            time.sleep(0.1)
+            m1.exit()  # node 1 stops heartbeating (simulated failure)
+            time.sleep(1.0)
+            assert m0.dead_nodes() == [1]
+            assert m0.watch_once() == ElasticStatus.RESTART
+        finally:
+            m0.exit()
+
+    def test_endpoint_rewrite_and_generation(self):
+        m0, m1 = self._mk()
+        try:
+            m0.register()
+            m1.register()
+            assert m0.generation() == 0
+            m0.rewrite_endpoints({1: "10.0.0.9:1001"})
+            assert m0.generation() == 1
+            # the survivor (and any replacement) reads the new table
+            env = m1.export_env({})
+            assert env["PADDLE_TRAINER_ENDPOINTS"] == "127.0.0.1:1000,10.0.0.9:1001"
+            assert env["PADDLE_ELASTIC_GENERATION"] == "1"
+        finally:
+            m0.exit()
+            m1.exit()
+
+
+class TestAutoCheckpoint:
+    def test_epoch_skip_and_state_restore(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+        ck = AutoCheckpoint("jobA", str(tmp_path), net, opt)
+
+        ran = []
+        for epoch in ck.train_epoch_range(3):
+            ran.append(epoch)
+            out = net(paddle.to_tensor(np.ones((2, 4), np.float32)))
+            out.sum().backward()
+            opt.step()
+            opt.clear_grad()
+            if epoch == 1:
+                break  # simulated crash AFTER epoch 0 snapshot, mid-epoch 1
+        assert ran == [0, 1]
+        w_after_e0 = None
+
+        # "restarted" process: fresh model/opt, same job id + dir
+        paddle.seed(9)
+        net2 = nn.Linear(4, 4)
+        opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+        ck2 = AutoCheckpoint("jobA", str(tmp_path), net2, opt2)
+        ran2 = list(ck2.train_epoch_range(3))
+        assert ran2 == [1, 2]  # epoch 0 skipped — resumed from the snapshot
+        # weights restored from the epoch-0 snapshot, not fresh init
+        sd2 = opt2.state_dict()
+        assert any("moment1" in k for k in sd2)
+
+    def test_fresh_job_starts_at_zero(self, tmp_path):
+        ck = AutoCheckpoint("jobB", str(tmp_path))
+        assert list(ck.train_epoch_range(2)) == [0, 1]
+
+
+def test_launcher_elastic_restart_loop(tmp_path):
+    """End-to-end: the launcher relaunches a crashing script and the second
+    incarnation resumes via AutoCheckpoint (epoch skip)."""
+    script = tmp_path / "train.py"
+    script.write_text(
+        f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.elastic import AutoCheckpoint
+
+net = nn.Linear(2, 2)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+ck = AutoCheckpoint("launcher_job", {str(tmp_path)!r}, net, opt)
+for epoch in ck.train_epoch_range(3):
+    out = net(paddle.to_tensor(np.ones((1, 2), np.float32)))
+    out.sum().backward(); opt.step(); opt.clear_grad()
+    print("EPOCH", epoch, flush=True)
+    if epoch == 1 and not os.path.exists({str(tmp_path / "crashed")!r}):
+        open({str(tmp_path / "crashed")!r}, "w").write("1")
+        sys.exit(17)  # crash during epoch 1 — epoch 0 is already snapshotted
+print("DONE", flush=True)
+"""
+    )
+    from paddle_tpu.distributed.launch.main import launch_main
+
+    log_dir = str(tmp_path / "logs")
+    rc = launch_main([
+        "--max_restarts", "2", "--log_dir", log_dir, str(script)
+    ])
+    assert rc == 0
+    log = open(os.path.join(log_dir, "workerlog.0")).read()
+    assert "DONE" in log
+    # first run: epochs 0,1 then crash; second run resumes at epoch 1 —
+    # epoch 0 is NOT re-run (the snapshot skip)
+    assert log.count("EPOCH 0") == 1, log
+    assert log.count("EPOCH 1") == 2, log
+    assert log.count("EPOCH 2") == 1, log

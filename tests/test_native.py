@@ -112,3 +112,52 @@ def test_array_data_feed():
     # pairs stay aligned through the shuffle
     for bx, by in batches:
         assert np.allclose(bx, x[by])
+
+
+# ---- native tokenizer (reference faster_tokenizer_op.cc) --------------------
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown", "fox",
+         "jump", "##ed", "##s", "over", "lazy", "dog", ",", "!", "中", "国"]
+
+
+def test_tokenizer_wordpiece_and_specials():
+    from paddle_tpu.text import BertTokenizer
+
+    tok = BertTokenizer(VOCAB)
+    assert tok.vocab_size == len(VOCAB)
+    ids, types = tok.encode("The quick brown fox jumped!")
+    # lowercased, wordpiece jumped -> jump + ##ed, punct split
+    assert ids == [2, 4, 5, 6, 7, 8, 9, 15, 3]
+    assert types == [0] * len(ids)
+
+
+def test_tokenizer_unknown_and_cjk():
+    from paddle_tpu.text import BertTokenizer
+
+    tok = BertTokenizer(VOCAB)
+    assert tok.encode("the zebra")[0] == [2, 4, 1, 3]  # [UNK]
+    assert tok.encode("中国")[0] == [2, 16, 17, 3]  # per-codepoint CJK split
+
+
+def test_tokenizer_pair_and_truncation():
+    from paddle_tpu.text import BertTokenizer
+
+    tok = BertTokenizer(VOCAB)
+    ids, ty = tok.encode("the fox", "lazy dog")
+    assert ids == [2, 4, 7, 3, 12, 13, 3]
+    assert ty == [0, 0, 0, 0, 1, 1, 1]
+    ids_t, _ = tok.encode("the quick brown fox", max_seq_len=4)
+    assert len(ids_t) == 4
+    assert ids_t[-1] == 3  # truncation keeps a terminating [SEP]
+
+
+def test_faster_tokenizer_layer_batch_padding():
+    from paddle_tpu.text import FasterTokenizer
+
+    ft = FasterTokenizer(VOCAB)
+    ids, types = ft(["the fox", "the quick brown fox"])
+    assert ids.shape == [2, 6]
+    assert ids.numpy()[0].tolist() == [2, 4, 7, 3, 0, 0]  # [PAD] padded
+    assert ids.numpy()[1].tolist() == [2, 4, 5, 6, 7, 3]
+    ids2, _ = ft("the dog", pad_to_max_seq_len=True, max_seq_len=8)
+    assert ids2.shape == [1, 8]
