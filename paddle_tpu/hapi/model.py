@@ -79,9 +79,27 @@ class Model:
             total = _mean(total)
         return total
 
+    def _dist_mesh(self):
+        """The active fleet/SPMD mesh, if Model.fit should train sharded
+        (the reference hapi's automatic fleet integration — BASELINE north
+        star: Model.fit + Fleet Sharding scaling). Pipeline degrees are the
+        fleet PipelineParallel wrapper's job, not hapi's."""
+        from ..distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+        if mesh is None:
+            return None
+        shape = dict(mesh.shape)
+        if shape.get("pp", 1) > 1:
+            return None
+        if all(shape.get(ax, 1) <= 1 for ax in ("dp", "mp", "sharding", "sp")):
+            return None
+        return mesh
+
     def _make_train_step(self, n_inputs, n_labels):
         net = self.network
         optimizer = self._optimizer
+        mesh = self._dist_mesh()
 
         def step(params, buffers, opt_state, lr, key, *arrays):
             in_arrays = arrays[:n_inputs]
@@ -105,7 +123,26 @@ class Model:
             )
             return loss, outs, new_buf, new_params, new_opt
 
-        return jax.jit(step, donate_argnums=(0, 2))
+        if mesh is None:
+            return jax.jit(step, donate_argnums=(0, 2))
+
+        # ---- sharded step: GSPMD over the fleet mesh ----------------------
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.spmd import build_state_shardings
+
+        zero = 1 if dict(mesh.shape).get("sharding", 1) > 1 else 0
+        _, pspecs, bspecs, ospecs = build_state_shardings(
+            net, self._optimizer, mesh, zero
+        )
+        ns = lambda s: NamedSharding(mesh, s)
+        batch_in = tuple(ns(P("dp")) for _ in range(n_inputs + n_labels))
+        in_sh = (pspecs, bspecs, ospecs, ns(P()), ns(P())) + batch_in
+        # outputs (for metrics) take compiler-chosen shardings (None)
+        out_sh = (ns(P()), None, bspecs, pspecs, ospecs)
+        return jax.jit(
+            step, in_shardings=in_sh, out_shardings=out_sh, donate_argnums=(0, 2)
+        )
 
     def _make_eval_step(self, n_inputs, n_labels, with_loss):
         net = self.network
@@ -156,7 +193,24 @@ class Model:
             self._opt_state = self._optimizer.state_arrays_for(
                 self.network.named_parameters_dict()
             )
-        key = self._shapes_key("train", ins + labs)
+        mesh = self._dist_mesh()
+        if mesh is not None:
+            dp = dict(mesh.shape).get("dp", 1)
+            if dp > 1 and ins and ins[0].shape[0] % dp:
+                raise ValueError(
+                    f"Model.train_batch: batch size {ins[0].shape[0]} is not "
+                    f"divisible by the mesh dp degree {dp} — use a divisible "
+                    "batch_size (fit drops the ragged final batch "
+                    "automatically when a mesh is active)"
+                )
+            # loader outputs are committed to one device; place them on the
+            # mesh (jit refuses to re-shard committed args)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(mesh, P("dp"))
+            ins = [jax.device_put(a, sh) for a in ins]
+            labs = [jax.device_put(a, sh) for a in labs]
+        key = (self._shapes_key("train", ins + labs), id(mesh))
         if key not in self._compiled_steps:
             self._compiled_steps[key] = self._make_train_step(len(ins), len(labs))
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
@@ -366,6 +420,11 @@ class Model:
     def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
         if data is None or isinstance(data, DataLoader):
             return data
+        if not drop_last and self._dist_mesh() is not None:
+            # a ragged final batch cannot shard over the dp axis; the
+            # reference pads via DistributedBatchSampler — dropping keeps
+            # step semantics exact (documented hapi fleet behavior here)
+            drop_last = True
         if isinstance(data, Dataset):
             try:
                 from ..distributed import get_world_size

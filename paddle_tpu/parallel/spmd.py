@@ -72,6 +72,27 @@ def _state_spec_like(pspec: P, param_shape, slot_arr, mesh, zero_stage):
     return P()
 
 
+def build_state_shardings(model, optimizer, mesh, zero_stage=0):
+    """Shared spec derivation for every sharded-step builder (ShardedTrainStep
+    and hapi Model's fleet path): returns (param_pspecs_raw, param_shardings,
+    buffer_shardings, opt_state_shardings)."""
+    pspecs_raw = module_param_specs(model, mesh, zero_stage)
+    ns = lambda s: NamedSharding(mesh, s)
+    pspecs = {k: ns(s) for k, s in pspecs_raw.items()}
+    _, buffers = state_dict_arrays(model)
+    bspecs = {k: ns(P()) for k in buffers}
+    named = model.named_parameters_dict()
+    opt_template = optimizer.init_state_arrays({k: p._array for k, p in named.items()})
+    ospecs = {
+        k: {
+            s: ns(_state_spec_like(pspecs_raw[k], named[k].shape, a, mesh, zero_stage))
+            for s, a in slots.items()
+        }
+        for k, slots in opt_template.items()
+    }
+    return pspecs_raw, pspecs, bspecs, ospecs
+
+
 class ShardedTrainStep:
     """One compiled XLA program: forward + loss + grad + optimizer update,
     with explicit in/out shardings over the mesh. Donates params/opt state."""
@@ -152,27 +173,9 @@ class ShardedTrainStep:
             return loss, new_params, new_buf, new_opt
 
         ns = lambda spec: NamedSharding(self.mesh, spec)
-        pspecs = {k: ns(s) for k, s in self.param_specs.items()}
-        _, buffers = state_dict_arrays(self.model)
-        bspecs = {k: ns(P()) for k in buffers}
-        opt_template = self.optimizer.init_state_arrays(
-            {k: p._array for k, p in self.model.named_parameters_dict().items()}
+        _, pspecs, bspecs, ospecs = build_state_shardings(
+            self.model, self.optimizer, self.mesh, self.zero_stage
         )
-        ospecs = {
-            k: {
-                s: ns(
-                    _state_spec_like(
-                        self.param_specs[k],
-                        self.model.named_parameters_dict()[k].shape,
-                        a,
-                        self.mesh,
-                        self.zero_stage,
-                    )
-                )
-                for s, a in slots.items()
-            }
-            for k, slots in opt_template.items()
-        }
         batch_in = tuple(ns(s) for s in self.batch_specs)
         in_shardings = (pspecs, bspecs, ospecs, ns(P()), ns(P())) + batch_in
         out_shardings = (ns(P()), pspecs, bspecs, ospecs)
