@@ -118,7 +118,7 @@ def main():
 
     sweep = {}
     errors = []
-    batches = (16, 32, 64) if on_tpu else (2,)
+    batches = (8, 16, 32) if on_tpu else (2,)
     iters = 20 if on_tpu else 3
     max_attempts = 3
     oom = False
@@ -134,6 +134,8 @@ def main():
                 if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
                     oom = True
                     break  # OOM is deterministic — larger batches will too
+                if "tpu_compile_helper" in msg:
+                    break  # compile-helper failures are deterministic too
                 # transient (remote-compile transport, tunnel resets): back
                 # off and retry; the compile cache makes retries cheap
                 time.sleep(5.0 * (attempt + 1))

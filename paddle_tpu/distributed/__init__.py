@@ -4,6 +4,7 @@ Reference parity: python/paddle/distributed/__init__.py in /root/reference.
 """
 from . import fleet  # noqa: F401
 from .collective import (  # noqa: F401
+    P2POp,
     ReduceOp,
     all_gather,
     all_gather_object,
@@ -11,6 +12,7 @@ from .collective import (  # noqa: F401
     alltoall,
     all_to_all,
     barrier,
+    batch_isend_irecv,
     broadcast,
     broadcast_object_list,
     get_group,

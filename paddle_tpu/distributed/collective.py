@@ -588,6 +588,55 @@ def irecv(tensor, src=0, group=None):
     return _CompletedTask(recv(tensor, src, group))
 
 
+class P2POp:
+    """One op in a batch_isend_irecv (reference
+    communication/batch_isend_irecv.py P2POp): op is isend or irecv."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be paddle.distributed.isend/irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = int(peer)
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Deadlock-free batched point-to-point (reference
+    batch_isend_irecv.py): the blocking pair-exchange transport requires a
+    cross-pair schedule — ops are executed grouped by communicating pair in
+    the GLOBAL pair order (min_rank, max_rank), which every process shares,
+    so the lowest pending pair always has both endpoints ready for it (the
+    classic hazard: A does [B then C] while B does [C then A]); within a
+    pair, sends run first so a recv-leading order on both sides cannot
+    spin (a send deposits into the peer's FIFO inbox regardless of the
+    peer's own op order)."""
+    if not p2p_op_list:
+        return []
+    me = jax.process_index()
+    for op in p2p_op_list:
+        if not isinstance(op, P2POp):
+            raise TypeError("batch_isend_irecv takes a list of P2POp")
+    indexed = list(enumerate(p2p_op_list))
+    # within a pair, sends run before recvs: a send deposits into the
+    # peer's FIFO inbox through the paired exchange regardless of the
+    # peer's own op order, while recv-before-send on BOTH sides would spin
+    indexed.sort(
+        key=lambda iop: (
+            min(me, iop[1].peer),
+            max(me, iop[1].peer),
+            0 if iop[1].op is isend else 1,
+        )
+    )
+    tasks = [None] * len(p2p_op_list)
+    for i, op in indexed:
+        if op.op is isend:
+            tasks[i] = isend(op.tensor, dst=op.peer, group=op.group)
+        else:
+            tasks[i] = irecv(op.tensor, src=op.peer, group=op.group)
+    return tasks
+
+
 def barrier(group=None):
     """All ranks synchronize: a 1-element all_reduce everyone must enter."""
     g = _group(group)

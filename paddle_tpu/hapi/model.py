@@ -303,7 +303,9 @@ class Model:
         accumulate_grad_batches=1,
         num_iters=None,
     ):
-        train_loader = self._to_loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        train_loader = self._to_loader(
+            train_data, batch_size, shuffle, drop_last, num_workers, train=True
+        )
         eval_loader = self._to_loader(eval_data, batch_size, False, False, num_workers) if eval_data is not None else None
 
         do_eval = eval_loader is not None
@@ -417,13 +419,15 @@ class Model:
         except TypeError:
             return None
 
-    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers,
+                   train=False):
         if data is None or isinstance(data, DataLoader):
             return data
-        if not drop_last and self._dist_mesh() is not None:
-            # a ragged final batch cannot shard over the dp axis; the
-            # reference pads via DistributedBatchSampler — dropping keeps
-            # step semantics exact (documented hapi fleet behavior here)
+        if train and not drop_last and self._dist_mesh() is not None:
+            # TRAIN only: a ragged final batch cannot shard over the dp
+            # axis; the reference pads via DistributedBatchSampler —
+            # dropping keeps step semantics exact. eval/predict steps are
+            # unsharded and must see every sample.
             drop_last = True
         if isinstance(data, Dataset):
             try:

@@ -2,6 +2,7 @@
 
 Reference parity: python/paddle/incubate/ in /root/reference (SURVEY.md §2.3).
 """
+from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -1,0 +1,113 @@
+"""ASP: automatic structured (n:m) sparsity.
+
+Reference parity: python/paddle/incubate/asp/ in /root/reference — 2:4 mask
+generation over Linear/Conv weights (`prune_model`), optimizer decoration
+that re-applies masks after every update (ASPHelper + OptimizerWithSparsity),
+and excluded-layer registry.
+
+TPU-native note: n:m sparse MXU execution is a hardware feature this
+framework does not target; ASP here produces and MAINTAINS the sparse
+pattern (the training-time role of the reference API) so exported weights
+are n:m-sparse for downstream deployment.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_MASKS = {}  # id(param) -> (param, mask jnp array)
+_EXCLUDED = set()  # parameter names excluded from pruning
+
+
+def reset_masks():
+    """Forget all generated masks (also releases the pruned models the
+    registry keeps alive)."""
+    _MASKS.clear()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    for n in param_names:
+        _EXCLUDED.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x):
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def create_mask(weight, n=2, m=4):
+    """n:m mask along the LAST axis: keep the n largest |w| of every m."""
+    w = np.asarray(weight)
+    last = w.shape[-1]
+    if last % m:
+        return np.ones_like(w, dtype=w.dtype)  # not maskable; dense
+    g = w.reshape(-1, m)
+    order = np.argsort(-np.abs(g), axis=1)
+    mask = np.zeros_like(g)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return mask.reshape(w.shape)
+
+
+def _prunable(layer):
+    from ..nn.common import Linear
+
+    return isinstance(layer, Linear)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Generate + apply n:m masks to every prunable weight (reference
+    asp.prune_model). Returns {param_name: mask}."""
+    masks = {}
+    for name, layer in model.named_sublayers():
+        if not _prunable(layer):
+            continue
+        p = layer.weight
+        if p.name in _EXCLUDED or name in _EXCLUDED:
+            continue
+        mask = create_mask(p.numpy(), n=n, m=m)
+        p.set_value(np.asarray(p.numpy()) * mask)
+        _MASKS[id(p)] = (p, jnp.asarray(mask))
+        masks[name] = mask
+    return masks
+
+
+class ASPOptimizer:
+    """decorate(optimizer): after every step, re-apply the masks so pruned
+    weights stay zero through training (reference OptimizerWithSparsity).
+    Scoped to the DECORATED optimizer's parameters — another model's masks
+    in the registry are never touched by this optimizer."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        param_ids = {id(p) for p in (optimizer._parameter_list or [])}
+        self._masks = [
+            (p, m) for pid, (p, m) in _MASKS.items() if pid in param_ids
+        ]
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _apply(self):
+        for p, mask in self._masks:
+            p._array = p._array * mask.astype(p._array.dtype)
+
+    def step(self):
+        self._inner.step()
+        self._apply()
+
+    def minimize(self, loss, *a, **k):
+        out = self._inner.minimize(loss, *a, **k)
+        self._apply()
+        return out
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+
+def decorate(optimizer):
+    return ASPOptimizer(optimizer)

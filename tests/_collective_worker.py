@@ -139,6 +139,30 @@ if NRANKS == 2:
     dist.recv(buf, src=peer)
     np.testing.assert_allclose(buf.numpy(), rank_val(peer, base=21.0))
 
+# batch_isend_irecv: mixed directions in one batch, DIFFERENT op orders on
+# each side (the global pair ordering + FIFO matching must line them up)
+if NRANKS == 2:
+    peer = 1 - RANK
+    out1 = paddle.to_tensor(rank_val(RANK, base=31.0))
+    out2 = paddle.to_tensor(rank_val(RANK, base=32.0))
+    in1 = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+    in2 = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+    if RANK == 0:
+        # recv-first on BOTH sides: the batch must reorder sends ahead
+        ops = [dist.P2POp(dist.irecv, in1, peer),
+               dist.P2POp(dist.isend, out1, peer),
+               dist.P2POp(dist.irecv, in2, peer),
+               dist.P2POp(dist.isend, out2, peer)]
+    else:
+        ops = [dist.P2POp(dist.irecv, in1, peer),
+               dist.P2POp(dist.irecv, in2, peer),
+               dist.P2POp(dist.isend, out1, peer),
+               dist.P2POp(dist.isend, out2, peer)]
+    for t in dist.batch_isend_irecv(ops):
+        t.wait()
+    np.testing.assert_allclose(in1.numpy(), rank_val(peer, base=31.0))
+    np.testing.assert_allclose(in2.numpy(), rank_val(peer, base=32.0))
+
 # subgroup: new_group([0]) — rank 1 is not a member, collective is a no-op
 g0 = dist.new_group([0])
 t = paddle.to_tensor(rank_val(RANK))

@@ -397,3 +397,31 @@ def test_deform_conv2d_layer_registration():
     x = paddle.to_tensor(np.random.rand(1, 2, 5, 5).astype(np.float32))
     off = paddle.to_tensor(np.zeros((1, 18, 3, 3), np.float32))
     assert net(x, off).shape == [1, 3, 3, 3]
+
+
+def test_incubate_asp_2_4_sparsity():
+    """ASP: prune to 2:4, train with the decorated optimizer, pattern holds
+    (reference incubate/asp prune_model + OptimizerWithSparsity)."""
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    masks = asp.prune_model(net, n=2, m=4)
+    assert masks  # pruned something
+    for lin in (net[0], net[2]):
+        w = lin.weight.numpy()
+        groups = np.asarray(w).reshape(-1, 4)
+        assert ((groups != 0).sum(axis=1) <= 2).all()  # 2:4 pattern
+    assert abs(asp.calculate_density(net[0].weight) - 0.5) < 0.1
+
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+    for _ in range(3):
+        loss = nn.MSELoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for lin in (net[0], net[2]):
+        groups = np.asarray(lin.weight.numpy()).reshape(-1, 4)
+        assert ((groups != 0).sum(axis=1) <= 2).all()  # masks re-applied

@@ -123,3 +123,23 @@ def test_model_fit_ragged_dataset(clean_mesh):
     with _pytest.raises(ValueError, match="divisible"):
         model.train_batch([rs.rand(6, 8).astype(np.float32)],
                           [rs.rand(6, 4).astype(np.float32)])
+
+
+def test_evaluate_sees_all_samples_under_mesh(clean_mesh):
+    """eval/predict are unsharded: a ragged tail must NOT be dropped."""
+    init_mesh({"dp": 4})
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    model = paddle.Model(net)
+    model.prepare(None)
+    rs = np.random.RandomState(0)
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 10  # ragged vs batch 4
+
+        def __getitem__(self, i):
+            return (rs.rand(8).astype(np.float32),)
+
+    outs = model.predict(DS(), batch_size=4, stack_outputs=True, verbose=0)
+    assert outs[0].shape[0] == 10  # every sample predicted
