@@ -67,13 +67,37 @@ class CausalSelfAttention(nn.Layer):
         )
         self.dropout = cfg.dropout
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         b, s, _ = x.shape
         qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded on last dim)
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q = M.squeeze(M.slice(qkv, [2], [0], [1]), 2)
         k = M.squeeze(M.slice(qkv, [2], [1], [2]), 2)
         v = M.squeeze(M.slice(qkv, [2], [2], [3]), 2)
+        if cache is not None:
+            # incremental decode: fixed-size KV cache so every step compiles
+            # once (reference fused_multi_transformer's cache_kv role).
+            # cache = (k_buf [b, L, h, d], v_buf, cur_len int32 scalar).
+            # Inference-only path: computed in plain jnp, no tape.
+            import jax
+            import jax.numpy as jnp
+
+            k_buf, v_buf, cur = cache
+            kb = jax.lax.dynamic_update_slice_in_dim(k_buf, k._array, cur, 1)
+            vb = jax.lax.dynamic_update_slice_in_dim(v_buf, v._array, cur, 1)
+            L = kb.shape[1]
+            scale = 1.0 / np.sqrt(self.head_dim)
+            s_l = jnp.einsum(
+                "bqhd,bkhd->bhqk", q._array, kb,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            kpos = jnp.arange(L)[None, None, None, :]
+            qpos = cur + jnp.arange(s)[None, None, :, None]
+            s_l = jnp.where(kpos <= qpos, s_l, -1e30)
+            p = jax.nn.softmax(s_l, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vb.dtype), vb)
+            out = M.reshape(Tensor._from_op(o), [b, s, self.num_heads * self.head_dim])
+            return self.proj(out), (kb, vb, cur + s)
         if self.cfg.attn_impl == "ring":
             from ..parallel.ring_attention import ring_attention
 
@@ -107,14 +131,21 @@ class GPTBlock(nn.Layer):
         self.dropout = nn.Dropout(cfg.dropout)
         self._cfg = cfg
 
-    def _inner(self, x):
+    def _inner(self, x, cache=None):
+        if cache is not None:
+            attn_out, new_cache = self.attn(self.ln1(x), cache=cache)
+            x = x + attn_out
+            x = x + self.fc2(self.act(self.fc1(self.ln2(x))))
+            return x, new_cache
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = _constraint(x, "dp", "sp", None)
         x = x + self.dropout(self.fc2(self.act(self.fc1(self.ln2(x)))))
         x = _constraint(x, "dp", "sp", None)
         return x
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        if cache is not None:
+            return self._inner(x, cache=cache)
         if self._cfg.remat:
             from ..distributed.fleet.utils import recompute
 
@@ -134,21 +165,128 @@ class GPT(nn.Layer):
         # LM head is weight-tied to wte (standard GPT; the reference ties via
         # SharedLayerDesc in pp_layers)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos_offset=0):
         b, s = input_ids.shape
-        pos = M.reshape(Tensor(np.arange(s, dtype=np.int64)), [1, s])
+        if caches is not None:
+            import jax.numpy as jnp
+
+            po = pos_offset._array if isinstance(pos_offset, Tensor) else pos_offset
+            pos = Tensor._from_op(po + jnp.arange(s, dtype=jnp.int64)[None])
+        else:
+            pos = M.reshape(Tensor(np.arange(s, dtype=np.int64)), [1, s])
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        x = _constraint(x, "dp", "sp", None)
-        for blk in self.blocks:
-            x = blk(x)
+        if caches is None:
+            x = _constraint(x, "dp", "sp", None)
+        new_caches = [] if caches is not None else None
+        for i, blk in enumerate(self.blocks):
+            if caches is not None:
+                x, c = blk(x, cache=caches[i])
+                new_caches.append(c)
+            else:
+                x = blk(x)
         x = self.ln_f(x)
         # logits = x @ wte.T  (vocab-parallel output)
         logits = M.reshape(
             F.linear(x, M.t(self.wte.weight)), [b, s, self.cfg.vocab_size]
         )
-        logits = _constraint(logits, "dp", "sp", "mp")
-        return logits
+        if caches is None:
+            logits = _constraint(logits, "dp", "sp", "mp")
+            return logits
+        return logits, new_caches
+
+    def init_caches(self, batch_size, max_len, dtype=None):
+        """Fixed-size per-layer KV caches for incremental decode. dtype
+        defaults to the model's parameter dtype (bf16 models get bf16
+        caches)."""
+        import jax.numpy as jnp
+
+        from ..core.dtypes import convert_dtype
+
+        dt = self.wte.weight._array.dtype if dtype is None else convert_dtype(dtype)
+        shape = (batch_size, max_len, self.cfg.num_heads,
+                 self.cfg.hidden_size // self.cfg.num_heads)
+        return [
+            (jnp.zeros(shape, dt), jnp.zeros(shape, dt), jnp.int32(0))
+            for _ in range(self.cfg.num_layers)
+        ]
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=None, seed=0, eos_token_id=None):
+        """Autoregressive decode with a compiled per-token step and a
+        fixed-size KV cache: prefill once, then one [b, 1] step per token
+        (the reference's fused_multi_transformer decode loop, TPU-native:
+        two cached executables total, static shapes throughout)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.functional import functional_call, state_dict_arrays
+
+        ids = input_ids if isinstance(input_ids, Tensor) else Tensor(np.asarray(input_ids))
+        b, prompt_len = ids.shape
+        if max_new_tokens <= 0:
+            return ids
+        max_len = prompt_len + max_new_tokens
+        if max_len > self.cfg.max_seq_len:
+            raise ValueError(
+                f"generate: prompt {prompt_len} + {max_new_tokens} new tokens "
+                f"exceeds max_seq_len {self.cfg.max_seq_len}"
+            )
+        params, buffers = state_dict_arrays(self)
+        caches = self.init_caches(b, max_len)
+        model = self
+
+        # compiled executables cached per decode signature (a fresh @jax.jit
+        # closure per call would recompile every generate); caches donated —
+        # the K/V buffers update in place instead of copying per token
+        if not hasattr(self, "_decode_fns"):
+            self._decode_fns = {}
+        sig = (b, prompt_len, max_len, float(temperature), top_k)
+        if sig not in self._decode_fns:
+
+            def sample(logits_last, key):
+                lg = logits_last.astype(jnp.float32) / max(temperature, 1e-6)
+                if top_k is not None:
+                    kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+                    lg = jnp.where(lg < kth, -jnp.inf, lg)
+                if temperature == 0.0:
+                    return jnp.argmax(lg, axis=-1).astype(jnp.int64)
+                return jax.random.categorical(key, lg, axis=-1).astype(jnp.int64)
+
+            def prefill(params, buffers, ids_arr, caches, key):
+                (logits, caches), _ = functional_call(
+                    model, params, buffers, args=(ids_arr,),
+                    kwargs={"caches": caches, "pos_offset": 0}, training=False,
+                )
+                return sample(logits[:, -1], key), caches
+
+            def step(params, buffers, tok, caches, pos, key):
+                (logits, caches), _ = functional_call(
+                    model, params, buffers, args=(tok[:, None],),
+                    kwargs={"caches": caches, "pos_offset": pos}, training=False,
+                )
+                return sample(logits[:, -1], key), caches
+
+            self._decode_fns[sig] = (
+                jax.jit(prefill, donate_argnums=(3,)),
+                jax.jit(step, donate_argnums=(3,)),
+            )
+        prefill, step = self._decode_fns[sig]
+
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
+        tok, caches = prefill(params, buffers, ids._array, caches, k0)
+        out = [tok]
+        for t in range(1, max_new_tokens):
+            key, kt = jax.random.split(key)
+            tok, caches = step(
+                params, buffers, tok, caches, jnp.int32(prompt_len + t - 1), kt
+            )
+            out.append(tok)
+            if eos_token_id is not None and bool((tok == eos_token_id).all()):
+                break
+        gen = jnp.stack(out, axis=1)
+        return Tensor._from_op(jnp.concatenate([ids._array.astype(gen.dtype), gen], axis=1))
 
 
 def gpt_loss_fn(logits_arrays, labels_array):
