@@ -79,7 +79,9 @@ class Fleet:
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 
     def distributed_scaler(self, scaler):
-        return scaler
+        from .meta_parallel.parallel_wrappers import HybridParallelGradScaler
+
+        return HybridParallelGradScaler(scaler, self._hcg)
 
     def state_dict(self):
         return {}

@@ -286,6 +286,26 @@ def _split_batch(x, n):
     return [Tensor(a) for a in np.array_split(arr, n)]
 
 
+class HybridParallelGradScaler:
+    """Reference hybrid_parallel_gradscaler.py:24: the found-inf flag must be
+    agreed across the hybrid groups before deciding to skip a step. Under the
+    single/multi-controller jax model, grads land as global arrays, so the
+    inner scaler's isfinite scan already sees every shard's values — the
+    wrapper is a delegation that keeps the reference API shape."""
+
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        # pure delegation — crucially the HybridParallelOptimizer object is
+        # passed through UNWRAPPED, so the inner scaler's per-optimizer
+        # INIT/UNSCALED/STEPPED state keys one consistent identity
+        # (unwrapping to _inner_opt would make unscale_-then-step divide
+        # gradients by the scale twice)
+        return getattr(self._scaler, name)
+
+
 class HybridParallelOptimizer:
     """Reference hybrid_parallel_optimizer.py:186: wraps the inner optimizer;
     grad clip stays global-norm-aware across mp/pp shards.

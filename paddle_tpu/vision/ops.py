@@ -104,6 +104,71 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_rati
     return op(f, xt, name="roi_align")
 
 
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLO head predictions into boxes + class scores (reference
+    vision/ops.py yolo_box, phi/kernels yolo_box_kernel — the PP-YOLOE
+    deployment path). Pure vectorized jnp: sigmoid offsets + anchor scaling
+    on the grid, confidence gating, optional box clipping.
+
+    x [N, an*(5+cls), H, W]; img_size [N, 2] as (h, w).
+    Returns (boxes [N, H*W*an, 4] xyxy in image pixels,
+             scores [N, H*W*an, cls])."""
+    from ..core import autograd
+
+    xt, st = T(x), T(img_size)
+    an = len(anchors) // 2
+    n, c, h, w = xt.shape
+    if c != an * (5 + class_num) + (an if iou_aware else 0):
+        raise ValueError(
+            f"yolo_box: channel {c} != anchors {an} * (5 + {class_num})"
+        )
+    anchors_np = np.asarray(anchors, np.float32).reshape(an, 2)
+
+    def f(pred, imgs):
+        if iou_aware:
+            ioup, pred = pred[:, :an], pred[:, an:]
+        p = pred.reshape(n, an, 5 + class_num, h, w)
+        tx, ty, tw, th = p[:, :, 0], p[:, :, 1], p[:, :, 2], p[:, :, 3]
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:])
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        bias_xy = 0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(tx) * scale_x_y - bias_xy + gx) / w
+        cy = (jax.nn.sigmoid(ty) * scale_x_y - bias_xy + gy) / h
+        aw = anchors_np[:, 0][None, :, None, None]
+        ah = anchors_np[:, 1][None, :, None, None]
+        bw = jnp.exp(tw) * aw / (downsample_ratio * w)
+        bh = jnp.exp(th) * ah / (downsample_ratio * h)
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                jax.nn.sigmoid(ioup.reshape(n, an, h, w)) ** iou_aware_factor
+        imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * imw
+        y1 = (cy - bh / 2) * imh
+        x2 = (cx + bw / 2) * imw
+        y2 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        keep = conf >= conf_thresh
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+        scores = cls * (conf * keep)[:, :, None]
+        # [N, an, H, W, ...] -> [N, an*H*W, ...] (anchor-major, grid row-major)
+        boxes = boxes.reshape(n, an * h * w, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, an * h * w, class_num)
+        return boxes, scores
+
+    out, node = autograd.apply(f, xt, st, name="yolo_box")
+    b, s = out
+    return Tensor._from_op(b, node, 0), Tensor._from_op(s, node, 1)
+
+
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   dilation=1, deformable_groups=1, groups=1, mask=None,
                   name=None):

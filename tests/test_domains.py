@@ -425,3 +425,40 @@ def test_incubate_asp_2_4_sparsity():
     for lin in (net[0], net[2]):
         groups = np.asarray(lin.weight.numpy()).reshape(-1, 4)
         assert ((groups != 0).sum(axis=1) <= 2).all()  # masks re-applied
+
+
+def test_yolo_box_decode():
+    """yolo_box decodes grid+anchor offsets into image-space boxes/scores
+    (reference vision/ops.py yolo_box, yolo_box_kernel)."""
+    from paddle_tpu.vision.ops import yolo_box
+
+    rs = np.random.RandomState(0)
+    N, an, cls, H, W = 2, 3, 4, 5, 5
+    anchors = [10, 13, 16, 30, 33, 23]
+    x = rs.randn(N, an * (5 + cls), H, W).astype(np.float32)
+    img = np.array([[320, 320], [416, 416]], np.int32)
+    b, s = yolo_box(paddle.to_tensor(x), paddle.to_tensor(img), anchors, cls,
+                    conf_thresh=0.01, downsample_ratio=32)
+    assert b.shape == [N, an * H * W, 4]
+    assert s.shape == [N, an * H * W, cls]
+
+    p = x.reshape(N, an, 5 + cls, H, W)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    a_i, gy_i, gx_i = 1, 2, 3
+    cx = (sig(p[0, a_i, 0, gy_i, gx_i]) + gx_i) / W
+    cy = (sig(p[0, a_i, 1, gy_i, gx_i]) + gy_i) / H
+    bw = np.exp(p[0, a_i, 2, gy_i, gx_i]) * anchors[2 * a_i] / (32 * W)
+    bh = np.exp(p[0, a_i, 3, gy_i, gx_i]) * anchors[2 * a_i + 1] / (32 * H)
+    conf = sig(p[0, a_i, 4, gy_i, gx_i])
+    ref = np.array([
+        np.clip((cx - bw / 2) * 320, 0, 319), np.clip((cy - bh / 2) * 320, 0, 319),
+        np.clip((cx + bw / 2) * 320, 0, 319), np.clip((cy + bh / 2) * 320, 0, 319),
+    ]) * (conf >= 0.01)
+    idx = a_i * H * W + gy_i * W + gx_i
+    np.testing.assert_allclose(b.numpy()[0, idx], ref, atol=1e-3)
+    np.testing.assert_allclose(
+        s.numpy()[0, idx], sig(p[0, a_i, 5:, gy_i, gx_i]) * conf * (conf >= 0.01),
+        atol=1e-5,
+    )
+    # boxes clipped into the image
+    assert (b.numpy()[0] <= 319.0 + 1e-3).all() and (b.numpy() >= 0).all()
