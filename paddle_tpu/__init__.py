@@ -28,6 +28,49 @@ from .core.tensor import Parameter, Tensor, is_tensor, to_tensor  # noqa: F401
 from .core.autograd import enable_grad, no_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
 from .core.rng import seed, get_rng_state, set_rng_state  # noqa: F401
 from .core import device as _device_mod
+from .core.device import (  # noqa: F401
+    is_compiled_with_cuda,
+    is_compiled_with_rocm,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+)
+
+
+class _Place:
+    """Reference Place parity (CPUPlace/CUDAPlace/...): on a compiler-managed
+    runtime placement is a device string; these classes keep API shape."""
+
+    _kind = "cpu"
+
+    def __init__(self, device_id=0):
+        self._id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._id})"
+
+    def __eq__(self, other):
+        return isinstance(other, _Place) and (self._kind, self._id) == (
+            other._kind, other._id
+        )
+
+    def __hash__(self):
+        return hash((self._kind, self._id))
+
+
+class CPUPlace(_Place):
+    _kind = "cpu"
+
+
+class CUDAPlace(_Place):
+    _kind = "gpu"
+
+
+class TPUPlace(_Place):
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(_Place):
+    _kind = "cpu_pinned"
 
 # bind Tensor methods before anything imports them
 from .ops import _bind as _bind_mod
@@ -107,3 +150,23 @@ def in_dynamic_mode():
 
 
 __version__ = "0.3.0"
+
+# paddle.linalg / paddle.tensor / paddle.version namespace parity
+import sys as _sys  # noqa: E402
+
+from .ops import linalg  # noqa: F401,E402
+from . import ops as tensor  # noqa: F401,E402  (paddle.tensor.* functions)
+
+# make `import paddle_tpu.tensor` importable too, not just attribute access
+_sys.modules[__name__ + ".tensor"] = tensor
+_sys.modules[__name__ + ".linalg"] = linalg
+
+
+class version:  # noqa: N801 — reference paddle.version module shape
+    full_version = __version__
+    major, minor, patch = (__version__.split(".") + ["0", "0"])[:3]
+    commit = "tpu-native"
+
+    @staticmethod
+    def show():
+        print(f"paddle-tpu {version.full_version} ({version.commit})")

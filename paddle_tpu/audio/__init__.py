@@ -5,3 +5,4 @@ MelSpectrogram, LogMelSpectrogram, MFCC + window functions).
 """
 from . import functional  # noqa: F401
 from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram  # noqa: F401
+from . import datasets  # noqa: F401,E402

@@ -462,3 +462,24 @@ def test_yolo_box_decode():
     )
     # boxes clipped into the image
     assert (b.numpy()[0] <= 319.0 + 1e-3).all() and (b.numpy() >= 0).all()
+
+
+def test_audio_datasets():
+    """TESS/ESC50 dataset interfaces (reference audio/datasets): raw and
+    feature-extracted items, label structure."""
+    from paddle_tpu.audio.datasets import ESC50, TESS
+
+    ds = TESS(mode="train")
+    wave, label = ds[0]
+    assert wave.ndim == 1 and wave.dtype == np.float32
+    assert 0 <= int(label) < 7
+    assert len(TESS(mode="train")) + len(TESS(mode="dev")) == TESS.N
+
+    mel = TESS(mode="train", feat_type="mfcc", n_mfcc=13)
+    feat, _ = mel[0]
+    assert feat.ndim == 2 and feat.shape[0] == 13
+
+    e = ESC50(mode="train")
+    _, lab = e[1]
+    assert 0 <= int(lab) < 50
+    assert len(e.label_list) == 50
