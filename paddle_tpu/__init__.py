@@ -49,6 +49,8 @@ class _Place:
         return f"Place({self._kind}:{self._id})"
 
     def __eq__(self, other):
+        if isinstance(other, str):  # Tensor.place returns the string form
+            return other == repr(self)
         return isinstance(other, _Place) and (self._kind, self._id) == (
             other._kind, other._id
         )

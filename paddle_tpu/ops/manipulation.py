@@ -431,14 +431,15 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     if len(pad) == 2 * nd:
         widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
-        # paddle convention: pad applies to last len(pad)//2 spatial dims,
-        # ordered from the last dim backward, honoring data_format
+        # paddle/torch convention: pair i applies to spatial dim counted
+        # from the LAST backward — [left, right, top, bottom] pads W with
+        # (left, right) and H with (top, bottom)
         widths = [(0, 0)] * nd
         npairs = len(pad) // 2
         if data_format.endswith("C") and nd >= 3:  # NHWC / NLC / NDHWC
-            dims = list(range(1, 1 + npairs))
+            dims = [nd - 2 - i for i in range(npairs)]  # W, H, D...
         else:  # NCHW / NCL / NCDHW
-            dims = list(range(nd - npairs, nd))
+            dims = [nd - 1 - i for i in range(npairs)]
         for i, d in enumerate(dims):
             widths[d] = (pad[2 * i], pad[2 * i + 1])
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]

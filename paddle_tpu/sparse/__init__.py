@@ -91,12 +91,55 @@ def _dense_of(x):
 
 
 def add(x, y, name=None):
+    """COO + COO stays SPARSE: concatenate coordinates (valid
+    COO-with-duplicates — to_dense scatter-adds); duplicates are merged
+    eagerly via coalesce, skipped under jit tracing where output nnz must
+    stay static. Mixed/dense operands fall back to dense arithmetic."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        if x.shape != y.shape:
+            raise ValueError(f"sparse add shape mismatch {x.shape} vs {y.shape}")
+        dt = jnp.promote_types(x.values._array.dtype, y.values._array.dtype)
+        idx = jnp.concatenate([x.indices._array, y.indices._array], axis=1)
+        vals = jnp.concatenate(
+            [x.values._array.astype(dt), y.values._array.astype(dt)]
+        )
+        out = SparseCooTensor(idx, vals, x.shape)
+        import jax.core
+
+        if not isinstance(vals, jax.core.Tracer):
+            out = out.coalesce()
+        return out
     from ..ops.math import add as _add
 
     return _add(_dense_of(x), _dense_of(y))
 
 
+def subtract(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return add(x, SparseCooTensor(y.indices, -y.values._array, y.shape))
+    from ..ops.math import subtract as _sub
+
+    return _sub(_dense_of(x), _dense_of(y))
+
+
 def multiply(x, y, name=None):
+    """sparse * scalar and sparse * dense keep x's sparse pattern (values
+    gathered at x's coordinates, no densification of x); sparse * sparse
+    keeps x's pattern too (y read through its dense form)."""
+    import numbers
+
+    if isinstance(x, SparseCooTensor) and isinstance(y, numbers.Number):
+        return SparseCooTensor(x.indices, x.values._array * y, x.shape)
+    if isinstance(x, SparseCooTensor) and isinstance(y, Tensor) and y.ndim == 0:
+        return SparseCooTensor(x.indices, x.values._array * y._array, x.shape)
+    if isinstance(x, SparseCooTensor) and isinstance(y, (Tensor, SparseCooTensor)):
+        yt = y.to_dense() if isinstance(y, SparseCooTensor) else y
+        if list(yt.shape) != list(x.shape):
+            raise ValueError(
+                f"sparse multiply shape mismatch {x.shape} vs {list(yt.shape)}"
+            )
+        g = yt._array[tuple(x.indices._array)]
+        return SparseCooTensor(x.indices, x.values._array * g, x.shape)
     from ..ops.math import multiply as _mul
 
     return _mul(_dense_of(x), _dense_of(y))

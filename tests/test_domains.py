@@ -483,3 +483,36 @@ def test_audio_datasets():
     _, lab = e[1]
     assert 0 <= int(lab) < 50
     assert len(e.label_list) == 50
+
+
+def test_sparse_add_multiply_stay_sparse():
+    """COO+COO and COO*dense keep sparse storage (reference sparse kernels;
+    previously these densified)."""
+    idx1 = np.array([[0, 2], [1, 3]])
+    idx2 = np.array([[0, 1], [1, 0]])
+    a = paddle.sparse.sparse_coo_tensor(idx1, np.array([2.0, 3.0], np.float32), [4, 5])
+    b = paddle.sparse.sparse_coo_tensor(idx2, np.array([10.0, 5.0], np.float32), [4, 5])
+    c = paddle.sparse.add(a, b)
+    assert isinstance(c, paddle.sparse.SparseCooTensor)
+    dense = c.to_dense().numpy()
+    ref = a.to_dense().numpy() + b.to_dense().numpy()
+    np.testing.assert_allclose(dense, ref)
+    assert c.nnz() == 3  # (0,1) merged
+
+    d = paddle.sparse.subtract(a, b)
+    assert isinstance(d, paddle.sparse.SparseCooTensor)
+    np.testing.assert_allclose(
+        d.to_dense().numpy(), a.to_dense().numpy() - b.to_dense().numpy()
+    )
+
+    m = paddle.sparse.multiply(a, 2.5)
+    assert isinstance(m, paddle.sparse.SparseCooTensor)
+    np.testing.assert_allclose(m.to_dense().numpy(), a.to_dense().numpy() * 2.5)
+
+    y = paddle.to_tensor(np.arange(20, dtype=np.float32).reshape(4, 5))
+    mz = paddle.sparse.multiply(a, y)
+    assert isinstance(mz, paddle.sparse.SparseCooTensor)
+    assert mz.nnz() == 2  # sparsity preserved, no densification
+    np.testing.assert_allclose(
+        mz.to_dense().numpy(), a.to_dense().numpy() * y.numpy()
+    )

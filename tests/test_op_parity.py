@@ -341,6 +341,45 @@ CASES = [
     OpCase("triplet_margin_loss", LO.triplet_margin_loss, lambda rs: (rs.rand(3, 4).astype(np.float32), rs.rand(3, 4).astype(np.float32), rs.rand(3, 4).astype(np.float32)), None, grad=False),
 ]
 
+# ---- fft / signal enrolment -------------------------------------------------
+from paddle_tpu import fft as FF  # noqa: E402
+from paddle_tpu import signal as SG  # noqa: E402
+
+CASES += [
+    OpCase("fft", FF.fft, n(2, 8), np.fft.fft, grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("ifft", FF.ifft, lambda rs: ((rs.rand(2, 8) + 1j * rs.rand(2, 8)).astype(np.complex64),), np.fft.ifft, grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("fft2", FF.fft2, n(4, 4), np.fft.fft2, grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("ifft2", FF.ifft2, lambda rs: ((rs.rand(4, 4) + 1j * rs.rand(4, 4)).astype(np.complex64),), np.fft.ifft2, grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("fftn", FF.fftn, n(2, 3, 4), np.fft.fftn, grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("rfft", FF.rfft, n(2, 8), np.fft.rfft, grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("irfft", FF.irfft, lambda rs: ((rs.rand(2, 5) + 1j * rs.rand(2, 5)).astype(np.complex64),), lambda a: np.fft.irfft(a), grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("hfft", FF.hfft, lambda rs: ((rs.rand(2, 5) + 1j * rs.rand(2, 5)).astype(np.complex64),), lambda a: np.fft.hfft(a), grad=False, rtol=1e-4, atol=1e-4),
+    OpCase("fftfreq", lambda: FF.fftfreq(8, 0.5), lambda rs: (), lambda: np.fft.fftfreq(8, 0.5).astype(np.float32), grad=False),
+    OpCase("rfftfreq", lambda: FF.rfftfreq(8, 0.5), lambda rs: (), lambda: np.fft.rfftfreq(8, 0.5).astype(np.float32), grad=False),
+    OpCase("fftshift", FF.fftshift, n(2, 8), np.fft.fftshift, grad=False),
+    OpCase("ifftshift", FF.ifftshift, n(2, 8), np.fft.ifftshift, grad=False),
+    OpCase(
+        "signal_frame",
+        lambda x: SG.frame(x, frame_length=4, hop_length=2),
+        n(16,),
+        None,  # shape/grad only (layout is axis-convention specific)
+        gtol=1e-2,
+    ),
+]
+
+# ---- more conv / pool variants ----------------------------------------------
+CASES += [
+    OpCase("conv1d", CP.conv1d, lambda rs: (rs.rand(1, 2, 8).astype(np.float32), rs.rand(3, 2, 3).astype(np.float32)), None, gtol=1e-2),
+    OpCase("conv3d", CP.conv3d, lambda rs: (rs.rand(1, 1, 3, 4, 4).astype(np.float32), rs.rand(2, 1, 2, 2, 2).astype(np.float32)), None, grad=False),
+    OpCase("max_pool1d", CP.max_pool1d, lambda rs: (rs.rand(1, 2, 8).astype(np.float32),), None, kwargs={"kernel_size": 2}, grad=False),
+    OpCase("avg_pool1d", CP.avg_pool1d, lambda rs: (rs.rand(1, 2, 8).astype(np.float32),), None, kwargs={"kernel_size": 2}, gtol=1e-2),
+    OpCase("adaptive_max_pool2d", CP.adaptive_max_pool2d, lambda rs: (rs.rand(1, 2, 4, 4).astype(np.float32),), None, kwargs={"output_size": 2}, grad=False),
+    OpCase("pixel_unshuffle", CP.pixel_unshuffle, lambda rs: (rs.rand(1, 1, 4, 4).astype(np.float32),), None, kwargs={"downscale_factor": 2}),
+    OpCase("conv1d_transpose", CP.conv1d_transpose, lambda rs: (rs.rand(1, 2, 5).astype(np.float32), rs.rand(2, 3, 2).astype(np.float32)), None, gtol=1e-2),
+    OpCase("zeropad2d", CN.zeropad2d, lambda rs: (rs.rand(1, 1, 3, 3).astype(np.float32),), lambda a: np.pad(a, ((0, 0), (0, 0), (1, 1), (2, 2))), kwargs={"padding": [2, 2, 1, 1]}),
+    OpCase("unfold", CP.unfold, lambda rs: (rs.rand(1, 2, 4, 4).astype(np.float32),), None, kwargs={"kernel_sizes": 2}, gtol=1e-2),
+]
+
 # apply whitelist relaxations / removals
 for c in CASES:
     if c.name in FWD_RTOL:
