@@ -30,7 +30,7 @@ from .loss import (  # noqa: F401
 from .norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
-    LocalResponseNorm, RMSNorm, SyncBatchNorm,
+    LocalResponseNorm, RMSNorm, SpectralNorm, SyncBatchNorm,
 )
 from .pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,

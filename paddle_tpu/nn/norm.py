@@ -186,6 +186,62 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Reference nn.SpectralNorm: forward(weight) returns weight / sigma
+    where sigma is the leading singular value estimated by power iteration;
+    the u/v estimates persist as buffers across calls."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+        import numpy as _np
+
+        self.dim = int(dim)
+        self.power_iters = int(power_iters)
+        self.epsilon = float(epsilon)
+        shape = [int(s) for s in weight_shape]
+        h = shape[self.dim]
+        w = 1
+        for i, s in enumerate(shape):
+            if i != self.dim:
+                w *= s
+        rs = _np.random.RandomState(0)
+        from ..core.tensor import Tensor as _T
+
+        self.register_buffer("weight_u", _T(rs.randn(h).astype(_np.float32)))
+        self.register_buffer("weight_v", _T(rs.randn(w).astype(_np.float32)))
+        self._shape = shape
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+
+        from ..core import autograd
+        from ..core.tensor import Tensor as _T
+
+        dim = self.dim
+        eps = self.epsilon
+        iters = self.power_iters
+        perm = [dim] + [i for i in range(len(self._shape)) if i != dim]
+        u0, v0 = self.weight_u._array, self.weight_v._array
+
+        def f(w_arr):
+            wm = jnp.transpose(w_arr, perm).reshape(w_arr.shape[dim], -1)
+            u, v = u0, v0
+            for _ in range(max(iters, 1)):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = u @ wm @ v
+            return w_arr / sigma, u, v
+
+        import jax
+
+        wt = weight if isinstance(weight, _T) else _T(weight)
+        # ONE power iteration per call: the multi-output apply returns the
+        # normalized weight plus the refreshed u/v estimates together
+        out, node = autograd.apply(f, wt, name="spectral_norm")
+        w_norm, u_new, v_new = out
+        self.weight_u._array = u_new
+        self.weight_v._array = v_new
+        return _T._from_op(w_norm, node, 0)

@@ -253,7 +253,46 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
 
 def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    raise NotImplementedError
+    """col2im: scatter-add unfolded patches back into an image (the inverse
+    of unfold; reference fold kernel). x [N, C*kh*kw, L] -> [N, C, H, W]."""
+    from ._helpers import int_or_list
+
+    oh, ow = int_or_list(output_sizes) if isinstance(output_sizes, (list, tuple)) else (output_sizes, output_sizes)
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else tuple(kernel_sizes)
+    sh, sw = (strides, strides) if isinstance(strides, int) else tuple(strides)
+    if isinstance(paddings, int):
+        pt = pb = pl = pr = paddings
+    else:
+        pp = list(paddings)
+        if len(pp) == 2:  # [padding_h, padding_w]
+            pt = pb = pp[0]
+            pl = pr = pp[1]
+        elif len(pp) == 4:  # reference order: [top, left, bottom, right]
+            pt, pl, pb, pr = pp
+        else:
+            raise ValueError(f"fold: paddings must be int, 2- or 4-list, got {paddings}")
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else tuple(dilations)
+    xt = T(x)
+    n, ckk, L = xt.shape
+    c = ckk // (kh * kw)
+    lh = (oh + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (ow + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
+    if lh * lw != L:
+        raise ValueError(f"fold: L={L} != computed {lh}*{lw} patch grid")
+
+    def f(a):
+        p = a.reshape(n, c, kh, kw, lh, lw)
+        out = jnp.zeros((n, c, oh + pt + pb, ow + pl + pr), a.dtype)
+        for i in range(kh):  # static tap loop: kh*kw scatter-adds
+            for j in range(kw):
+                ys = i * dh
+                xs = j * dw
+                out = out.at[
+                    :, :, ys:ys + sh * lh:sh, xs:xs + sw * lw:sw
+                ].add(p[:, :, i, j])
+        return out[:, :, pt:pt + oh, pl:pl + ow]
+
+    return op(f, xt, name="fold")
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):

@@ -289,13 +289,19 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     ks = _pair(kernel_sizes, 2)
     st = _pair(strides, 2)
-    pd = _pair(paddings, 2)
     dl = _pair(dilations, 2)
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
+        # reference order: [top, left, bottom, right] (may be asymmetric)
+        pt, pl, pb, pr = (int(p) for p in paddings)
+        pad_spec = [(pt, pb), (pl, pr)]
+    else:
+        pd = _pair(paddings, 2)
+        pad_spec = [(pd[0], pd[0]), (pd[1], pd[1])]
 
     def f(a):
         n, c, h, w = a.shape
         patches = jax.lax.conv_general_dilated_patches(
-            a, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+            a, ks, st, pad_spec, rhs_dilation=dl,
             dimension_numbers=jax.lax.conv_dimension_numbers(a.shape, (1, 1) + tuple(ks), ("NCHW", "OIHW", "NCHW")),
         )
         return patches.reshape(n, c * ks[0] * ks[1], -1)

@@ -292,4 +292,97 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss: planned (lax.scan forward algorithm)")
+    """CTC loss via the forward (alpha) recursion as ONE lax.scan over time
+    (reference phi warpctc kernel semantics; log-space, batched with masks
+    so every sample shares the compiled loop regardless of its lengths).
+
+    log_probs [T, N, C] UNSCALED logits (softmax integrated, the\n    warpctc contract); labels [N, L]; input_lengths /
+    label_lengths [N]. reduction 'mean' divides each loss by its label
+    length then averages (reference behavior)."""
+    lp_t, lab_t = T(log_probs), T(labels)
+    il_t, ll_t = T(input_lengths), T(label_lengths)
+
+    def f(logits_in, lab, in_len, lab_len):
+        # reference warpctc contract: UNSCALED logits in, softmax integrated
+        lp = jax.nn.log_softmax(logits_in, axis=-1)
+        Tm, N, C = lp.shape
+        Lmax = lab.shape[1]
+        S = 2 * Lmax + 1
+        NEG = -1e30
+        lab = lab.astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((N, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        prev2 = jnp.concatenate(
+            [jnp.full((N, 2), -1, jnp.int32), ext[:, :-2]], axis=1
+        )
+        skip_ok = (ext != blank) & (ext != prev2)  # [N, S]
+        s_idx = jnp.arange(S)[None, :]
+        valid_s = s_idx < (2 * lab_len[:, None] + 1)
+
+        def emit(lp_frame):  # [N, C] -> [N, S] log prob of each ext symbol
+            return jnp.take_along_axis(lp_frame, ext, axis=1)
+
+        alpha0 = jnp.full((N, S), NEG)
+        e0 = emit(lp[0])
+        alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, e0[:, 1], NEG)
+        )
+
+        def logsum3(a, b, c):
+            m = jnp.maximum(jnp.maximum(a, b), c)
+            m_safe = jnp.maximum(m, NEG)
+            return m_safe + jnp.log(
+                jnp.exp(a - m_safe) + jnp.exp(b - m_safe) + jnp.exp(c - m_safe)
+            )
+
+        def step(alpha, lp_frame):
+            a1 = alpha
+            a2 = jnp.concatenate([jnp.full((N, 1), NEG), alpha[:, :-1]], axis=1)
+            a3 = jnp.concatenate([jnp.full((N, 2), NEG), alpha[:, :-2]], axis=1)
+            a3 = jnp.where(skip_ok, a3, NEG)
+            new = logsum3(a1, a2, a3) + emit(lp_frame)
+            new = jnp.where(valid_s, new, NEG)
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, N, S]
+        # per-sample final frame t = input_len - 1
+        t_last = jnp.clip(in_len.astype(jnp.int32) - 1, 0, Tm - 1)
+        at = alphas[t_last, jnp.arange(N)]  # [N, S]
+        e_blank = jnp.take_along_axis(at, (2 * lab_len[:, None]).astype(jnp.int32), 1)[:, 0]
+        e_label = jnp.take_along_axis(
+            at, jnp.clip(2 * lab_len[:, None] - 1, 0, S - 1).astype(jnp.int32), 1
+        )[:, 0]
+        e_label = jnp.where(lab_len > 0, e_label, NEG)
+        return -jnp.logaddexp(e_blank, e_label)  # per-sample [N]
+
+    def g(logits_in, lab, in_len, lab_len):
+        core = lambda lg: f(lg, lab, in_len, lab_len)
+        if norm_by_times:
+            # reference warpctc: norm_by_times scales only the GRADIENT by
+            # 1/T per sample; the forward loss value stays unscaled
+            @jax.custom_vjp
+            def nbt(lg):
+                return core(lg)
+
+            def nbt_fwd(lg):
+                out, vjp_fn = jax.vjp(core, lg)
+                return out, vjp_fn
+
+            def nbt_bwd(vjp_fn, ct):
+                scaled = ct / jnp.maximum(in_len.astype(ct.dtype), 1.0)
+                return vjp_fn(scaled)
+
+            nbt.defvjp(nbt_fwd, nbt_bwd)
+            loss = nbt(logits_in)
+        else:
+            loss = core(logits_in)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return op(g, lp_t, lab_t, il_t, ll_t, name="ctc_loss")

@@ -221,3 +221,107 @@ def test_initializers():
     assert np.allclose(np.asarray(c), 3.0)
     o = np.asarray(I.Orthogonal()([8, 8]))
     assert np.allclose(o @ o.T, np.eye(8), atol=1e-4)
+
+
+def test_ctc_loss_matches_brute_force():
+    """CTC forward recursion vs exhaustive alignment enumeration
+    (reference warpctc kernel semantics)."""
+    import itertools
+
+    from paddle_tpu.ops.loss_ops import ctc_loss
+
+    rs = np.random.RandomState(0)
+    T_, N, C = 4, 2, 3
+    logits = rs.randn(T_, N, C).astype(np.float32)
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    labels = np.array([[1, 2], [2, 0]], np.int64)
+    in_len = np.array([4, 3], np.int64)
+    lab_len = np.array([2, 1], np.int64)
+
+    def brute(lp_n, lab, T_n):
+        total = 0.0
+        for path in itertools.product(range(C), repeat=T_n):
+            col, prev = [], None
+            for ch in path:
+                if ch != prev:
+                    col.append(ch)
+                prev = ch
+            col = [ch for ch in col if ch != 0]
+            if col == list(lab):
+                total += np.exp(sum(lp_n[t, ch] for t, ch in enumerate(path)))
+        return -np.log(total)
+
+    ref = [brute(lp[:, 0], [1, 2], 4), brute(lp[:, 1], [2], 3)]
+    # the op takes RAW logits (softmax integrated, warpctc contract)
+    out = ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                   paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                   reduction="none")
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+    mean = ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                    paddle.to_tensor(in_len), paddle.to_tensor(lab_len))
+    np.testing.assert_allclose(float(mean.numpy()),
+                               np.mean([ref[0] / 2, ref[1] / 1]), atol=1e-4)
+    lpt = paddle.to_tensor(logits)
+    lpt.stop_gradient = False
+    ctc_loss(lpt, paddle.to_tensor(labels), paddle.to_tensor(in_len),
+             paddle.to_tensor(lab_len)).backward()
+    assert lpt.grad is not None and np.isfinite(lpt.grad.numpy()).all()
+
+    # norm_by_times: forward values UNCHANGED, gradients scaled by 1/T
+    out_nbt = ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                       paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                       reduction="none", norm_by_times=True)
+    np.testing.assert_allclose(out_nbt.numpy(), ref, atol=1e-4)
+    g1 = paddle.to_tensor(logits); g1.stop_gradient = False
+    ctc_loss(g1, paddle.to_tensor(labels), paddle.to_tensor(in_len),
+             paddle.to_tensor(lab_len), reduction="sum").backward()
+    g2 = paddle.to_tensor(logits); g2.stop_gradient = False
+    ctc_loss(g2, paddle.to_tensor(labels), paddle.to_tensor(in_len),
+             paddle.to_tensor(lab_len), reduction="sum",
+             norm_by_times=True).backward()
+    # sample 0 grads scale by 1/4, sample 1 by 1/3
+    np.testing.assert_allclose(
+        g2.grad.numpy()[:, 0], g1.grad.numpy()[:, 0] / 4.0, atol=1e-5)
+    np.testing.assert_allclose(
+        g2.grad.numpy()[:, 1], g1.grad.numpy()[:, 1] / 3.0, atol=1e-5)
+
+
+def test_fold_inverts_unfold():
+    from paddle_tpu.ops.common_nn import fold
+    from paddle_tpu.ops.conv_pool import unfold
+
+    x = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4))
+    u = unfold(x, kernel_sizes=2, strides=2)
+    back = fold(u, output_sizes=[4, 4], kernel_sizes=2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x.numpy())
+    # overlapping patches scatter-add with patch multiplicity
+    # 4-element paddings follow the reference [top, left, bottom, right]
+    up = unfold(x, kernel_sizes=2, strides=1, paddings=[1, 0, 0, 0])
+    bp = fold(up, output_sizes=[4, 4], kernel_sizes=2, strides=1,
+              paddings=[1, 0, 0, 0])
+    assert bp.shape == [1, 2, 4, 4]
+
+    u2 = unfold(x, kernel_sizes=2, strides=1)
+    b2 = fold(u2, output_sizes=[4, 4], kernel_sizes=2, strides=1)
+    ones = fold(
+        unfold(paddle.ones([1, 2, 4, 4]), kernel_sizes=2, strides=1),
+        output_sizes=[4, 4], kernel_sizes=2, strides=1,
+    )
+    np.testing.assert_allclose(b2.numpy() / ones.numpy(), x.numpy(), atol=1e-5)
+
+
+def test_spectral_norm():
+    from paddle_tpu.nn import SpectralNorm
+
+    rs = np.random.RandomState(0)
+    w = rs.randn(6, 4).astype(np.float32)
+    sn = SpectralNorm(w.shape, dim=0, power_iters=30)
+    wn = sn(paddle.to_tensor(w))
+    sv = np.linalg.svd(wn.numpy(), compute_uv=False)
+    assert abs(sv[0] - 1.0) < 1e-3  # leading singular value normalized to 1
+    wt = paddle.to_tensor(w)
+    wt.stop_gradient = False
+    sn2 = SpectralNorm(w.shape, power_iters=5)
+    sn2(wt).sum().backward()
+    assert wt.grad is not None
+    assert "weight_u" in dict(sn2.named_buffers())  # persists power-iter state
