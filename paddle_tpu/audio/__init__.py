@@ -3,6 +3,8 @@
 Reference parity: python/paddle/audio/ in /root/reference (Spectrogram,
 MelSpectrogram, LogMelSpectrogram, MFCC + window functions).
 """
+from . import backends  # noqa: F401
 from . import functional  # noqa: F401
+from .backends import load, save  # noqa: F401
 from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram  # noqa: F401
 from . import datasets  # noqa: F401,E402

@@ -1,0 +1,92 @@
+"""paddle.audio.backends — WAV file I/O.
+
+Reference parity: python/paddle/audio/backends/ in /root/reference
+(soundfile/wave backends, load -> (waveform, sample_rate), save). This
+environment ships no soundfile; PCM WAV (8/16/32-bit int and 32-bit float)
+is parsed with the stdlib `wave` module plus a RIFF fallback for float
+format tags `wave` rejects.
+"""
+from __future__ import annotations
+
+import struct
+import wave as _wave
+
+import numpy as np
+
+_INT_DTYPES = {1: np.uint8, 2: np.int16, 4: np.int32}
+_INT_SCALE = {1: 1.0 / 128.0, 2: 1.0 / 32768.0, 4: 1.0 / 2147483648.0}
+
+
+def _load_riff_float(path):
+    """Minimal RIFF walk for IEEE-float WAVs (format tag 3)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != b"RIFF" or data[8:12] != b"WAVE":
+        raise ValueError(f"{path}: not a RIFF/WAVE file")
+    pos = 12
+    fmt = None
+    payload = None
+    while pos + 8 <= len(data):
+        cid = data[pos:pos + 4]
+        (size,) = struct.unpack("<I", data[pos + 4:pos + 8])
+        body = data[pos + 8:pos + 8 + size]
+        pos += 8 + size + (size & 1)
+        if cid == b"fmt ":
+            fmt = struct.unpack("<HHIIHH", body[:16])
+        elif cid == b"data":
+            payload = body
+    if fmt is None or payload is None:
+        raise ValueError(f"{path}: missing fmt/data chunk")
+    tag, channels, rate, _, _, bits = fmt
+    if tag != 3 or bits != 32:
+        raise ValueError(f"{path}: unsupported WAV format tag={tag} bits={bits}")
+    wav = np.frombuffer(payload, np.float32).reshape(-1, channels)
+    return wav.T.copy(), rate
+
+
+def load(path: str, normalize: bool = True):
+    """Read a WAV file -> (waveform [channels, frames] float32 in [-1, 1],
+    sample_rate). Reference backends load() contract."""
+    try:
+        with _wave.open(path, "rb") as w:
+            channels = w.getnchannels()
+            width = w.getsampwidth()
+            rate = w.getframerate()
+            frames = w.readframes(w.getnframes())
+    except _wave.Error:
+        return _load_riff_float(path)
+    if width not in _INT_DTYPES:
+        raise ValueError(f"{path}: unsupported sample width {width}")
+    arr = np.frombuffer(frames, _INT_DTYPES[width]).reshape(-1, channels).T
+    if width == 1:
+        arr = arr.astype(np.int16) - 128  # u8 is offset-binary
+        out = arr.astype(np.float32) * _INT_SCALE[1]
+    else:
+        out = arr.astype(np.float32) * _INT_SCALE[width]
+    return (out if normalize else arr.astype(np.float32)), rate
+
+
+def save(path: str, src, sample_rate: int, bits_per_sample: int = 16):
+    """Write [channels, frames] (or [frames]) float32 in [-1,1] as PCM WAV."""
+    arr = np.asarray(getattr(src, "numpy", lambda: src)())
+    if arr.ndim == 1:
+        arr = arr[None]
+    channels, _ = arr.shape
+    if bits_per_sample == 16:
+        pcm = np.clip(arr * 32767.0, -32768, 32767).astype(np.int16)
+    elif bits_per_sample == 32:
+        # scale in float64: 2^31-1 is not float32-representable, so the
+        # float32 product of a full-scale sample rounds to 2^31 and the
+        # int32 cast would wrap to -2^31
+        pcm = np.clip(
+            arr.astype(np.float64) * 2147483647.0, -2147483648, 2147483647
+        ).astype(np.int32)
+    elif bits_per_sample == 8:
+        pcm = (np.clip(arr * 127.0, -128, 127) + 128).astype(np.uint8)
+    else:
+        raise ValueError(f"bits_per_sample {bits_per_sample} unsupported")
+    with _wave.open(path, "wb") as w:
+        w.setnchannels(channels)
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.T.tobytes())

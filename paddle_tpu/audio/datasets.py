@@ -31,22 +31,36 @@ class _SyntheticAudioDataset(Dataset):
         n_samples = int(self.SAMPLE_RATE * self.DURATION)
         if archive_path and os.path.isdir(archive_path):
             files = sorted(
-                f for f in os.listdir(archive_path) if f.endswith(".npy")
+                f for f in os.listdir(archive_path)
+                if f.endswith((".npy", ".wav"))
             )
-            self._waves = [
-                np.load(os.path.join(archive_path, f)).astype(np.float32)
-                for f in files
-            ]
+            self._waves = []
+            for f in files:
+                full = os.path.join(archive_path, f)
+                if f.endswith(".wav"):
+                    from .backends import load as _wav_load
+
+                    wav, _sr = _wav_load(full)
+                    self._waves.append(wav[0])  # mono: first channel
+                else:
+                    self._waves.append(np.load(full).astype(np.float32))
             self._labels = []
             for f in files:
-                head = f.split("_")[0]
-                label = int(head) if head.isdigit() else 0
-                if label >= self.N_CLASSES:
+                label = self._label_from_name(os.path.splitext(f)[0])
+                if not 0 <= label < self.N_CLASSES:
                     raise ValueError(
-                        f"{f}: label {label} >= {self.N_CLASSES} classes"
+                        f"{f}: label {label} outside {self.N_CLASSES} classes"
                     )
                 self._labels.append(label)
         else:
+            import warnings
+
+            warnings.warn(
+                f"{type(self).__name__}: archive_path={archive_path!r} is not "
+                "a directory — falling back to SYNTHETIC waveforms (correct "
+                "interface/labels, not real audio).",
+                stacklevel=2,
+            )
             # synthetic: each class is a distinct fundamental + harmonics
             t = np.arange(n_samples) / self.SAMPLE_RATE
             self._waves, self._labels = [], []
@@ -64,6 +78,11 @@ class _SyntheticAudioDataset(Dataset):
         sl = slice(0, cut) if mode == "train" else slice(cut, None)
         self._waves = self._waves[sl]
         self._labels = self._labels[sl]
+
+    def _label_from_name(self, stem):
+        """Default clip-label convention: numeric prefix before '_'."""
+        head = stem.split("_")[0]
+        return int(head) if head.isdigit() else 0
 
     def __len__(self):
         return len(self._waves)
@@ -99,6 +118,13 @@ class TESS(_SyntheticAudioDataset):
     def __init__(self, mode="train", n_shift=None, **kw):
         super().__init__(mode=mode, **kw)
 
+    def _label_from_name(self, stem):
+        # TESS convention "OAF_back_angry": emotion is the last '_' token
+        emotion = stem.split("_")[-1].lower()
+        if emotion in self.label_list:
+            return self.label_list.index(emotion)
+        return super()._label_from_name(stem)
+
 
 class ESC50(_SyntheticAudioDataset):
     """Environmental sound classification (reference audio/datasets/esc50.py):
@@ -107,3 +133,10 @@ class ESC50(_SyntheticAudioDataset):
     N_CLASSES = 50
     N = 400
     label_list = [f"class_{i}" for i in range(50)]
+
+    def _label_from_name(self, stem):
+        # ESC-50 convention "{fold}-{src}-{take}-{target}": target is last
+        tail = stem.split("-")[-1]
+        if tail.isdigit():
+            return int(tail)
+        return super()._label_from_name(stem)

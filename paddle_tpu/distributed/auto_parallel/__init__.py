@@ -280,6 +280,13 @@ class Engine:
                 loss, params, buffers, opt_state = self._step(
                     params, buffers, opt_state, lr, rng.next_key(), xa, ya
                 )
+                # advance the LR schedule per iteration (reference Engine
+                # steps the scheduler each step; hapi train_batch does too)
+                self.optimizer._step_count += 1
+                from ...optimizer.lr import LRScheduler
+
+                if isinstance(self.optimizer._learning_rate, LRScheduler):
+                    self.optimizer._learning_rate.step()
                 self.history["loss"].append(float(np.asarray(loss)))
         self._state = (params, buffers, opt_state)
         from ...core.functional import load_state_arrays

@@ -188,9 +188,20 @@ def load_state(path, shardings=None, keys=None):
     return _unflatten(flat)
 
 
-def save_sharded_model(model, optimizer, path, opt_state=None):
+def save_sharded_model(model, optimizer, path, opt_state=None, save_id=None):
     """hapi-level wrapper: save a model's params (+ optimizer slots) from
-    their live (possibly sharded) arrays (reference dist_save.py role)."""
+    their live (possibly sharded) arrays (reference dist_save.py role).
+
+    `save_id` (e.g. the global step) is required under multi-process so
+    rank 0's index merge can tell THIS save's per-rank index files from a
+    previous save's to the same path (save_state's contract)."""
+    if save_id is None and jax.process_count() > 1:
+        raise ValueError(
+            "save_sharded_model: save_id is required when "
+            "jax.process_count() > 1 — pass the global step (the same "
+            "value on every rank) so re-saves to the same path cannot mix "
+            "a stale rank's index with fresh shard files"
+        )
     params = {k: p._array for k, p in model.named_parameters_dict().items()}
     buffers = {k: b._array for k, b in model.named_buffers_dict().items()}
     state = {"params": params, "buffers": buffers}
@@ -198,7 +209,7 @@ def save_sharded_model(model, optimizer, path, opt_state=None):
         state["opt"] = opt_state
     elif optimizer is not None:
         state["opt"] = optimizer.state_arrays_for(model.named_parameters_dict())
-    save_state(state, path)
+    save_state(state, path, save_id=save_id)
 
 
 def load_sharded_model(model, optimizer, path, mesh=None, param_specs=None):

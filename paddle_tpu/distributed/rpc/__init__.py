@@ -43,14 +43,26 @@ class _Agent:
         self._client_pool = ThreadPoolExecutor(max_workers=8)
         self._stop = threading.Event()
         # Trust model: like the reference's brpc agent (and NCCL/gloo
-        # bootstraps), RPC assumes a private cluster network. We still bind
-        # loopback-only for local jobs, and the authkey — which
-        # multiprocessing uses for HMAC challenge-response, so it never
-        # crosses the wire — comes from PADDLE_RPC_AUTHKEY when set.
+        # bootstraps), RPC assumes a private cluster network — but the CALL
+        # handler executes pickled callables, so an authkey any peer can
+        # derive is no authkey at all. Loopback jobs get a derived default;
+        # a non-loopback bind REQUIRES an explicit secret (the launcher
+        # generates one per job and carries it in the env — see
+        # launch/main.py), which multiprocessing uses for HMAC
+        # challenge-response so it never crosses the wire.
         bind_ip = "127.0.0.1" if local_only else "0.0.0.0"
-        self._authkey = os.environ.get(
-            "PADDLE_RPC_AUTHKEY", f"paddle_tpu_rpc:{master_addr}:{master_port}"
-        ).encode()
+        key = os.environ.get("PADDLE_RPC_AUTHKEY")
+        if key is None:
+            if not local_only:
+                raise RuntimeError(
+                    "init_rpc: refusing to bind a non-loopback RPC listener "
+                    f"(master {master_addr}) without PADDLE_RPC_AUTHKEY. The "
+                    "RPC agent executes remote callables; set a per-job "
+                    "secret (paddle_tpu.distributed.launch generates one "
+                    "automatically) before running multi-host RPC."
+                )
+            key = f"paddle_tpu_rpc:{master_addr}:{master_port}"
+        self._authkey = key.encode()
         self._listener = Listener((bind_ip, self.port), authkey=self._authkey)
         self._serve_thread = threading.Thread(target=self._serve, daemon=True)
         self._serve_thread.start()

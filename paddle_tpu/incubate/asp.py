@@ -84,16 +84,20 @@ class ASPOptimizer:
 
     def __init__(self, optimizer):
         self._inner = optimizer
-        param_ids = {id(p) for p in (optimizer._parameter_list or [])}
-        self._masks = [
-            (p, m) for pid, (p, m) in _MASKS.items() if pid in param_ids
-        ]
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
+    def _my_masks(self):
+        # Resolved LAZILY each step, not snapshotted at decorate() time:
+        # the reference API allows asp.decorate(opt) BEFORE asp.prune_model
+        # (model), and a decorate-time snapshot would silently hold an
+        # empty list forever in that order.
+        param_ids = {id(p) for p in (self._inner._parameter_list or [])}
+        return [(p, m) for pid, (p, m) in _MASKS.items() if pid in param_ids]
+
     def _apply(self):
-        for p, mask in self._masks:
+        for p, mask in self._my_masks():
             p._array = p._array * mask.astype(p._array.dtype)
 
     def step(self):

@@ -1,2 +1,4 @@
 from . import datasets, models, transforms  # noqa: F401
+from . import image  # noqa: F401
 from . import ops  # noqa: F401
+from .image import image_load, image_save  # noqa: F401

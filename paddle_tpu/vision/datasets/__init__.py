@@ -11,11 +11,24 @@ from __future__ import annotations
 
 import gzip
 import os
+import pickle
 import struct
+import tarfile
+import warnings
 
 import numpy as np
 
 from ...io.dataset import Dataset
+from ..image import IMG_EXTENSIONS, image_load
+
+
+def _warn_synthetic(cls_name, why):
+    warnings.warn(
+        f"{cls_name}: {why} — falling back to the deterministic SYNTHETIC "
+        "sample generator (correct shapes/classes, not real data). Pass the "
+        "dataset file explicitly to train on real data.",
+        stacklevel=3,
+    )
 
 
 class _SyntheticImageDataset(Dataset):
@@ -64,6 +77,11 @@ class MNIST(_SyntheticImageDataset):
             self.images, self.labels_np = self._load_idx(image_path, label_path)
             self.real = True
         else:
+            _warn_synthetic(
+                type(self).__name__,
+                f"image_path={image_path!r} not found" if image_path
+                else "no image_path given (no network egress to download)",
+            )
             super().__init__(mode, transform)
             self.real = False
 
@@ -95,15 +113,81 @@ class FashionMNIST(MNIST):
 
 
 class Cifar10(_SyntheticImageDataset):
+    """Real loading parses the standard cifar-10-python.tar.gz: pickled
+    batch dicts of {b'data': [N, 3072] uint8, b'labels': [N]} (reference
+    vision/datasets/cifar.py member-name + pickle layout)."""
+
     IMAGE_SHAPE = (3, 32, 32)
     NUM_CLASSES = 10
+    _TRAIN_MEMBERS = ("data_batch",)
+    _TEST_MEMBERS = ("test_batch",)
+    _LABEL_KEYS = (b"labels", "labels")
 
     def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
-        super().__init__(mode, transform)
+        if data_file and os.path.exists(data_file):
+            self.mode = mode
+            self.transform = transform
+            self.images, self.labels_np = self._load_tar(data_file, mode)
+            self.real = True
+        else:
+            _warn_synthetic(
+                type(self).__name__,
+                f"data_file={data_file!r} not found" if data_file
+                else "no data_file given (no network egress to download)",
+            )
+            super().__init__(mode, transform)
+            self.real = False
+
+    @classmethod
+    def _load_tar(cls, data_file, mode):
+        wanted = cls._TRAIN_MEMBERS if mode == "train" else cls._TEST_MEMBERS
+        images, labels = [], []
+        open_mode = "r:gz" if data_file.endswith(("gz", "tgz")) else "r"
+        with tarfile.open(data_file, open_mode) as tf:
+            for member in sorted(tf.getmembers(), key=lambda m: m.name):
+                base = os.path.basename(member.name)
+                if not member.isfile() or not any(base.startswith(w) for w in wanted):
+                    continue
+                batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                data = batch[b"data"] if b"data" in batch else batch["data"]
+                lab = None
+                for k in cls._LABEL_KEYS:
+                    if k in batch:
+                        lab = batch[k]
+                        break
+                if lab is None:
+                    raise ValueError(
+                        f"{data_file}:{member.name}: no label key "
+                        f"{cls._LABEL_KEYS} in pickle dict"
+                    )
+                images.append(np.asarray(data, np.uint8).reshape(-1, 3, 32, 32))
+                labels.append(np.asarray(lab, np.int64))
+        if not images:
+            raise ValueError(
+                f"{data_file}: no members matching {wanted} for mode={mode!r}"
+            )
+        return (
+            np.concatenate(images).astype(np.float32) / 255.0,
+            np.concatenate(labels),
+        )
+
+    def __getitem__(self, idx):
+        if not self.real:
+            return super().__getitem__(idx)
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels_np[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images) if self.real else super().__len__()
 
 
 class Cifar100(Cifar10):
     NUM_CLASSES = 100
+    _TRAIN_MEMBERS = ("train",)
+    _TEST_MEMBERS = ("test",)
+    _LABEL_KEYS = (b"fine_labels", "fine_labels")
 
 
 class Flowers(_SyntheticImageDataset):
@@ -132,10 +216,14 @@ class VOC2012(_SyntheticImageDataset):
 
 
 class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (reference vision/datasets/folder.py).
+    Decodes PNG/PPM/PGM/BMP natively (vision/image.py) plus npy/npz."""
+
     def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
         self.root = root
         self.transform = transform
-        exts = extensions or (".npy",)
+        self.loader = loader or image_load
+        exts = tuple(extensions) if extensions else IMG_EXTENSIONS
         self.samples = []
         classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
@@ -143,12 +231,17 @@ class DatasetFolder(Dataset):
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
         for c in classes:
             for fn in sorted(os.listdir(os.path.join(root, c))):
-                if fn.endswith(exts):
-                    self.samples.append((os.path.join(root, c, fn), self.class_to_idx[c]))
+                full = os.path.join(root, c, fn)
+                ok = (
+                    is_valid_file(full) if is_valid_file is not None
+                    else fn.lower().endswith(exts)
+                )
+                if ok:
+                    self.samples.append((full, self.class_to_idx[c]))
 
     def __getitem__(self, idx):
         path, target = self.samples[idx]
-        img = np.load(path)
+        img = self.loader(path)
         if self.transform:
             img = self.transform(img)
         return img, target
@@ -161,13 +254,16 @@ class ImageFolder(DatasetFolder):
     def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
         self.root = root
         self.transform = transform
-        exts = extensions or (".npy",)
+        self.loader = loader or image_load
+        exts = tuple(extensions) if extensions else IMG_EXTENSIONS
         self.samples = [
-            os.path.join(root, fn) for fn in sorted(os.listdir(root)) if fn.endswith(exts)
+            os.path.join(root, fn) for fn in sorted(os.listdir(root))
+            if (is_valid_file(os.path.join(root, fn)) if is_valid_file is not None
+                else fn.lower().endswith(exts))
         ]
 
     def __getitem__(self, idx):
-        img = np.load(self.samples[idx])
+        img = self.loader(self.samples[idx])
         if self.transform:
             img = self.transform(img)
         return [img]

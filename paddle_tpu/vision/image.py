@@ -1,0 +1,263 @@
+"""Pure-numpy image codecs: PNG / PPM-PGM / BMP / NPY.
+
+Reference parity: python/paddle/vision/image.py in /root/reference routes
+image_load through PIL or cv2 backends. This environment ships neither, so
+the formats the datasets and DatasetFolder need are decoded natively:
+
+- PNG (the test/checkpoint workhorse): 8-bit gray / gray+alpha / RGB / RGBA
+  / palette, all five scanline filters, non-interlaced. Encoder writes
+  filter-0 rows (always valid PNG) for round-trip tests and artifact dumps.
+- PPM/PGM (P2/P3/P5/P6): the classic uncompressed interchange formats.
+- BMP: 24/32-bit uncompressed BITMAPINFOHEADER.
+- NPY: raw arrays saved by this framework's own tooling.
+
+All decoders return HWC uint8 (grayscale keeps a 1-channel last axis) so
+transforms can treat every source uniformly.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import numpy as np
+
+_PNG_SIG = b"\x89PNG\r\n\x1a\n"
+
+
+# ---------------------------------------------------------------------------
+# PNG
+# ---------------------------------------------------------------------------
+
+def _png_unfilter(raw, height, stride, bpp):
+    """Undo per-scanline filtering (PNG spec §9). bpp = bytes per pixel."""
+    out = np.empty(height * stride, np.uint8)
+    pos = 0
+    prev = np.zeros(stride, np.uint8)
+    for y in range(height):
+        ftype = raw[pos]
+        line = np.frombuffer(raw, np.uint8, stride, pos + 1).copy()
+        pos += 1 + stride
+        if ftype == 0:  # None
+            pass
+        elif ftype == 1:  # Sub
+            for i in range(bpp, stride):
+                line[i] = (int(line[i]) + int(line[i - bpp])) & 0xFF
+        elif ftype == 2:  # Up
+            line = (line.astype(np.int32) + prev).astype(np.uint8)
+        elif ftype == 3:  # Average
+            for i in range(stride):
+                left = int(line[i - bpp]) if i >= bpp else 0
+                line[i] = (int(line[i]) + ((left + int(prev[i])) >> 1)) & 0xFF
+        elif ftype == 4:  # Paeth
+            for i in range(stride):
+                a = int(line[i - bpp]) if i >= bpp else 0
+                b = int(prev[i])
+                c = int(prev[i - bpp]) if i >= bpp else 0
+                p = a + b - c
+                pa, pb, pc = abs(p - a), abs(p - b), abs(p - c)
+                pred = a if (pa <= pb and pa <= pc) else (b if pb <= pc else c)
+                line[i] = (int(line[i]) + pred) & 0xFF
+        else:
+            raise ValueError(f"PNG: unknown filter type {ftype}")
+        out[y * stride:(y + 1) * stride] = line
+        prev = line
+    return out
+
+
+def decode_png(data: bytes) -> np.ndarray:
+    if data[:8] != _PNG_SIG:
+        raise ValueError("not a PNG file")
+    pos = 8
+    width = height = None
+    bit_depth = color_type = None
+    idat = []
+    palette = None
+    trns = None
+    while pos < len(data):
+        (length,) = struct.unpack(">I", data[pos:pos + 4])
+        ctype = data[pos + 4:pos + 8]
+        body = data[pos + 8:pos + 8 + length]
+        pos += 12 + length
+        if ctype == b"IHDR":
+            width, height, bit_depth, color_type, _comp, _filt, interlace = (
+                struct.unpack(">IIBBBBB", body)
+            )
+            if interlace:
+                raise ValueError("PNG: interlaced images unsupported")
+            if bit_depth != 8:
+                raise ValueError(f"PNG: bit depth {bit_depth} unsupported (8 only)")
+        elif ctype == b"PLTE":
+            palette = np.frombuffer(body, np.uint8).reshape(-1, 3)
+        elif ctype == b"tRNS":
+            trns = np.frombuffer(body, np.uint8)
+        elif ctype == b"IDAT":
+            idat.append(body)
+        elif ctype == b"IEND":
+            break
+    channels = {0: 1, 2: 3, 3: 1, 4: 2, 6: 4}[color_type]
+    raw = zlib.decompress(b"".join(idat))
+    stride = width * channels
+    flat = _png_unfilter(raw, height, stride, channels)
+    img = flat.reshape(height, width, channels)
+    if color_type == 3:  # palette -> RGB(A)
+        rgb = palette[img[..., 0]]
+        if trns is not None:
+            alpha = np.full((height, width, 1), 255, np.uint8)
+            n = min(len(trns), 256)
+            lut = np.full(256, 255, np.uint8)
+            lut[:n] = trns[:n]
+            alpha[..., 0] = lut[img[..., 0]]
+            rgb = np.concatenate([rgb, alpha], axis=-1)
+        img = rgb
+    return img
+
+
+def encode_png(img: np.ndarray) -> bytes:
+    """Minimal encoder: 8-bit gray/GA/RGB/RGBA, filter 0 everywhere."""
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[..., None]
+    if img.dtype != np.uint8:
+        raise ValueError("encode_png expects uint8")
+    h, w, c = img.shape
+    color_type = {1: 0, 2: 4, 3: 2, 4: 6}[c]
+    raw = b"".join(b"\x00" + img[y].tobytes() for y in range(h))
+
+    def chunk(ctype, body):
+        return (
+            struct.pack(">I", len(body)) + ctype + body
+            + struct.pack(">I", zlib.crc32(ctype + body) & 0xFFFFFFFF)
+        )
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, color_type, 0, 0, 0)
+    return (
+        _PNG_SIG
+        + chunk(b"IHDR", ihdr)
+        + chunk(b"IDAT", zlib.compress(raw, 6))
+        + chunk(b"IEND", b"")
+    )
+
+
+# ---------------------------------------------------------------------------
+# PPM / PGM
+# ---------------------------------------------------------------------------
+
+def decode_ppm(data: bytes) -> np.ndarray:
+    """P2/P3 (ascii) and P5/P6 (binary) netpbm, maxval <= 255."""
+    magic = data[:2]
+    if magic not in (b"P2", b"P3", b"P5", b"P6"):
+        raise ValueError("not a PGM/PPM file")
+    # tokenize the header: magic, width, height, maxval (comments start '#')
+    tokens = []
+    pos = 2
+    while len(tokens) < 3:
+        while pos < len(data) and data[pos:pos + 1].isspace():
+            pos += 1
+        if data[pos:pos + 1] == b"#":
+            while pos < len(data) and data[pos] != 0x0A:
+                pos += 1
+            continue
+        start = pos
+        while pos < len(data) and not data[pos:pos + 1].isspace():
+            pos += 1
+        tokens.append(int(data[start:pos]))
+    pos += 1  # single whitespace after maxval
+    w, h, maxval = tokens
+    channels = 3 if magic in (b"P3", b"P6") else 1
+    count = w * h * channels
+    if magic in (b"P5", b"P6"):
+        img = np.frombuffer(data, np.uint8, count, pos)
+    else:
+        img = np.array(data[pos:].split()[:count], np.uint16)
+    if maxval != 255:
+        img = (img.astype(np.float32) * (255.0 / maxval)).round()
+    return img.astype(np.uint8).reshape(h, w, channels)
+
+
+def encode_ppm(img: np.ndarray) -> bytes:
+    img = np.asarray(img, np.uint8)
+    if img.ndim == 2:
+        img = img[..., None]
+    h, w, c = img.shape
+    magic = b"P6" if c == 3 else b"P5"
+    if c not in (1, 3):
+        raise ValueError("PPM supports 1 or 3 channels")
+    return magic + f"\n{w} {h}\n255\n".encode() + img.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# BMP
+# ---------------------------------------------------------------------------
+
+def decode_bmp(data: bytes) -> np.ndarray:
+    if data[:2] != b"BM":
+        raise ValueError("not a BMP file")
+    (offset,) = struct.unpack("<I", data[10:14])
+    header_size, w, h = struct.unpack("<IiI", data[14:26])
+    (bpp,) = struct.unpack("<H", data[28:30])
+    (compression,) = struct.unpack("<I", data[30:34])
+    if compression != 0 or bpp not in (24, 32):
+        raise ValueError(f"BMP: only uncompressed 24/32-bit (got bpp={bpp})")
+    flip = h > 0
+    h = abs(h)
+    nbytes = bpp // 8
+    stride = (w * nbytes + 3) & ~3
+    img = np.empty((h, w, 3), np.uint8)
+    for y in range(h):
+        row = np.frombuffer(data, np.uint8, w * nbytes, offset + y * stride)
+        row = row.reshape(w, nbytes)
+        img[h - 1 - y if flip else y] = row[:, 2::-1]  # BGR(A) -> RGB
+    return img
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+IMG_EXTENSIONS = (".png", ".ppm", ".pgm", ".bmp", ".npy", ".npz")
+
+
+def image_load(path: str) -> np.ndarray:
+    """Load one image file to an HWC uint8 array (npy/npz pass through with
+    their stored dtype). Reference image_load (vision/image.py) role."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npy":
+        return np.load(path)
+    if ext == ".npz":
+        z = np.load(path)
+        return z[list(z.files)[0]]
+    with open(path, "rb") as f:
+        data = f.read()
+    if ext == ".png":
+        return decode_png(data)
+    if ext in (".ppm", ".pgm"):
+        return decode_ppm(data)
+    if ext == ".bmp":
+        return decode_bmp(data)
+    # sniff by magic as a fallback
+    if data[:8] == _PNG_SIG:
+        return decode_png(data)
+    if data[:2] in (b"P2", b"P3", b"P5", b"P6"):
+        return decode_ppm(data)
+    if data[:2] == b"BM":
+        return decode_bmp(data)
+    raise ValueError(
+        f"image_load: unsupported format {path!r} (supported: "
+        f"{', '.join(IMG_EXTENSIONS)})"
+    )
+
+
+def image_save(path: str, img: np.ndarray) -> None:
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npy":
+        np.save(path, img)
+        return
+    if ext == ".png":
+        payload = encode_png(img)
+    elif ext in (".ppm", ".pgm"):
+        payload = encode_ppm(img)
+    else:
+        raise ValueError(f"image_save: unsupported extension {ext!r}")
+    with open(path, "wb") as f:
+        f.write(payload)

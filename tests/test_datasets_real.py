@@ -1,0 +1,244 @@
+"""Real-format dataset ingestion: each loader parses a tiny real-format file
+written by the test (VERDICT r3 item 5 — interface parity AND data parity).
+
+Reference formats matched: MNIST IDX (vision/datasets/mnist.py), CIFAR
+pickle-in-tar (cifar.py), image folder decode (folder.py), WAV audio
+(audio/backends)."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import image as V
+
+
+# ---------------------------------------------------------------------------
+# image codecs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("channels", [1, 2, 3, 4])
+def test_png_roundtrip(tmp_path, channels):
+    rs = np.random.RandomState(channels)
+    img = rs.randint(0, 256, (13, 17, channels), dtype=np.uint8)
+    p = str(tmp_path / "x.png")
+    V.image_save(p, img)
+    back = V.image_load(p)
+    np.testing.assert_array_equal(back, img)
+
+
+def test_png_decodes_all_filter_types(tmp_path):
+    """A zlib stream using filters 1-4 (written by hand) must decode to the
+    same pixels as the filter-0 encoding."""
+    import zlib
+
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 256, (4, 8, 3), dtype=np.uint8)
+    stride, bpp = 8 * 3, 3
+    rows = []
+    for y, ftype in enumerate([1, 2, 3, 4]):
+        line = img[y].reshape(-1).astype(np.int32)
+        prev = img[y - 1].reshape(-1).astype(np.int32) if y else np.zeros(stride, np.int32)
+        enc = np.zeros(stride, np.int32)
+        for i in range(stride):
+            a = line[i - bpp] if i >= bpp else 0
+            b = prev[i]
+            c = prev[i - bpp] if i >= bpp else 0
+            if ftype == 1:
+                pred = a
+            elif ftype == 2:
+                pred = b
+            elif ftype == 3:
+                pred = (a + b) >> 1
+            else:
+                p = a + b - c
+                pa, pb, pc = abs(p - a), abs(p - b), abs(p - c)
+                pred = a if (pa <= pb and pa <= pc) else (b if pb <= pc else c)
+            enc[i] = (line[i] - pred) & 0xFF
+        rows.append(bytes([ftype]) + bytes(enc.astype(np.uint8)))
+    raw = b"".join(rows)
+
+    def chunk(ctype, body):
+        return (struct.pack(">I", len(body)) + ctype + body
+                + struct.pack(">I", zlib.crc32(ctype + body) & 0xFFFFFFFF))
+
+    data = (b"\x89PNG\r\n\x1a\n"
+            + chunk(b"IHDR", struct.pack(">IIBBBBB", 8, 4, 8, 2, 0, 0, 0))
+            + chunk(b"IDAT", zlib.compress(raw))
+            + chunk(b"IEND", b""))
+    np.testing.assert_array_equal(V.decode_png(data), img)
+
+
+def test_ppm_binary_and_ascii(tmp_path):
+    img = np.arange(2 * 3 * 3, dtype=np.uint8).reshape(2, 3, 3)
+    p6 = str(tmp_path / "x.ppm")
+    V.image_save(p6, img)
+    np.testing.assert_array_equal(V.image_load(p6), img)
+    # ascii P3 with a comment line
+    body = " ".join(str(v) for v in img.reshape(-1))
+    p3 = tmp_path / "y.ppm"
+    p3.write_bytes(f"P3\n# comment\n3 2\n255\n{body}\n".encode())
+    np.testing.assert_array_equal(V.image_load(str(p3)), img)
+
+
+def test_bmp_24bit(tmp_path):
+    img = np.random.RandomState(0).randint(0, 256, (5, 3, 3), dtype=np.uint8)
+    h, w = img.shape[:2]
+    stride = (w * 3 + 3) & ~3
+    rows = b""
+    for y in range(h - 1, -1, -1):  # bottom-up
+        row = img[y, :, ::-1].tobytes()  # RGB -> BGR
+        rows += row + b"\x00" * (stride - len(row))
+    header = (b"BM" + struct.pack("<IHHI", 54 + len(rows), 0, 0, 54)
+              + struct.pack("<IiiHHIIiiII", 40, w, h, 1, 24, 0, len(rows), 0, 0, 0, 0))
+    p = tmp_path / "x.bmp"
+    p.write_bytes(header + rows)
+    np.testing.assert_array_equal(V.image_load(str(p)), img)
+
+
+# ---------------------------------------------------------------------------
+# MNIST idx
+# ---------------------------------------------------------------------------
+
+def _write_idx(tmp_path, n=6, gz=False):
+    rs = np.random.RandomState(1)
+    images = rs.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+    labels = rs.randint(0, 10, n).astype(np.uint8)
+    op = gzip.open if gz else open
+    ip = str(tmp_path / ("img.idx3-ubyte" + (".gz" if gz else "")))
+    lp = str(tmp_path / ("lab.idx1-ubyte" + (".gz" if gz else "")))
+    with op(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28) + images.tobytes())
+    with op(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + labels.tobytes())
+    return ip, lp, images, labels
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_mnist_idx(tmp_path, gz):
+    ip, lp, images, labels = _write_idx(tmp_path, gz=gz)
+    ds = paddle.vision.datasets.MNIST(image_path=ip, label_path=lp)
+    assert ds.real and len(ds) == 6
+    img0, y0 = ds[0]
+    np.testing.assert_allclose(img0[0], images[0] / 255.0, rtol=1e-6)
+    assert int(y0[0]) == int(labels[0])
+
+
+def test_mnist_synthetic_fallback_warns():
+    with pytest.warns(UserWarning, match="SYNTHETIC"):
+        ds = paddle.vision.datasets.MNIST()
+    assert not ds.real and len(ds) > 0
+
+
+# ---------------------------------------------------------------------------
+# CIFAR tar.gz pickle
+# ---------------------------------------------------------------------------
+
+def _write_cifar(tmp_path, members, label_key, n=4):
+    rs = np.random.RandomState(2)
+    path = str(tmp_path / "cifar.tar.gz")
+    all_data = {}
+    with tarfile.open(path, "w:gz") as tf:
+        import io
+
+        for m in members:
+            data = rs.randint(0, 256, (n, 3072), dtype=np.uint8)
+            labels = rs.randint(0, 10, n).tolist()
+            all_data[m] = (data, labels)
+            blob = pickle.dumps({b"data": data, label_key: labels})
+            info = tarfile.TarInfo(f"cifar-batches-py/{m}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    return path, all_data
+
+
+def test_cifar10_pickle_tar(tmp_path):
+    path, truth = _write_cifar(tmp_path, ["data_batch_1", "data_batch_2", "test_batch"], b"labels")
+    train = paddle.vision.datasets.Cifar10(data_file=path, mode="train")
+    test = paddle.vision.datasets.Cifar10(data_file=path, mode="test")
+    assert train.real and len(train) == 8 and len(test) == 4
+    img0, y0 = train[0]
+    np.testing.assert_allclose(
+        img0, truth["data_batch_1"][0][0].reshape(3, 32, 32) / 255.0, rtol=1e-6
+    )
+    assert int(y0[0]) == truth["data_batch_1"][1][0]
+
+
+def test_cifar100_pickle_tar(tmp_path):
+    path, truth = _write_cifar(tmp_path, ["train", "test"], b"fine_labels")
+    ds = paddle.vision.datasets.Cifar100(data_file=path, mode="test")
+    assert ds.real and len(ds) == 4
+    _, y0 = ds[0]
+    assert int(y0[0]) == truth["test"][1][0]
+
+
+def test_cifar_synthetic_fallback_warns():
+    with pytest.warns(UserWarning, match="SYNTHETIC"):
+        ds = paddle.vision.datasets.Cifar10()
+    assert not ds.real
+
+
+# ---------------------------------------------------------------------------
+# DatasetFolder with real image decode
+# ---------------------------------------------------------------------------
+
+def test_dataset_folder_mixed_formats(tmp_path):
+    rs = np.random.RandomState(3)
+    for cls in ("cat", "dog"):
+        os.makedirs(tmp_path / cls)
+    a = rs.randint(0, 256, (8, 8, 3), dtype=np.uint8)
+    b = rs.randint(0, 256, (8, 8, 3), dtype=np.uint8)
+    V.image_save(str(tmp_path / "cat" / "a.png"), a)
+    V.image_save(str(tmp_path / "dog" / "b.ppm"), b)
+    np.save(str(tmp_path / "dog" / "c.npy"), b)
+    ds = paddle.vision.datasets.DatasetFolder(str(tmp_path))
+    assert len(ds) == 3
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    img, target = ds[0]
+    np.testing.assert_array_equal(img, a)
+    assert target == 0
+    img_b, target_b = ds[1]
+    np.testing.assert_array_equal(img_b, b)
+    assert target_b == 1
+
+
+# ---------------------------------------------------------------------------
+# WAV audio
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bits", [8, 16, 32])
+def test_wav_roundtrip(tmp_path, bits):
+    rs = np.random.RandomState(bits)
+    wav = np.clip(rs.randn(2, 400) * 0.3, -1, 1).astype(np.float32)
+    p = str(tmp_path / "x.wav")
+    paddle.audio.save(p, wav, 16000, bits_per_sample=bits)
+    back, sr = paddle.audio.load(p)
+    assert sr == 16000 and back.shape == wav.shape
+    # 32-bit tolerance is float32 mantissa rounding of near-2^31 ints
+    tol = {8: 2e-2, 16: 1e-4, 32: 1e-6}[bits]
+    np.testing.assert_allclose(back, wav, atol=tol)
+
+
+def test_tess_reads_wav_dir(tmp_path):
+    t = np.arange(16000) / 16000.0
+    for i, emotion in enumerate(["angry", "happy", "sad", "neutral"]):
+        wav = np.sin(2 * np.pi * 200 * (i + 1) * t).astype(np.float32)
+        paddle.audio.save(str(tmp_path / f"OAF_word_{emotion}.wav"), wav[None], 16000)
+    ds = paddle.audio.datasets.TESS(mode="train", split=1.0, archive_path=str(tmp_path))
+    assert len(ds) == 4
+    wave0, label0 = ds[0]
+    assert wave0.shape == (16000,)
+    assert int(label0) == 0  # "angry" sorts first and maps to label_list[0]
+
+
+def test_esc50_filename_labels(tmp_path):
+    wav = np.zeros((1, 800), np.float32)
+    paddle.audio.save(str(tmp_path / "1-100032-A-14.wav"), wav, 16000)
+    paddle.audio.save(str(tmp_path / "1-100038-A-7.wav"), wav, 16000)
+    ds = paddle.audio.datasets.ESC50(mode="train", split=1.0, archive_path=str(tmp_path))
+    labels = sorted(int(ds[i][1]) for i in range(len(ds)))
+    assert labels == [7, 14]
