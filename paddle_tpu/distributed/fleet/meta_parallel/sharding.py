@@ -29,13 +29,19 @@ def _largest_divisible_dim(shape, degree):
     return best
 
 
-def shard_parameters_over(layer: Layer, degree: int, axis_name="sharding"):
-    """Annotate each parameter's largest divisible dim for ZeRO-3."""
+def shard_parameters_over(layer: Layer, degree: int, axis_name="sharding",
+                          min_numel=1):
+    """Annotate each parameter's largest divisible dim for ZeRO-3.
+
+    `min_numel` plays the reference's segment_size role
+    (group_sharded_stage3.py:59 `segment_size`, in elements here): params
+    below it stay replicated — sharding tiny tensors buys no memory and
+    costs an all-gather per use."""
     for _, p in layer.named_parameters():
         if p.sharding_axes is not None and any(a for a in p.sharding_axes):
             continue  # already TP-sharded; opt states follow param sharding
         dim = _largest_divisible_dim(p.shape, degree)
-        if dim is not None and int(np.prod(p.shape)) >= degree:
+        if dim is not None and int(np.prod(p.shape)) >= max(degree, min_numel):
             axes = [None] * len(p.shape)
             axes[dim] = axis_name
             p.sharding_axes = tuple(axes)
@@ -54,7 +60,20 @@ class DygraphShardingOptimizer:
 
 
 class GroupShardedOptimizerStage2:
+    """Stage 2 (reference group_sharded_optimizer_stage2.py:53): optimizer
+    state AND gradients sharded. The compiled step reads zero_stage=2 and
+    pins grads to the 'sharding' layout (parallel/spmd.py grad_pspec), which
+    lowers the dp grad sync to reduce-scatter."""
+
     def __init__(self, params, optim, group=None, offload=False, device="tpu", **kw):
+        if offload:
+            raise NotImplementedError(
+                "GroupShardedOptimizerStage2(offload=True): host-offloaded "
+                "optimizer state is not supported on TPU — the memory saving "
+                "comes from sharding over the 'sharding' mesh axis (grow the "
+                "axis instead); a PCIe-hosted Adam step would serialize every "
+                "update through host transfers"
+            )
         self._inner_opt = optim
         self.zero_stage = 2
 
@@ -80,14 +99,28 @@ class GroupShardedStage2(Layer):
 
 
 class GroupShardedStage3(Layer):
-    def __init__(self, layer, optimizer, group=None, sync_buffers=False, device="tpu", segment_size=2**20, pertrain_sync_models=True, offload=False, sync_comm=False, **kw):
+    def __init__(self, layer, optimizer, group=None, sync_buffers=False, device="tpu", segment_size=None, pertrain_sync_models=True, offload=False, sync_comm=False, **kw):
         super().__init__()
+        if offload:
+            raise NotImplementedError(
+                "GroupShardedStage3(offload=True): host offload is not "
+                "supported on TPU — shard over a larger 'sharding' axis "
+                "instead (see GroupShardedOptimizerStage2 for rationale)"
+            )
         self._layers = layer
         self._optimizer = optimizer
         self.zero_stage = 3
+        # segment_size (bytes in the reference, group_sharded_stage3.py:59)
+        # maps to a replicate-below threshold: sharding tiny tensors buys no
+        # memory and costs an all-gather per use. None = shard everything
+        # divisible (element threshold ~ the sharding degree). The 4-byte
+        # divisor assumes f32 params — for bf16 it errs toward replicating
+        # more small tensors, never toward OOM. sync_comm is accepted but
+        # moot: XLA schedules the just-in-time all-gathers.
         degree = self._degree(group)
+        min_numel = degree if segment_size is None else max(1, int(segment_size) // 4)
         if degree > 1:
-            shard_parameters_over(layer, degree)
+            shard_parameters_over(layer, degree, min_numel=min_numel)
 
     @staticmethod
     def _degree(group):
@@ -111,7 +144,7 @@ class GroupShardedStage3(Layer):
         return self._layers.parameters()
 
 
-def group_sharded_parallel(model, optimizer, level, scaler=None, group=None, offload=False, sync_buffers=False, buffer_max_size=2**23, segment_size=2**20, sync_comm=False):
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None, offload=False, sync_buffers=False, buffer_max_size=2**23, segment_size=None, sync_comm=False):
     """Reference distributed/sharding/group_sharded.py:37."""
     if level == "os":
         opt = DygraphShardingOptimizer(optimizer)

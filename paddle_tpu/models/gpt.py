@@ -40,6 +40,7 @@ class GPTConfig:
         attn_impl="flash",  # flash | ring | xla
         remat=False,
         dtype="float32",
+        fused_head_chunks=None,  # seq chunks for the fused CE head (None=auto)
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -51,6 +52,7 @@ class GPTConfig:
         self.attn_impl = attn_impl
         self.remat = remat
         self.dtype = dtype
+        self.fused_head_chunks = fused_head_chunks
 
 
 class CausalSelfAttention(nn.Layer):
@@ -165,7 +167,7 @@ class GPT(nn.Layer):
         # LM head is weight-tied to wte (standard GPT; the reference ties via
         # SharedLayerDesc in pp_layers)
 
-    def forward(self, input_ids, caches=None, pos_offset=0):
+    def forward(self, input_ids, caches=None, pos_offset=0, labels=None):
         b, s = input_ids.shape
         if caches is not None:
             import jax.numpy as jnp
@@ -186,6 +188,23 @@ class GPT(nn.Layer):
             else:
                 x = blk(x)
         x = self.ln_f(x)
+        if labels is not None and caches is None:
+            # fused training head: chunked linear+CE never materializes the
+            # [b, s, vocab] logits (ops/fused_ce.py) — this is the train-step
+            # path; the logits path below stays for eval/generation
+            import jax.numpy as jnp
+
+            from ..core import autograd
+            from ..ops.fused_ce import fused_linear_cross_entropy
+
+            lab = labels._array if isinstance(labels, Tensor) else jnp.asarray(labels)
+            out, node = autograd.apply(
+                lambda xa, wa: fused_linear_cross_entropy(
+                    xa, wa, lab, self.cfg.fused_head_chunks
+                ),
+                x, self.wte.weight, name="fused_linear_cross_entropy",
+            )
+            return Tensor._from_op(out, node)
         # logits = x @ wte.T  (vocab-parallel output)
         logits = M.reshape(
             F.linear(x, M.t(self.wte.weight)), [b, s, self.cfg.vocab_size]

@@ -1,0 +1,127 @@
+"""Fused (chunked) linear + softmax cross-entropy over a tied vocab head.
+
+Reference parity: the reference fuses softmax+CE in
+paddle/phi/kernels/gpu/cross_entropy_kernel.cu (softmax_with_cross_entropy)
+and caps logit memory via its fused attention/CE ops; this is the TPU-native
+generalization that also folds in the unembedding matmul.
+
+Why: for a [B, S, H] activation and a [V, H] tied embedding, materializing
+logits [B, S, V] is the single largest HBM tenant of a GPT train step
+(2.1 GB bf16 + 4.3 GB f32 cotangent at B=32, S=1024, V=32k) and is what
+knocks the step off its throughput scaling. This op scans the sequence in
+chunks: forward computes per-chunk logits -> logsumexp -> picked logit and
+keeps ONLY the [B, S] logsumexp; backward recomputes each chunk's logits
+(one extra [chunk, V] matmul — FLOPs traded for HBM, the same deal as flash
+attention) and accumulates dW in f32. Peak head memory drops from
+O(B*S*V) to O(B*S*V / n_chunks).
+
+The chunk axis is the SEQUENCE, with batch left intact, so a dp-sharded
+batch stays perfectly data-parallel under GSPMD (each scan step is a
+[B, c, H] x [H, V] matmul sharded over dp; no resharding of the scanned
+operand).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pick_chunks(B, S, V, n_chunks):
+    """Choose a sequence-chunk count: cap per-chunk f32 logits near 256 MB.
+    n_chunks None or <1 means auto."""
+    if n_chunks is not None and int(n_chunks) >= 1:
+        n = int(n_chunks)
+    else:
+        budget = 256e6
+        n = 1
+        while (B * (S // n) * V * 4 > budget and n < S and S % (n * 2) == 0):
+            n *= 2
+    while S % n:
+        n -= 1
+    return max(n, 1)
+
+
+def _chunk_logits(xc, w):
+    """[B, c, H] x [V, H] -> [B, c, V] with f32 MXU accumulation."""
+    return jax.lax.dot_general(
+        xc, w, (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ce(x, w, labels, n):
+    return _fused_ce_fwd(x, w, labels, n)[0]
+
+
+def _fused_ce_fwd(x, w, labels, n):
+    B, S, H = x.shape
+    c = S // n
+    xr = jnp.moveaxis(x.reshape(B, n, c, H), 1, 0)        # [n, B, c, H]
+    lr = jnp.moveaxis(labels.reshape(B, n, c), 1, 0)      # [n, B, c]
+
+    def f(acc, inp):
+        xc, lc = inp
+        logits = _chunk_logits(xc, w)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, lc[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        return acc + jnp.sum(lse - picked), lse
+
+    total, lses = jax.lax.scan(f, jnp.float32(0.0), (xr, lr))
+    loss = total / (B * S)
+    return loss, (x, w, labels, lses)
+
+
+def _fused_ce_bwd(n, res, g):
+    x, w, labels, lses = res
+    B, S, H = x.shape
+    V = w.shape[0]
+    c = S // n
+    xr = jnp.moveaxis(x.reshape(B, n, c, H), 1, 0)
+    lr = jnp.moveaxis(labels.reshape(B, n, c), 1, 0)
+    scale = (g / (B * S)).astype(jnp.float32)
+
+    def b(dw, inp):
+        xc, lc, lse = inp
+        logits = _chunk_logits(xc, w)
+        p = jnp.exp(logits - lse[..., None])              # stable: logits<=lse
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, p.shape, 2)
+            == lc[..., None].astype(jnp.int32)
+        )
+        ds = (p - onehot.astype(p.dtype)) * scale          # [B, c, V] f32
+        dxc = jax.lax.dot_general(                         # ds @ W -> [B, c, H]
+            ds.astype(w.dtype), w, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dw_c = jax.lax.dot_general(                        # ds^T @ x -> [V, H]
+            ds.astype(xc.dtype), xc,
+            (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dw + dw_c, dxc.astype(x.dtype)
+
+    dw, dxs = jax.lax.scan(b, jnp.zeros((V, H), jnp.float32), (xr, lr, lses))
+    dx = jnp.moveaxis(dxs, 0, 1).reshape(B, S, H)
+    dlabels = np.zeros(labels.shape, jax.dtypes.float0)    # int input: no grad
+    return dx, dw.astype(w.dtype), dlabels
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def fused_linear_cross_entropy(x, weight, labels, n_chunks=None):
+    """Mean token cross-entropy of `x @ weight.T` against `labels`, computed
+    in sequence chunks so the full [B, S, V] logits never exist in HBM.
+
+    x: [B, S, H]; weight: [V, H] (e.g. a tied wte); labels: [B, S] int.
+    n_chunks: sequence chunks (None = auto, ~256 MB f32 logits per chunk).
+    Exact same value/grads as the unfused logsumexp CE (tests assert)."""
+    B, S, H = x.shape
+    V = weight.shape[0]
+    n = _pick_chunks(B, S, V, n_chunks)
+    return _fused_ce(x, weight, labels.astype(jnp.int32), n)

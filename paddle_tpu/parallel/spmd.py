@@ -32,19 +32,33 @@ def _largest_divisible_dim(shape, degree):
     return best
 
 
+def _zero_shard_spec(shape, mesh: Mesh):
+    """The one place the ZeRO 'sharding'-axis layout is derived: shard the
+    largest divisible dim when the tensor is big enough to be worth it
+    (>= degree*128 elements). Param (stage 3), grad (stage 2) and optimizer
+    slot (stage 1) layouts all come from here so they can never diverge.
+    Returns a P spec or None."""
+    if mesh.shape.get("sharding", 1) <= 1:
+        return None
+    deg = mesh.shape["sharding"]
+    dim = _largest_divisible_dim(tuple(shape), deg)
+    if dim is None or int(np.prod(shape)) < deg * 128:
+        return None
+    spec = [None] * len(shape)
+    spec[dim] = "sharding"
+    return P(*spec)
+
+
 def param_pspec(param, mesh: Mesh, zero3=False) -> P:
     axes = getattr(param, "sharding_axes", None)
     if axes:
         spec = [a if (a and mesh.shape.get(a, 1) > 1) else None for a in axes]
         if any(spec):
             return P(*spec)
-    if zero3 and mesh.shape.get("sharding", 1) > 1:
-        deg = mesh.shape["sharding"]
-        dim = _largest_divisible_dim(tuple(param.shape), deg)
-        if dim is not None and int(np.prod(param.shape)) >= deg * 128:
-            spec = [None] * len(param.shape)
-            spec[dim] = "sharding"
-            return P(*spec)
+    if zero3:
+        spec = _zero_shard_spec(param.shape, mesh)
+        if spec is not None:
+            return spec
     return P()
 
 
@@ -62,14 +76,26 @@ def _state_spec_like(pspec: P, param_shape, slot_arr, mesh, zero_stage):
         return P()
     if any(pspec):
         return pspec
-    if zero_stage >= 1 and mesh.shape.get("sharding", 1) > 1:
-        deg = mesh.shape["sharding"]
-        dim = _largest_divisible_dim(slot_arr.shape, deg)
-        if dim is not None and int(np.prod(slot_arr.shape)) >= deg * 128:
-            spec = [None] * slot_arr.ndim
-            spec[dim] = "sharding"
-            return P(*spec)
+    if zero_stage >= 1:
+        spec = _zero_shard_spec(slot_arr.shape, mesh)
+        if spec is not None:
+            return spec
     return P()
+
+
+def grad_pspec(pspec: P, param_shape, mesh, zero_stage) -> P:
+    """Gradient sharding for ZeRO stage >= 2: grads live sharded over the
+    'sharding' axis (the reference's GroupShardedStage2 reduce-scatter,
+    group_sharded_stage2.py:46) — under GSPMD, constraining the grad to the
+    slot sharding makes XLA emit reduce-scatter instead of all-reduce and
+    keeps the full-size grad from ever materializing per device."""
+    if any(pspec):
+        return pspec  # TP-sharded grads already partial per axis
+    if zero_stage >= 2:
+        spec = _zero_shard_spec(param_shape, mesh)
+        if spec is not None:
+            return spec
+    return pspec
 
 
 def build_state_shardings(model, optimizer, mesh, zero_stage=0):
@@ -167,6 +193,22 @@ class ShardedTrainStep:
             (loss, (out, new_buf)), grads = jax.value_and_grad(
                 compute_loss, has_aux=True
             )(params)
+            if self.zero_stage >= 2:
+                # ZeRO-2: pin grads to the sharded layout so XLA lowers the
+                # dp-grad sync to reduce-scatter (each device keeps only its
+                # shard) rather than all-reduce + full-size grads
+                grads = {
+                    k: jax.lax.with_sharding_constraint(
+                        g,
+                        NamedSharding(
+                            self.mesh,
+                            grad_pspec(
+                                self.param_specs[k], g.shape, self.mesh, self.zero_stage
+                            ),
+                        ),
+                    )
+                    for k, g in grads.items()
+                }
             new_params, new_opt = optimizer.apply_gradients_arrays(
                 params, grads, opt_state, lr
             )

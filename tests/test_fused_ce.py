@@ -1,0 +1,104 @@
+"""Fused chunked linear+CE head (ops/fused_ce.py) — exactness vs the unfused
+logsumexp CE, for the op and for the GPT labels= forward path it powers.
+
+Reference parity: softmax_with_cross_entropy fusion
+(/root/reference/paddle/phi/kernels/gpu/cross_entropy_kernel.cu), extended
+TPU-side to fold the tied unembedding matmul into the chunk scan.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+
+def _ref(x, w, labels):
+    lg = jax.lax.dot_general(
+        x, w, (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    lse = jax.scipy.special.logsumexp(lg, -1)
+    picked = jnp.take_along_axis(lg, labels[..., None].astype(jnp.int32), -1)[..., 0]
+    return jnp.mean(lse - picked)
+
+
+@pytest.mark.parametrize("n_chunks", [1, 2, 4, None, 0])
+def test_fused_ce_matches_unfused(n_chunks):
+    rs = np.random.RandomState(0)
+    B, S, H, V = 2, 32, 16, 64
+    x = jnp.asarray(rs.randn(B, S, H).astype(np.float32))
+    w = jnp.asarray(rs.randn(V, H).astype(np.float32) * 0.1)
+    labels = jnp.asarray(rs.randint(0, V, (B, S)))
+
+    v, g = jax.value_and_grad(
+        lambda x, w: fused_linear_cross_entropy(x, w, labels, n_chunks), (0, 1)
+    )(x, w)
+    rv, rg = jax.value_and_grad(lambda x, w: _ref(x, w, labels), (0, 1))(x, w)
+    assert np.allclose(float(v), float(rv), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(rg[0]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(rg[1]), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ce_odd_seq_under_jit():
+    rs = np.random.RandomState(1)
+    B, S, H, V = 3, 30, 8, 32  # S=30: chunk fit must back off to a divisor
+    x = jnp.asarray(rs.randn(B, S, H).astype(np.float32))
+    w = jnp.asarray(rs.randn(V, H).astype(np.float32) * 0.1)
+    labels = jnp.asarray(rs.randint(0, V, (B, S)))
+    v = jax.jit(lambda x, w: fused_linear_cross_entropy(x, w, labels, 4))(x, w)
+    assert np.allclose(float(v), float(_ref(x, w, labels)), rtol=1e-6)
+
+
+def test_gpt_labels_path_matches_logits_path():
+    """GPT.forward(ids, labels=) (fused head, the bench train path) must give
+    the same loss AND parameter grads as gpt_loss_fn over the logits path —
+    including the weight-tied wte grad, which gets contributions from both
+    the embedding lookup and the unembed matmul."""
+    from paddle_tpu.core.functional import functional_call, state_dict_arrays
+    from paddle_tpu.models.gpt import gpt_tiny, gpt_loss_fn
+
+    paddle.seed(0)
+    m = gpt_tiny()
+    params, buffers = state_dict_arrays(m)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 1024, (2, 64)).astype(np.int32))
+    labels = jnp.asarray(rs.randint(0, 1024, (2, 64)).astype(np.int32))
+
+    def loss_fused(p):
+        out, _ = functional_call(
+            m, p, buffers, args=(ids,), kwargs={"labels": labels}, training=False
+        )
+        return out
+
+    def loss_ref(p):
+        out, _ = functional_call(m, p, buffers, args=(ids,), training=False)
+        return gpt_loss_fn(out, labels)
+
+    vf, gf = jax.value_and_grad(loss_fused)(params)
+    vr, gr = jax.value_and_grad(loss_ref)(params)
+    assert np.allclose(float(vf), float(vr), rtol=1e-5)
+    for k in gf:
+        np.testing.assert_allclose(
+            np.asarray(gf[k]), np.asarray(gr[k]), rtol=1e-4, atol=1e-5, err_msg=k
+        )
+
+
+def test_gpt_labels_path_eager_backward():
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    paddle.seed(0)
+    m = gpt_tiny()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 1024, (4, 64)))
+    labels = paddle.to_tensor(rs.randint(0, 1024, (4, 64)))
+    losses = []
+    for _ in range(4):
+        loss = m(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
