@@ -240,6 +240,51 @@ def bench_resnet50(on_tpu, errors):
 
 
 # ---------------------------------------------------------------------------
+# PP-YOLOE-s inference latency (BASELINE config 4)
+# ---------------------------------------------------------------------------
+
+def bench_ppyoloe(on_tpu, errors):
+    """Batch-1 detection latency: PP-YOLOE-s net + decode + matrix NMS as
+    ONE compiled program (the predictor's bucket machinery is exercised in
+    tests/test_detection.py; here we time the compiled detect step itself)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import autograd
+    from paddle_tpu.core.functional import state_dict_arrays, swap_state
+    from paddle_tpu.core.tensor import Tensor as _T
+    from paddle_tpu.vision.models import ppyoloe_s
+
+    paddle.seed(0)
+    side = 640 if on_tpu else 64
+    model = ppyoloe_s(num_classes=80)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    params, buffers = state_dict_arrays(model)
+
+    @jax.jit
+    def detect(params, images):
+        with autograd.trace_mode(), swap_state(model, params, buffers):
+            out, nums = model.predict(_T._from_op(images), keep_top_k=100)
+        return out._array, nums._array
+
+    rs = np.random.RandomState(0)
+    img = rs.rand(1, 3, side, side).astype(np.float32)
+    imgs = jnp.asarray(img, jnp.bfloat16 if on_tpu else jnp.float32)
+    out = detect(params, imgs)
+    jax.block_until_ready(out)
+    iters = 30 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = detect(params, imgs)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return {"latency_ms": round(dt * 1e3, 3), "image_size": side, "batch": 1}
+
+
+# ---------------------------------------------------------------------------
 # LeNet Model.fit step time (BASELINE config 0)
 # ---------------------------------------------------------------------------
 
@@ -273,7 +318,8 @@ def main():
     extras = {}
 
     gpt = bench_gpt(on_tpu, errors)
-    for name, fn in (("resnet50", bench_resnet50), ("lenet", bench_lenet)):
+    for name, fn in (("resnet50", bench_resnet50), ("lenet", bench_lenet),
+                     ("ppyoloe", bench_ppyoloe)):
         try:
             r = fn(on_tpu, errors)
             if r:

@@ -177,7 +177,11 @@ class Predictor:
         else:
             fwd = self._get_compiled(key, len(arrays))
             out = fwd(self._params, rng.next_key(), *[np.asarray(a) for a in arrays])
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        # nested model outputs (e.g. a detection head's (cls_list, reg_list))
+        # flatten to the reference's positional-output contract
+        outs = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda t: isinstance(t, Tensor)
+        )
         results = []
         for i, o in enumerate(outs):
             o = np.asarray(o)

@@ -1,0 +1,345 @@
+"""Detection op suite: matrix_nms, generate_proposals,
+distribute_fpn_proposals, box_coder, and a compiled greedy NMS.
+
+Reference parity: /root/reference/paddle/fluid/operators/detection/
+matrix_nms_op.cc, generate_proposals_op.cc (+v2), distribute_fpn_proposals_op.cc,
+box_coder_op.cc. API shapes follow python/paddle/vision/ops.py.
+
+TPU-native design: every op is compiled XLA with STATIC shapes — variable
+result counts become fixed-capacity padded arrays plus a count (invalid rows
+carry label/index -1 and zero boxes), the same contract the inference
+predictor's shape buckets use. Matrix NMS is the showcase: the reference's
+per-class loops become one vmap'd dense IoU/decay matrix computation — the
+algorithm (SOLOv2 decay) is already matrix-shaped, which is why PP-YOLOE
+uses it over greedy NMS; it maps onto the MXU with no sequential loop at
+all. Greedy NMS (RPN path) is a lax.fori_loop over selections — O(k·n) but
+compiled, no host sync.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _T(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _pairwise_iou(boxes, normalized=True):
+    """[n,4] x1y1x2y2 -> [n,n] IoU. normalized=False adds the +1 pixel
+    convention (reference matrix_nms_op.cc JaccardOverlap)."""
+    off = 0.0 if normalized else 1.0
+    area = (boxes[:, 2] - boxes[:, 0] + off) * (boxes[:, 3] - boxes[:, 1] + off)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.clip(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+# ---------------------------------------------------------------------------
+# greedy NMS (compiled, padded)
+# ---------------------------------------------------------------------------
+
+def nms_padded_array(boxes, scores, iou_threshold, max_out, score_threshold=None):
+    """Greedy hard-NMS entirely under XLA: no data-dependent shapes.
+
+    boxes [n,4], scores [n] -> (keep_idx [max_out] int32, -1 padded;
+    num_kept scalar). Scores <= score_threshold (if given) are never kept."""
+    n = boxes.shape[0]
+    iou = _pairwise_iou(boxes)
+    valid0 = jnp.ones(n, bool) if score_threshold is None else scores > score_threshold
+
+    def body(state, _):
+        valid, = state
+        masked = jnp.where(valid, scores, -jnp.inf)
+        i = jnp.argmax(masked)
+        ok = masked[i] > -jnp.inf
+        # suppress the pick and everything overlapping it
+        valid = valid & (iou[i] <= iou_threshold)
+        valid = valid.at[i].set(False)
+        return (valid,), jnp.where(ok, i.astype(jnp.int32), -1)
+
+    (_,), keep = jax.lax.scan(body, (valid0,), None, length=max_out)
+    return keep, jnp.sum(keep >= 0).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# matrix NMS
+# ---------------------------------------------------------------------------
+
+def _matrix_nms_single(bboxes, scores, score_threshold, post_threshold,
+                       nms_top_k, keep_top_k, use_gaussian, gaussian_sigma,
+                       background_label, normalized):
+    """One image: bboxes [M,4], scores [C,M] ->
+    (out [keep_top_k,6], index [keep_top_k], count)."""
+    C, M = scores.shape
+    k = min(int(nms_top_k), M) if nms_top_k > 0 else M
+
+    def per_class(cls_scores):
+        s = jnp.where(cls_scores > score_threshold, cls_scores, -jnp.inf)
+        topv, topi = jax.lax.top_k(s, k)
+        sel = topv > -jnp.inf
+        b = bboxes[topi]
+        iou = _pairwise_iou(b, normalized)
+        tri = jnp.tril(jnp.ones((k, k), bool), -1).T  # [j,i] True iff j<i
+        iou_u = jnp.where(tri, iou, 0.0)
+        comp = jnp.max(iou_u, axis=0)  # compensate IoU per box (as column i)
+        if use_gaussian:
+            # reference matrix_nms kernel: exp((max_iou^2 - iou^2) * sigma)
+            decay_m = jnp.exp((comp[:, None] ** 2 - iou_u ** 2) * gaussian_sigma)
+        else:
+            decay_m = (1.0 - iou_u) / jnp.maximum(1.0 - comp[:, None], 1e-10)
+        decay = jnp.min(jnp.where(tri, decay_m, 1.0), axis=0)
+        dscore = jnp.where(sel, topv * decay, -jnp.inf)
+        return dscore, topi, b
+
+    cls_ids = jnp.arange(C)
+    dscores, idxs, boxes_c = jax.vmap(lambda c: per_class(scores[c]))(cls_ids)
+    # drop background class by zeroing its scores
+    if background_label >= 0:
+        dscores = jnp.where(cls_ids[:, None] == background_label, -jnp.inf, dscores)
+    flat_s = dscores.reshape(-1)
+    flat_s = jnp.where(flat_s > post_threshold, flat_s, -jnp.inf)
+    kk = min(int(keep_top_k), flat_s.shape[0]) if keep_top_k > 0 else flat_s.shape[0]
+    topv, flat_i = jax.lax.top_k(flat_s, kk)
+    sel = topv > -jnp.inf
+    ci = flat_i // k
+    pi = flat_i % k
+    box = boxes_c[ci, pi]
+    orig = idxs[ci, pi]
+    out = jnp.concatenate(
+        [
+            jnp.where(sel, ci, -1)[:, None].astype(bboxes.dtype),
+            jnp.where(sel, topv, 0.0)[:, None].astype(bboxes.dtype),
+            jnp.where(sel[:, None], box, 0.0),
+        ],
+        axis=1,
+    )
+    index = jnp.where(sel, orig, -1).astype(jnp.int32)
+    return out, index, jnp.sum(sel).astype(jnp.int32)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Decay-based NMS (reference matrix_nms_op.cc; SOLOv2 alg.).
+
+    bboxes [N,M,4], scores [N,C,M]. Returns padded fixed shapes:
+    out [N*keep_top_k, 6] (label,score,x1,y1,x2,y2; label -1 = pad),
+    optional index [N*keep_top_k], rois_num [N]."""
+    b = _T(bboxes)._array
+    s = _T(scores)._array
+    fn = functools.partial(
+        _matrix_nms_single,
+        score_threshold=float(score_threshold),
+        post_threshold=float(post_threshold),
+        nms_top_k=int(nms_top_k), keep_top_k=int(keep_top_k),
+        use_gaussian=bool(use_gaussian), gaussian_sigma=float(gaussian_sigma),
+        background_label=int(background_label), normalized=bool(normalized),
+    )
+    out, index, nums = jax.vmap(fn)(b, s)
+    out2 = out.reshape(-1, 6)
+    res = [Tensor._from_op(out2)]
+    if return_index:
+        res.append(Tensor._from_op(index.reshape(-1)))
+    if return_rois_num:
+        res.append(Tensor._from_op(nums))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+# ---------------------------------------------------------------------------
+# box coder
+# ---------------------------------------------------------------------------
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder_op.cc).
+
+    encode: target [T,4] vs priors [P,4] -> [T,P,4] deltas.
+    decode: deltas [T,P,4] (or [T,4] with axis semantics) -> boxes."""
+    pb = _T(prior_box)._array
+    tb = _T(target_box)._array
+    pv = None if prior_box_var is None else jnp.asarray(
+        prior_box_var if not isinstance(prior_box_var, Tensor) else prior_box_var._array
+    )
+    off = 0.0 if box_normalized else 1.0
+
+    pw = pb[:, 2] - pb[:, 0] + off
+    ph = pb[:, 3] - pb[:, 1] + off
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + off
+        th = tb[:, 3] - tb[:, 1] + off
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pv is not None:
+            out = out / (pv if pv.ndim == 1 else pv[None, :, :])
+        return Tensor._from_op(out)
+    if code_type == "decode_center_size":
+        if tb.ndim == 2:
+            # [T,4] deltas pair row t with prior t (requires T == P)
+            d = tb * pv if pv is not None else tb
+            cx = d[:, 0] * pw + pcx
+            cy = d[:, 1] * ph + pcy
+            w = jnp.exp(d[:, 2]) * pw
+            h = jnp.exp(d[:, 3]) * ph
+            out = jnp.stack(
+                [cx - w * 0.5, cy - h * 0.5, cx + w * 0.5 - off, cy + h * 0.5 - off],
+                axis=-1,
+            )
+            return Tensor._from_op(out)
+        d = tb
+        if pv is not None:
+            d = d * (pv if pv.ndim == 1 else pv[None] if pv.ndim == 2 else pv)
+        if axis == 0:
+            cx = d[..., 0] * pw[None, :] + pcx[None, :]
+            cy = d[..., 1] * ph[None, :] + pcy[None, :]
+            w = jnp.exp(d[..., 2]) * pw[None, :]
+            h = jnp.exp(d[..., 3]) * ph[None, :]
+        else:
+            cx = d[..., 0] * pw[:, None] + pcx[:, None]
+            cy = d[..., 1] * ph[:, None] + pcy[:, None]
+            w = jnp.exp(d[..., 2]) * pw[:, None]
+            h = jnp.exp(d[..., 3]) * ph[:, None]
+        out = jnp.stack(
+            [cx - w * 0.5, cy - h * 0.5, cx + w * 0.5 - off, cy + h * 0.5 - off],
+            axis=-1,
+        )
+        return Tensor._from_op(out)
+    raise ValueError(f"unknown code_type {code_type}")
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals (RPN)
+# ---------------------------------------------------------------------------
+
+def _decode_rpn(anchors, deltas, variances):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    d = deltas * variances if variances is not None else deltas
+    cx = d[:, 0] * aw + acx
+    cy = d[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5, cx + w * 0.5, cy + h * 0.5], 1)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=True, name=None):
+    """RPN proposal generation (reference generate_proposals_op.cc /
+    generate_proposals_v2): decode top-scoring anchor deltas, clip to the
+    image, drop degenerate boxes, greedy-NMS, pad to post_nms_top_n.
+
+    scores [N,A,H,W], bbox_deltas [N,4A,H,W], img_size [N,2] (h,w),
+    anchors [H,W,A,4] (or [HWA,4]), variances like anchors.
+    Returns rois [N*post_nms_top_n, 4] (zero-padded), optional
+    rois_num [N]. eta (adaptive NMS) accepted for parity; only eta=1.0
+    semantics are implemented (constant threshold)."""
+    s = _T(scores)._array
+    d = _T(bbox_deltas)._array
+    im = _T(img_size)._array
+    a = _T(anchors)._array.reshape(-1, 4)
+    v = _T(variances)._array.reshape(-1, 4) if variances is not None else None
+
+    N, A, H, W = s.shape
+    k_pre = min(int(pre_nms_top_n), A * H * W)
+    k_post = int(post_nms_top_n)
+    off = 1.0 if pixel_offset else 0.0
+
+    def per_image(si, di, imi):
+        flat = si.transpose(1, 2, 0).reshape(-1)          # HWA order = anchors
+        dm = di.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        topv, topi = jax.lax.top_k(flat, k_pre)
+        boxes = _decode_rpn(a[topi], dm[topi], None if v is None else v[topi])
+        h_img, w_img = imi[0], imi[1]
+        boxes = jnp.stack(
+            [
+                jnp.clip(boxes[:, 0], 0, w_img - off),
+                jnp.clip(boxes[:, 1], 0, h_img - off),
+                jnp.clip(boxes[:, 2], 0, w_img - off),
+                jnp.clip(boxes[:, 3], 0, h_img - off),
+            ],
+            1,
+        )
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        keep_sz = (ws >= min_size) & (hs >= min_size)
+        sc = jnp.where(keep_sz, topv, -jnp.inf)
+        keep, num = nms_padded_array(boxes, sc, nms_thresh, k_post)
+        sel = keep >= 0
+        rois = jnp.where(sel[:, None], boxes[jnp.maximum(keep, 0)], 0.0)
+        return rois, num
+
+    rois, nums = jax.vmap(per_image)(s, d, im)
+    res = [Tensor._from_op(rois.reshape(-1, 4))]
+    if return_rois_num:
+        res.append(Tensor._from_op(nums))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+# ---------------------------------------------------------------------------
+# distribute_fpn_proposals
+# ---------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals_op.cc): level = floor(log2(sqrt(area)/refer_scale
+    + eps)) + refer_level, clipped to [min_level, max_level].
+
+    Returns (multi_rois, restore_ind, rois_num_per_level):
+    multi_rois — one [R,4] zero-padded array per level (valid rows first);
+    restore_ind [R,1] maps concat(multi_rois valid rows) back to input order;
+    rois_num_per_level — [R]-capacity counts per level."""
+    r = _T(fpn_rois)._array
+    R = r.shape[0]
+    n_levels = int(max_level) - int(min_level) + 1
+    off = 1.0 if pixel_offset else 0.0
+    w = r[:, 2] - r[:, 0] + off
+    h = r[:, 3] - r[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(w * h, 0.0))
+    lvl = jnp.floor(jnp.log2(scale / float(refer_scale) + 1e-8)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32) - int(min_level)
+
+    multi = []
+    nums = []
+    pos_in_level = []
+    for li in range(n_levels):
+        mask = lvl == li
+        # stable partition: valid rows first, original order preserved
+        order = jnp.argsort(jnp.where(mask, 0, 1), stable=True)
+        rois_l = jnp.where(mask[order][:, None], r[order], 0.0)
+        multi.append(Tensor._from_op(rois_l))
+        nums.append(jnp.sum(mask).astype(jnp.int32))
+        pos_in_level.append(jnp.cumsum(mask.astype(jnp.int32)) - 1)
+    nums_arr = jnp.stack(nums)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(nums_arr)[:-1]])
+    # restore_ind[j] = position of input roi j in the level-concat, so
+    # gather(concat_rois, restore_ind) recovers the input order (the
+    # reference RestoreIndex contract)
+    pos = jnp.stack(pos_in_level)                       # [L, R]
+    out_pos = (pos[lvl, jnp.arange(R)] + starts[lvl]).astype(jnp.int32)
+    return (
+        multi,
+        Tensor._from_op(out_pos[:, None]),
+        Tensor._from_op(nums_arr),
+    )

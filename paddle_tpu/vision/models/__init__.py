@@ -13,3 +13,4 @@ from .densenet import DenseNet, densenet121, densenet161, densenet169, densenet2
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .shufflenet import ShuffleNetV2, shufflenet_v2_x1_0  # noqa: F401
 from .inception import InceptionV3, inception_v3  # noqa: F401
+from .ppyoloe import PPYOLOE, ppyoloe_s, ppyoloe_m, ppyoloe_l  # noqa: F401

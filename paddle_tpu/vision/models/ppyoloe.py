@@ -1,0 +1,367 @@
+"""PP-YOLOE-class anchor-free detector (backbone CSPRepResNet + CSPPAN neck +
+ET-head with DFL box regression, matrix-NMS postprocess).
+
+Reference parity: the PP-YOLOE architecture served by the reference's
+inference stack (BASELINE config 4: dynamic-shape AnalysisPredictor latency;
+ops matrix_nms_op.cc / the detection suite in
+/root/reference/paddle/fluid/operators/detection/). The model definition
+itself lives in the PaddleDetection model zoo, not the core repo — this is a
+faithful compact re-implementation of its published architecture (RepVGG
+blocks, effective-SE, SPP in the neck, distribution focal regression),
+TPU-first: static shapes end to end, decode + matrix NMS compiled into the
+same XLA program as the network, variable image sizes handled by the
+predictor's shape buckets rather than dynamic shapes.
+
+Scope note: this is the inference vertical (the BASELINE config). Training
+utilities stop at a simple per-grid-cell assignment loss (`simple_loss`) —
+the full task-aligned assigner (TAL) of the paper is not implemented.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+
+_WIDTHS = {"s": 0.50, "m": 0.75, "l": 1.0, "x": 1.25}
+_DEPTHS = {"s": 0.33, "m": 0.67, "l": 1.0, "x": 1.33}
+
+
+def _ch(c, w):
+    return max(8, int(round(c * w / 8)) * 8)
+
+
+class ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, k=3, stride=1, groups=1, act=True):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride, padding=(k - 1) // 2,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = nn.Swish() if act else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class RepVGGBlock(nn.Layer):
+    """3x3 + 1x1 parallel branches (train form). Deploy-fusion is a weight
+    transform, not a different graph — XLA fuses the adds anyway."""
+
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.conv3 = ConvBNAct(cin, cout, 3, act=False)
+        self.conv1 = ConvBNAct(cin, cout, 1, act=False)
+        self.act = nn.Swish()
+
+    def forward(self, x):
+        return self.act(self.conv3(x) + self.conv1(x))
+
+
+class EffectiveSE(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.fc = nn.Conv2D(ch, ch, 1)
+        self.sig = nn.Sigmoid()
+
+    def forward(self, x):
+        from ... import ops as P
+
+        s = P.mean(x, axis=[2, 3], keepdim=True)
+        return x * self.sig(self.fc(s))
+
+
+class CSPResStage(nn.Layer):
+    def __init__(self, cin, cout, n_blocks, stride=2):
+        super().__init__()
+        self.down = ConvBNAct(cin, cin, 3, stride=stride) if stride > 1 else None
+        mid = cout // 2
+        self.conv1 = ConvBNAct(cin, mid, 1)
+        self.conv2 = ConvBNAct(cin, mid, 1)
+        self.blocks = nn.LayerList([RepVGGBlock(mid, mid) for _ in range(n_blocks)])
+        self.attn = EffectiveSE(mid * 2)
+        self.conv3 = ConvBNAct(mid * 2, cout, 1)
+
+    def forward(self, x):
+        if self.down is not None:
+            x = self.down(x)
+        from ... import ops as P
+
+        y1 = self.conv1(x)
+        y2 = self.conv2(x)
+        for b in self.blocks:
+            y2 = b(y2)
+        y = self.attn(P.concat([y1, y2], axis=1))
+        return self.conv3(y)
+
+
+class CSPRepResNet(nn.Layer):
+    def __init__(self, scale="s"):
+        super().__init__()
+        w, d = _WIDTHS[scale], _DEPTHS[scale]
+        chs = [_ch(c, w) for c in (64, 128, 256, 512, 1024)]
+        depths = [max(1, round(n * d)) for n in (3, 6, 6, 3)]
+        self.stem = nn.Sequential(
+            ConvBNAct(3, chs[0] // 2, 3, stride=2),
+            ConvBNAct(chs[0] // 2, chs[0], 3, stride=2),
+        )
+        self.stages = nn.LayerList(
+            [
+                CSPResStage(chs[i], chs[i + 1], depths[i], stride=2 if i else 1)
+                for i in range(4)
+            ]
+        )
+        self.out_channels = chs[2:]  # C3, C4, C5
+
+    def forward(self, x):
+        x = self.stem(x)
+        outs = []
+        for i, st in enumerate(self.stages):
+            x = st(x)
+            if i >= 1:
+                outs.append(x)
+        return outs  # strides 8, 16, 32
+
+
+class SPP(nn.Layer):
+    def __init__(self, cin, cout, sizes=(5, 9, 13)):
+        super().__init__()
+        self.pools = nn.LayerList(
+            [nn.MaxPool2D(k, stride=1, padding=k // 2) for k in sizes]
+        )
+        self.conv = ConvBNAct(cin * (len(sizes) + 1), cout, 1)
+
+    def forward(self, x):
+        from ... import ops as P
+
+        return self.conv(P.concat([x] + [p(x) for p in self.pools], axis=1))
+
+
+class CSPPANStage(nn.Layer):
+    def __init__(self, cin, cout, n_blocks=1, spp=False):
+        super().__init__()
+        mid = cout // 2
+        self.conv1 = ConvBNAct(cin, mid, 1)
+        self.conv2 = ConvBNAct(cin, mid, 1)
+        body = [RepVGGBlock(mid, mid) for _ in range(n_blocks)]
+        if spp:
+            body.insert(len(body) // 2, SPP(mid, mid))
+        self.blocks = nn.LayerList(body)
+        self.conv3 = ConvBNAct(mid * 2, cout, 1)
+
+    def forward(self, x):
+        from ... import ops as P
+
+        y1 = self.conv1(x)
+        y2 = self.conv2(x)
+        for b in self.blocks:
+            y2 = b(y2)
+        return self.conv3(P.concat([y1, y2], axis=1))
+
+
+class CSPPAN(nn.Layer):
+    """Top-down + bottom-up feature pyramid (CustomCSPPAN)."""
+
+    def __init__(self, in_channels, scale="s"):
+        super().__init__()
+        d = max(1, round(3 * _DEPTHS[scale]))
+        c3, c4, c5 = in_channels
+        self.reduce5 = CSPPANStage(c5, c5, d, spp=True)
+        self.lat5 = ConvBNAct(c5, c4, 1)
+        self.td4 = CSPPANStage(c4 * 2, c4, d)
+        self.lat4 = ConvBNAct(c4, c3, 1)
+        self.td3 = CSPPANStage(c3 * 2, c3, d)
+        self.down3 = ConvBNAct(c3, c3, 3, stride=2)
+        self.bu4 = CSPPANStage(c3 + c4, c4, d)
+        self.down4 = ConvBNAct(c4, c4, 3, stride=2)
+        self.bu5 = CSPPANStage(c4 + c5, c5, d)
+        self.out_channels = (c3, c4, c5)
+
+    def forward(self, feats):
+        from ... import ops as P
+        from ...nn import functional as F
+
+        c3, c4, c5 = feats
+        p5 = self.reduce5(c5)
+        u5 = F.interpolate(self.lat5(p5), scale_factor=2, mode="nearest")
+        p4 = self.td4(P.concat([u5, c4], axis=1))
+        u4 = F.interpolate(self.lat4(p4), scale_factor=2, mode="nearest")
+        p3 = self.td3(P.concat([u4, c3], axis=1))
+        n4 = self.bu4(P.concat([self.down3(p3), p4], axis=1))
+        n5 = self.bu5(P.concat([self.down4(n4), p5], axis=1))
+        return [p3, n4, n5]
+
+
+class ETHead(nn.Layer):
+    """Efficient task-aligned head: per-level cls + DFL box branches."""
+
+    def __init__(self, in_channels, num_classes=80, reg_max=16):
+        super().__init__()
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+        self.stem_cls = nn.LayerList([ConvBNAct(c, c, 1) for c in in_channels])
+        self.stem_reg = nn.LayerList([ConvBNAct(c, c, 1) for c in in_channels])
+        self.pred_cls = nn.LayerList(
+            [nn.Conv2D(c, num_classes, 3, padding=1) for c in in_channels]
+        )
+        self.pred_reg = nn.LayerList(
+            [nn.Conv2D(c, 4 * (reg_max + 1), 3, padding=1) for c in in_channels]
+        )
+
+    def forward(self, feats):
+        cls_logits, reg_dists = [], []
+        for i, f in enumerate(feats):
+            cls_logits.append(self.pred_cls[i](self.stem_cls[i](f) + f))
+            reg_dists.append(self.pred_reg[i](self.stem_reg[i](f) + f))
+        return cls_logits, reg_dists
+
+
+class PPYOLOE(nn.Layer):
+    """End-to-end detector; forward returns raw per-level heads (training
+    form); `decode`/`predict` produce final padded detections."""
+
+    strides = (8, 16, 32)
+
+    def __init__(self, scale="s", num_classes=80, reg_max=16):
+        super().__init__()
+        self.backbone = CSPRepResNet(scale)
+        self.neck = CSPPAN(self.backbone.out_channels, scale)
+        self.head = ETHead(self.neck.out_channels, num_classes, reg_max)
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+
+    def forward(self, images):
+        feats = self.neck(self.backbone(images))
+        return self.head(feats)
+
+    # ---- decode (pure jnp; compiled with the net by the predictor) -------
+    def _decode_arrays(self, cls_logits, reg_dists, img_hw):
+        import jax
+        import jax.numpy as jnp
+
+        rm = self.reg_max
+        all_scores, all_boxes = [], []
+        for lvl, (cl, rd) in enumerate(zip(cls_logits, reg_dists)):
+            s = self.strides[lvl]
+            b, nc, h, w = cl.shape
+            scores = jax.nn.sigmoid(
+                jnp.transpose(cl, (0, 2, 3, 1)).reshape(b, h * w, nc)
+            )
+            dist = jnp.transpose(rd, (0, 2, 3, 1)).reshape(b, h * w, 4, rm + 1)
+            # DFL expectation over the discretized distance distribution
+            proj = jnp.arange(rm + 1, dtype=jnp.float32)
+            ltrb = jnp.sum(jax.nn.softmax(dist, -1) * proj, -1) * s
+            cx = (jnp.arange(w, dtype=jnp.float32) + 0.5) * s
+            cy = (jnp.arange(h, dtype=jnp.float32) + 0.5) * s
+            gx, gy = jnp.meshgrid(cx, cy)
+            centers = jnp.stack([gx.reshape(-1), gy.reshape(-1)], -1)  # [hw,2]
+            boxes = jnp.concatenate(
+                [centers[None] - ltrb[..., :2], centers[None] + ltrb[..., 2:]],
+                axis=-1,
+            )
+            h_img, w_img = img_hw
+            boxes = jnp.stack(
+                [
+                    jnp.clip(boxes[..., 0], 0, w_img),
+                    jnp.clip(boxes[..., 1], 0, h_img),
+                    jnp.clip(boxes[..., 2], 0, w_img),
+                    jnp.clip(boxes[..., 3], 0, h_img),
+                ],
+                -1,
+            )
+            all_scores.append(scores)
+            all_boxes.append(boxes)
+        return jnp.concatenate(all_boxes, 1), jnp.concatenate(all_scores, 1)
+
+    def predict(self, images, score_threshold=0.01, nms_threshold=0.6,
+                keep_top_k=100, nms_top_k=1000):
+        """images [N,3,H,W] -> (dets [N*keep_top_k, 6], nums [N]); matrix NMS
+        (the PP-YOLOE deploy config) fully inside the compiled program."""
+        from ..detection_ops import matrix_nms
+
+        images_t = images if isinstance(images, Tensor) else Tensor(np.asarray(images))
+        cls_logits, reg_dists = self.forward(images_t)
+        h, w = images_t.shape[2], images_t.shape[3]
+        boxes, scores = self._decode_arrays(
+            [c._array for c in cls_logits], [r._array for r in reg_dists], (h, w)
+        )
+        import jax.numpy as jnp
+
+        out, nums = matrix_nms(
+            Tensor._from_op(boxes),
+            Tensor._from_op(jnp.transpose(scores, (0, 2, 1))),
+            score_threshold, score_threshold, nms_top_k, keep_top_k,
+            use_gaussian=True, background_label=-1,
+        )
+        return out, nums
+
+    # ---- simplified training loss ----------------------------------------
+    def simple_loss(self, cls_logits, reg_dists, gt_boxes, gt_labels):
+        """Per-grid-cell assignment loss (BCE cls + DFL reg at the cell
+        containing each GT center). NOT the paper's TAL assigner — enough to
+        verify end-to-end gradient flow and overfit tiny datasets."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...core import autograd
+
+        rm = self.reg_max
+        strides = self.strides
+        gt = gt_boxes._array if isinstance(gt_boxes, Tensor) else jnp.asarray(gt_boxes)
+        gl = gt_labels._array if isinstance(gt_labels, Tensor) else jnp.asarray(gt_labels)
+        n_levels = len(cls_logits)
+
+        def fn(*arrays):
+            total = jnp.float32(0.0)
+            for lvl in range(n_levels):
+                cl = arrays[lvl]
+                rd = arrays[n_levels + lvl]
+                s = strides[lvl]
+                b, nc, h, w = cl.shape
+                cxy = (gt[..., :2] + gt[..., 2:]) / 2.0
+                gx = jnp.clip((cxy[..., 0] / s).astype(jnp.int32), 0, w - 1)
+                gy = jnp.clip((cxy[..., 1] / s).astype(jnp.int32), 0, h - 1)
+                tgt = jnp.zeros((b, nc, h, w))
+                bi = jnp.arange(b)[:, None] * jnp.ones_like(gx)
+                tgt = tgt.at[bi, gl, gy, gx].set(1.0)
+                cl32 = cl.astype(jnp.float32)
+                total = total + jnp.mean(
+                    jnp.maximum(cl32, 0) - cl32 * tgt
+                    + jnp.log1p(jnp.exp(-jnp.abs(cl32)))
+                )
+                # DFL at assigned cells toward the (clipped) ltrb targets
+                cell_cx = (gx.astype(jnp.float32) + 0.5) * s
+                cell_cy = (gy.astype(jnp.float32) + 0.5) * s
+                ltrb = jnp.stack(
+                    [cell_cx - gt[..., 0], cell_cy - gt[..., 1],
+                     gt[..., 2] - cell_cx, gt[..., 3] - cell_cy], -1
+                ) / s
+                ltrb = jnp.clip(ltrb, 0, rm - 0.01)
+                rd_r = jnp.transpose(rd, (0, 2, 3, 1)).reshape(b, h, w, 4, rm + 1)
+                logits = rd_r[bi, gy, gx].astype(jnp.float32)  # [b, G, 4, rm+1]
+                lo = jnp.floor(ltrb)
+                hi = lo + 1
+                wlo = hi - ltrb
+                logp = jax.nn.log_softmax(logits, -1)
+                pick = lambda idx: jnp.take_along_axis(
+                    logp, idx[..., None].astype(jnp.int32), -1
+                )[..., 0]
+                total = total - jnp.mean(wlo * pick(lo) + (1 - wlo) * pick(hi))
+            return total
+
+        tensors = [t if isinstance(t, Tensor) else Tensor._from_op(t)
+                   for t in list(cls_logits) + list(reg_dists)]
+        out, node = autograd.apply(fn, *tensors, name="ppyoloe_simple_loss")
+        return Tensor._from_op(out, node)
+
+
+def ppyoloe_s(**kw):
+    return PPYOLOE("s", **kw)
+
+
+def ppyoloe_m(**kw):
+    return PPYOLOE("m", **kw)
+
+
+def ppyoloe_l(**kw):
+    return PPYOLOE("l", **kw)

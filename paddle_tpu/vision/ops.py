@@ -312,3 +312,12 @@ class DeformConv2D(_Layer):
             x, offset, self.weight, self.bias, self.stride, self.padding,
             self.dilation, self.deformable_groups, self.groups, mask,
         )
+
+
+from .detection_ops import (  # noqa: E402,F401 — detection suite lives in its own module
+    box_coder,
+    distribute_fpn_proposals,
+    generate_proposals,
+    matrix_nms,
+    nms_padded_array,
+)
