@@ -310,22 +310,67 @@ def bench_lenet(on_tpu, errors):
     return {"step_ms": round(dt * 1e3, 3), "batch": 64}
 
 
-def main():
+_BENCHES = {
+    "gpt": lambda on_tpu, errors: bench_gpt(on_tpu, errors),
+    "resnet50": lambda on_tpu, errors: bench_resnet50(on_tpu, errors),
+    "lenet": lambda on_tpu, errors: bench_lenet(on_tpu, errors),
+    "ppyoloe": lambda on_tpu, errors: bench_ppyoloe(on_tpu, errors),
+}
+
+
+def _child(name):
+    """Run ONE benchmark and print its JSON on the last line."""
     import jax
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
     errors = []
-    extras = {}
+    try:
+        result = _BENCHES[name](on_tpu, errors)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"{name}: {type(e).__name__}: {str(e)[:300]}")
+        result = None
+    print(json.dumps({"result": result, "errors": errors}))
+    return 0
 
-    gpt = bench_gpt(on_tpu, errors)
-    for name, fn in (("resnet50", bench_resnet50), ("lenet", bench_lenet),
-                     ("ppyoloe", bench_ppyoloe)):
-        try:
-            r = fn(on_tpu, errors)
-            if r:
-                extras[name] = r
-        except Exception as e:  # noqa: BLE001
-            errors.append(f"{name}: {type(e).__name__}: {str(e)[:300]}")
+
+def _run_isolated(name, timeout_s=2400):
+    """Each benchmark gets its own process: device memory fully released
+    between benches, and one bench's OOM cannot poison the next (an
+    in-process OOM leaves the PjRt allocator poisoned for later benches)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, name],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"result": None,
+                "errors": [f"{name}: no output (rc={proc.returncode}) "
+                           f"{proc.stderr[-200:]}"]}
+    except subprocess.TimeoutExpired:
+        return {"result": None, "errors": [f"{name}: timed out after {timeout_s}s"]}
+    except Exception as e:  # noqa: BLE001
+        return {"result": None, "errors": [f"{name}: {type(e).__name__}: {e}"]}
+
+
+def main():
+    if len(sys.argv) > 1:
+        return _child(sys.argv[1])
+
+    errors = []
+    extras = {}
+    gpt = None
+    for name in ("gpt", "resnet50", "lenet", "ppyoloe"):
+        r = _run_isolated(name)
+        errors.extend(r.get("errors") or [])
+        if name == "gpt":
+            gpt = r.get("result")
+        elif r.get("result"):
+            extras[name] = r["result"]
 
     if gpt is None:
         print(json.dumps({

@@ -325,12 +325,102 @@ class HybridParallelOptimizer:
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        # strategy-driven meta-optimizers (reference fleet meta_optimizers):
+        # lars swaps the update rule; gradient_merge accumulates k steps of
+        # grads before one inner step; localsgd averages params over dp
+        # every k steps instead of syncing grads every step.
+        self._gm_k = 1
+        self._gm_avg = True
+        self._gm_count = 0
+        self._gm_accum = {}
+        self._local_k = 1
+        self._local_count = 0
+        if strategy is not None:
+            if getattr(strategy, "lars", False):
+                self._inner_opt = self._to_lars(optimizer, strategy)
+            if getattr(strategy, "gradient_merge", False):
+                cfg = getattr(strategy, "gradient_merge_configs", {})
+                self._gm_k = int(cfg.get("k_steps", 1))
+                self._gm_avg = bool(cfg.get("avg", True))
+            if getattr(strategy, "localsgd", False):
+                cfg = getattr(strategy, "localsgd_configs", {"k_steps": 1})
+                self._local_k = int(cfg.get("k_steps", 1))
+                self._local_begin = int(cfg.get("begin_step", 1))
+
+    @staticmethod
+    def _to_lars(optimizer, strategy):
+        """Reference LarsOptimizer meta (lars_optimizer.py:23) applies to
+        Momentum only; other optimizers pass through unchanged."""
+        from ....optimizer import Lars, Momentum
+
+        if not isinstance(optimizer, Momentum):
+            return optimizer
+        cfg = getattr(strategy, "lars_configs", {})
+        wd = optimizer._wd_coeff()
+        return Lars(
+            learning_rate=optimizer._learning_rate,
+            momentum=optimizer._momentum,
+            lars_coeff=float(cfg.get("lars_coeff", 0.001)),
+            # the inner Momentum's own weight_decay carries into LARS when the
+            # strategy doesn't set one (reference passes regularization thru)
+            lars_weight_decay=float(cfg.get("lars_weight_decay", wd or 0.0005)),
+            epsilon=float(cfg.get("epsilon", 0.0)),
+            exclude_from_weight_decay=cfg.get("exclude_from_weight_decay", ()),
+            use_nesterov=optimizer._use_nesterov,
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip,
+        )
 
     def __getattr__(self, name):
         return getattr(self._inner_opt, name)
 
     def step(self):
+        if self._gm_k > 1 and not self._gm_merge_step():
+            return
         self._inner_opt.step()
+        self._localsgd_sync()
+
+    def _gm_merge_step(self):
+        """Accumulate grads; True only on the k-th call (when the inner step
+        must run on the merged grads). Reference
+        gradient_merge_optimizer.py:21 / GradientMergeOptimizer semantics."""
+        import jax.numpy as jnp
+
+        self._gm_count += 1
+        for p in self._inner_opt._params:
+            if p.stop_gradient or p._grad is None:
+                continue
+            acc = self._gm_accum.get(id(p))
+            g = p._grad  # raw jax array (Tensor._grad storage convention)
+            self._gm_accum[id(p)] = g if acc is None else acc + g
+        if self._gm_count % self._gm_k != 0:
+            self._inner_opt.clear_grad()
+            return False
+        scale = 1.0 / self._gm_k if self._gm_avg else 1.0
+        for p in self._inner_opt._params:
+            acc = self._gm_accum.get(id(p))
+            if acc is not None:
+                p._grad = acc * jnp.asarray(scale, acc.dtype)
+        self._gm_accum = {}
+        return True
+
+    def _localsgd_sync(self):
+        """Average params across the dp group every k inner steps (reference
+        localsgd_optimizer.py:28). Before begin_step, sync EVERY step (the
+        reference's sync-SGD warmup). With world_size 1 this is a no-op."""
+        if self._local_k <= 1:
+            return
+        self._local_count += 1
+        in_warmup = self._local_count < getattr(self, "_local_begin", 1)
+        if not in_warmup and self._local_count % self._local_k:
+            return
+        from ... import collective as dist
+
+        if dist.get_world_size() <= 1:
+            return
+        for p in self._inner_opt._params:
+            dist.all_reduce(p)
+            p._array = p._array / dist.get_world_size()
 
     def clear_grad(self, *a, **k):
         self._inner_opt.clear_grad(*a, **k)

@@ -47,8 +47,11 @@ class DistributedStrategy:
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
         self.lamb = False
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                             "epsilon": 0.0}
         self.dgc = False
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

@@ -189,20 +189,41 @@ class GPT(nn.Layer):
                 x = blk(x)
         x = self.ln_f(x)
         if labels is not None and caches is None:
-            # fused training head: chunked linear+CE never materializes the
-            # [b, s, vocab] logits (ops/fused_ce.py) — this is the train-step
-            # path; the logits path below stays for eval/generation
+            # training head: loss computed directly from hidden states.
+            # Chunked fused linear+CE (ops/fused_ce.py) kicks in when the
+            # [b, s, vocab] logits would be big enough that HBM pressure
+            # costs more than the backward's logit recompute (~1.5 GB bf16
+            # measured crossover on v5e); small shapes keep the one-matmul
+            # unfused path, which is faster when memory is free.
+            import jax
             import jax.numpy as jnp
 
             from ..core import autograd
             from ..ops.fused_ce import fused_linear_cross_entropy
 
             lab = labels._array if isinstance(labels, Tensor) else jnp.asarray(labels)
+            n_chunks = self.cfg.fused_head_chunks
+            logits_bytes = 2 * b * s * self.cfg.vocab_size
+            use_fused = (n_chunks or 0) != 1 and (
+                n_chunks is not None or logits_bytes > 1.5e9
+            )
+
+            if use_fused:
+                fn = lambda xa, wa: fused_linear_cross_entropy(xa, wa, lab, n_chunks)
+            else:
+                def fn(xa, wa):
+                    lg = jax.lax.dot_general(
+                        xa, wa, (((2,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    lse = jax.scipy.special.logsumexp(lg, axis=-1)
+                    picked = jnp.take_along_axis(
+                        lg, lab[..., None].astype(jnp.int32), axis=-1
+                    )[..., 0]
+                    return jnp.mean(lse - picked)
+
             out, node = autograd.apply(
-                lambda xa, wa: fused_linear_cross_entropy(
-                    xa, wa, lab, self.cfg.fused_head_chunks
-                ),
-                x, self.wte.weight, name="fused_linear_cross_entropy",
+                fn, x, self.wte.weight, name="gpt_head_loss",
             )
             return Tensor._from_op(out, node)
         # logits = x @ wte.T  (vocab-parallel output)

@@ -2,5 +2,5 @@
 from . import lr  # noqa: F401
 from .optimizer import L1Decay, L2Decay, Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp,
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, Momentum, RMSProp,
 )

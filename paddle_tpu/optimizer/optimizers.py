@@ -184,6 +184,102 @@ class RMSProp(Optimizer):
         }
 
 
+class Lars(Optimizer):
+    """LARS momentum (reference
+    fleet/meta_optimizers/lars_optimizer.py:23 over the
+    lars_momentum op): layer-wise adaptive LR — local_lr = lr * coeff *
+    ||w|| / (||g|| + wd * ||w|| + eps), momentum on the rescaled step."""
+
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, epsilon=0.0,
+                 grad_clip=None, exclude_from_weight_decay=None,
+                 use_nesterov=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._use_nesterov = use_nesterov
+        # name substrings whose params skip BOTH the decay term and the
+        # wd*||w|| in the trust ratio (reference lars excludes e.g. bn/bias)
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _init_slots(self, arr):
+        return {"velocity": jnp.zeros_like(arr, jnp.float32)}
+
+    def _name_decays(self, name):
+        return not any(tok in (name or "") for tok in self._exclude)
+
+    def _should_decay(self, param):
+        return self._name_decays(getattr(param, "name", ""))
+
+    def _jitted_update(self, apply_wd=True):
+        # bind the exclusion decision into the compiled per-param update:
+        # excluded params drop lars_weight_decay from both the decay term
+        # and the trust-ratio denominator
+        cached = self._jit_cache.get(bool(apply_wd))
+        if cached is not None:
+            return cached
+        import functools
+
+        import jax
+
+        upd = functools.partial(self._update, apply_lars_wd=bool(apply_wd))
+
+        def f(param, grad, lr, state, hyper):
+            new_p, new_s = upd(param, grad, lr, state, **hyper)
+            return new_p.astype(param.dtype), new_s
+
+        jf = jax.jit(f, donate_argnums=(0, 3))
+        self._jit_cache[bool(apply_wd)] = jf
+        return jf
+
+    def apply_gradients_arrays(self, params, grads, state, lr=None, grad_scale=None):
+        """Compiled-path update honoring per-name weight-decay exclusion."""
+        lr = jnp.asarray(self.get_lr(), jnp.float32) if lr is None else lr
+        if self._grad_clip is not None:
+            keys = list(grads.keys())
+            clipped = self._grad_clip.clip_arrays([grads[k] for k in keys])
+            grads = dict(zip(keys, clipped))
+        new_params, new_state = {}, {}
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k] = p
+                new_state[k] = state.get(k, {})
+                continue
+            g = g.astype(p.dtype)
+            if grad_scale is not None:
+                g = g * grad_scale
+            np_, ns = self._update(
+                p, g, lr, state[k], apply_lars_wd=self._name_decays(k)
+            )
+            new_params[k] = np_.astype(p.dtype)
+            new_state[k] = ns
+        return new_params, new_state
+
+    def _update(self, param, grad, lr, state, apply_lars_wd=True):
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        wd = self._lars_wd if apply_lars_wd else 0.0
+        w_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g)
+        denom = g_norm + wd * w_norm + self._epsilon
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm / jnp.maximum(denom, 1e-20),
+            lr,
+        )
+        v = self._momentum * state["velocity"] + local_lr * (g + wd * p32)
+        if self._use_nesterov:
+            step = local_lr * (g + wd * p32) + self._momentum * v
+        else:
+            step = v
+        return (p32 - step).astype(param.dtype), {"velocity": v}
+
+
 class Lamb(Optimizer):
     _slot_names = ("moment1", "moment2", "beta1_pow", "beta2_pow")
 

@@ -123,7 +123,7 @@ class ShardedTrainStep:
     """One compiled XLA program: forward + loss + grad + optimizer update,
     with explicit in/out shardings over the mesh. Donates params/opt state."""
 
-    def __init__(self, model, loss_fn, optimizer, mesh, batch_specs, zero_stage=0, remat=False):
+    def __init__(self, model, loss_fn, optimizer, mesh, batch_specs, zero_stage=0, remat=False, gradient_merge_k=1, gradient_merge_avg=True):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -131,6 +131,12 @@ class ShardedTrainStep:
         self.batch_specs = batch_specs
         self.zero_stage = zero_stage
         self.remat = remat
+        # k-step gradient accumulation INSIDE the one compiled program
+        # (reference fleet gradient_merge_optimizer.py:21): grads accumulate
+        # into a sharded f32 buffer; the optimizer update applies only on
+        # every k-th step via a per-leaf select — no second executable.
+        self.gm_k = int(gradient_merge_k)
+        self.gm_avg = bool(gradient_merge_avg)
         self._compiled = None
         self.param_specs = module_param_specs(model, mesh, zero_stage)
 
@@ -161,6 +167,20 @@ class ShardedTrainStep:
             }
             for k, slots in opt_state.items()
         }
+        if self.gm_k > 1:
+            accum = {
+                k: jax.device_put(
+                    jnp.zeros(v.shape, jnp.float32),
+                    NamedSharding(
+                        self.mesh,
+                        grad_pspec(self.param_specs[k], v.shape, self.mesh,
+                                   self.zero_stage),
+                    ),
+                )
+                for k, v in params.items()
+            }
+            opt_state = {"inner": opt_state, "gm_accum": accum,
+                         "gm_count": jnp.zeros((), jnp.int32)}
         return params, buffers, opt_state
 
     def shard_batch(self, *arrays):
@@ -209,6 +229,30 @@ class ShardedTrainStep:
                     )
                     for k, g in grads.items()
                 }
+            if self.gm_k > 1:
+                accum = {
+                    k: opt_state["gm_accum"][k] + grads[k].astype(jnp.float32)
+                    for k in grads
+                }
+                count = opt_state["gm_count"] + 1
+                apply_now = (count % self.gm_k) == 0
+                scale = (1.0 / self.gm_k) if self.gm_avg else 1.0
+                merged = {k: (a * scale).astype(grads[k].dtype) for k, a in accum.items()}
+                upd_params, upd_opt = optimizer.apply_gradients_arrays(
+                    params, merged, opt_state["inner"], lr
+                )
+                sel = lambda a, b: jax.tree_util.tree_map(
+                    lambda x, y: jnp.where(apply_now, x, y), a, b
+                )
+                new_params = sel(upd_params, params)
+                new_opt = {
+                    "inner": sel(upd_opt, opt_state["inner"]),
+                    "gm_accum": sel(
+                        {k: jnp.zeros_like(a) for k, a in accum.items()}, accum
+                    ),
+                    "gm_count": count,
+                }
+                return loss, new_params, new_buf, new_opt
             new_params, new_opt = optimizer.apply_gradients_arrays(
                 params, grads, opt_state, lr
             )
@@ -218,6 +262,17 @@ class ShardedTrainStep:
         _, pspecs, bspecs, ospecs = build_state_shardings(
             self.model, self.optimizer, self.mesh, self.zero_stage
         )
+        if self.gm_k > 1:
+            named = self.model.named_parameters_dict()
+            ospecs = {
+                "inner": ospecs,
+                "gm_accum": {
+                    k: ns(grad_pspec(self.param_specs[k], named[k].shape,
+                                     self.mesh, self.zero_stage))
+                    for k in pspecs
+                },
+                "gm_count": ns(P()),
+            }
         batch_in = tuple(ns(s) for s in self.batch_specs)
         in_shardings = (pspecs, bspecs, ospecs, ns(P()), ns(P())) + batch_in
         out_shardings = (ns(P()), pspecs, bspecs, ospecs)
@@ -234,11 +289,128 @@ class ShardedTrainStep:
         return self._compiled(params, buffers, opt_state, lr, key, *batch)
 
 
-def make_sharded_train_step(model, loss_fn, optimizer, mesh, batch_specs=None, zero_stage=0, remat=False):
+def make_sharded_train_step(model, loss_fn, optimizer, mesh, batch_specs=None, zero_stage=0, remat=False, gradient_merge_k=1, gradient_merge_avg=True):
     """loss_fn(outputs_arrays, labels_array) -> scalar array, in trace mode."""
     if batch_specs is None:
         batch_specs = (P("dp"), P("dp"))
-    return ShardedTrainStep(model, loss_fn, optimizer, mesh, batch_specs, zero_stage, remat)
+    return ShardedTrainStep(model, loss_fn, optimizer, mesh, batch_specs,
+                            zero_stage, remat, gradient_merge_k, gradient_merge_avg)
+
+
+class LocalSGDTrainStep:
+    """LocalSGD over the dp axis as ONE compiled program (reference
+    fleet/meta_optimizers/localsgd_optimizer.py:28).
+
+    Each dp replica keeps its OWN divergent params + optimizer state — a
+    leading replica axis sharded over 'dp' — and steps on its local shard of
+    the batch with NO gradient sync (this is the point: k-1 of every k steps
+    run with zero cross-replica traffic). Every k-th step the params are
+    averaged over the replica axis (XLA emits the all-reduce) and broadcast
+    back. vmap over the replica axis turns the per-replica step into SPMD;
+    GSPMD maps replicas onto the dp mesh axis."""
+
+    def __init__(self, model, loss_fn, optimizer, mesh, k_steps=1, batch_specs=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.k = int(k_steps)
+        self.R = mesh.shape.get("dp", 1)
+        self.batch_specs = batch_specs or (P("dp"), P("dp"))
+        self._compiled = None
+
+    def init_state(self):
+        params, buffers = state_dict_arrays(self.model)
+        rep = lambda a: jnp.broadcast_to(a[None], (self.R,) + a.shape)
+        params = {
+            k: jax.device_put(rep(v), NamedSharding(self.mesh, P("dp")))
+            for k, v in params.items()
+        }
+        buffers = {
+            k: jax.device_put(v, NamedSharding(self.mesh, P()))
+            for k, v in buffers.items()
+        }
+        slot_template = self.optimizer.init_state_arrays(
+            {k: v[0] for k, v in params.items()}
+        )
+        opt_state = {
+            k: {
+                s: jax.device_put(rep(a), NamedSharding(self.mesh, P("dp")))
+                for s, a in slots.items()
+            }
+            for k, slots in slot_template.items()
+        }
+        return params, buffers, opt_state, jnp.zeros((), jnp.int32)
+
+    def shard_batch(self, *arrays):
+        out = []
+        for a, spec in zip(arrays, self.batch_specs):
+            a = jnp.asarray(a)
+            # reshape [B, ...] -> [R, B//R, ...]: replica-major split
+            a = a.reshape((self.R, a.shape[0] // self.R) + a.shape[1:])
+            out.append(jax.device_put(a, NamedSharding(self.mesh, P("dp"))))
+        return tuple(out)
+
+    def _build(self, n_batch):
+        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+        k_steps, R = self.k, self.R
+
+        def one_replica(params, buffers, lr, key, *batch):
+            def compute_loss(p):
+                out, new_buf = functional_call(
+                    model, p, buffers, args=batch[: n_batch - 1],
+                    rng_key=key, training=True,
+                )
+                return loss_fn(out, batch[n_batch - 1]), new_buf
+            return jax.value_and_grad(compute_loss, has_aux=True)(params)
+
+        def step(params, buffers, opt_state, count, lr, key, *batch):
+            keys = jax.random.split(key, R)
+            (loss, new_buf), grads = jax.vmap(
+                one_replica, in_axes=(0, None, None, 0) + (0,) * n_batch,
+            )(params, buffers, lr, keys, *batch)
+            # mutated buffers (e.g. BN running stats) are averaged across
+            # replicas — the shared-buffer analogue of the param average
+            new_buf = jax.tree_util.tree_map(
+                lambda x: jnp.mean(x.astype(jnp.float32), 0).astype(x.dtype),
+                new_buf,
+            )
+            new_params, new_opt = jax.vmap(
+                lambda p, g, o: optimizer.apply_gradients_arrays(p, g, o, lr)
+            )(params, grads, opt_state)
+            count = count + 1
+            sync = (count % k_steps) == 0
+            avg = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(
+                    jnp.mean(x.astype(jnp.float32), 0, keepdims=True), x.shape
+                ).astype(x.dtype),
+                new_params,
+            )
+            new_params = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(sync, a, b), avg, new_params
+            )
+            return jnp.mean(loss), new_params, new_buf, new_opt, count
+
+        ns = lambda s: NamedSharding(self.mesh, s)
+        rspec = {k: ns(P("dp")) for k in self.model.named_parameters_dict()}
+        _, buffers = state_dict_arrays(self.model)
+        bspec = {k: ns(P()) for k in buffers}
+        otmpl = self.optimizer.init_state_arrays(
+            {k: p._array for k, p in self.model.named_parameters_dict().items()}
+        )
+        ospec = {k: {s: ns(P("dp")) for s in slots} for k, slots in otmpl.items()}
+        batch_in = tuple(ns(s) for s in self.batch_specs)
+        return jax.jit(
+            step,
+            in_shardings=(rspec, bspec, ospec, ns(P()), ns(P()), ns(P())) + batch_in,
+            out_shardings=(ns(P()), rspec, bspec, ospec, ns(P())),
+            donate_argnums=(0, 2),
+        )
+
+    def __call__(self, params, buffers, opt_state, count, lr, key, *batch):
+        if self._compiled is None:
+            self._compiled = self._build(len(batch))
+        return self._compiled(params, buffers, opt_state, count, lr, key, *batch)
 
 
 def shard_params_to_mesh(model, mesh, zero_stage=0):

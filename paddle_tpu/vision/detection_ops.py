@@ -227,6 +227,9 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
 # generate_proposals (RPN)
 # ---------------------------------------------------------------------------
 
+_BBOX_CLIP = float(np.log(1000.0 / 16.0))  # reference bbox_util.h kBBoxClipDefault
+
+
 def _decode_rpn(anchors, deltas, variances):
     aw = anchors[:, 2] - anchors[:, 0]
     ah = anchors[:, 3] - anchors[:, 1]
@@ -235,8 +238,8 @@ def _decode_rpn(anchors, deltas, variances):
     d = deltas * variances if variances is not None else deltas
     cx = d[:, 0] * aw + acx
     cy = d[:, 1] * ah + acy
-    w = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw
-    h = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah
+    w = jnp.exp(jnp.minimum(d[:, 2], _BBOX_CLIP)) * aw
+    h = jnp.exp(jnp.minimum(d[:, 3], _BBOX_CLIP)) * ah
     return jnp.stack([cx - w * 0.5, cy - h * 0.5, cx + w * 0.5, cy + h * 0.5], 1)
 
 
@@ -281,7 +284,13 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         )
         ws = boxes[:, 2] - boxes[:, 0] + off
         hs = boxes[:, 3] - boxes[:, 1] + off
-        keep_sz = (ws >= min_size) & (hs >= min_size)
+        ms = max(float(min_size), 1.0)  # reference FilterBoxes min_size clamp
+        keep_sz = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            # offset convention also requires the box CENTER inside the image
+            ccx = boxes[:, 0] + ws * 0.5
+            ccy = boxes[:, 1] + hs * 0.5
+            keep_sz = keep_sz & (ccx <= w_img) & (ccy <= h_img)
         sc = jnp.where(keep_sz, topv, -jnp.inf)
         keep, num = nms_padded_array(boxes, sc, nms_thresh, k_post)
         sel = keep >= 0
@@ -319,6 +328,13 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     scale = jnp.sqrt(jnp.maximum(w * h, 0.0))
     lvl = jnp.floor(jnp.log2(scale / float(refer_scale) + 1e-8)) + refer_level
     lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32) - int(min_level)
+    if rois_num is not None:
+        # padded-input contract: rows past rois_num are pads from an
+        # upstream fixed-capacity op (e.g. generate_proposals) — route them
+        # to NO level (sentinel bucket) so counts and restore stay clean
+        rn = rois_num._array if isinstance(rois_num, Tensor) else jnp.asarray(rois_num)
+        rn = rn.reshape(-1)[0] if rn.ndim else rn
+        lvl = jnp.where(jnp.arange(R) < rn, lvl, n_levels)
 
     multi = []
     nums = []
@@ -335,9 +351,15 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(nums_arr)[:-1]])
     # restore_ind[j] = position of input roi j in the level-concat, so
     # gather(concat_rois, restore_ind) recovers the input order (the
-    # reference RestoreIndex contract)
+    # reference RestoreIndex contract); pad rows map past the valid total
     pos = jnp.stack(pos_in_level)                       # [L, R]
-    out_pos = (pos[lvl, jnp.arange(R)] + starts[lvl]).astype(jnp.int32)
+    is_pad = lvl >= n_levels
+    lvl_safe = jnp.minimum(lvl, n_levels - 1)
+    valid_total = jnp.sum(nums_arr)
+    pad_pos = jnp.cumsum(is_pad.astype(jnp.int32)) - 1 + valid_total
+    out_pos = jnp.where(
+        is_pad, pad_pos, pos[lvl_safe, jnp.arange(R)] + starts[lvl_safe]
+    ).astype(jnp.int32)
     return (
         multi,
         Tensor._from_op(out_pos[:, None]),

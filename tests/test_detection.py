@@ -280,3 +280,20 @@ def test_box_coder_2d_decode_pairs_rows():
         vops.box_coder(priors, None, deltas, "decode_center_size").numpy()
     )
     np.testing.assert_allclose(dec, targets, rtol=1e-4, atol=1e-3)
+
+
+def test_distribute_fpn_respects_rois_num():
+    """Pad rows (index >= rois_num) route to NO level and restore maps them
+    past the valid rows (padded-capacity contract)."""
+    rois = np.array(
+        [[0, 0, 16, 16], [0, 0, 600, 600], [0, 0, 0, 0], [0, 0, 0, 0]],
+        np.float32,
+    )
+    multi, restore, nums = vops.distribute_fpn_proposals(
+        rois, 2, 5, 4, 224, rois_num=np.array([2], np.int32)
+    )
+    ns = np.asarray(nums.numpy())
+    assert ns.sum() == 2  # pads counted nowhere
+    assert ns[0] == 1 and ns[-1] == 1  # small -> level 2, big -> level 5
+    ri = np.asarray(restore.numpy())[:, 0]
+    assert set(ri[2:]) == {2, 3}  # pad rows sit past the valid rows

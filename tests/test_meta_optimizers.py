@@ -1,0 +1,254 @@
+"""Strategy-activated meta-optimizers: gradient merge, LocalSGD, Lars
+(VERDICT r3 item 6 — the DistributedStrategy fields must DRIVE behavior).
+
+Reference: fleet/meta_optimizers/{gradient_merge,localsgd,lars}_optimizer.py.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def teardown_module():
+    from paddle_tpu.distributed.mesh import set_mesh
+
+    set_mesh(None)
+
+
+def _fleet_opt(strategy, net, base_opt):
+    from paddle_tpu.distributed import fleet
+
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.fleet.distributed_optimizer(base_opt)
+
+
+def _train(net, opt, x, y, steps):
+    loss_fn = nn.MSELoss()
+    losses = []
+    for _ in range(steps):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+class TestGradientMergeEager:
+    def _strategy(self, gm_k=None):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                            "sharding_degree": 1}
+        if gm_k:
+            s.gradient_merge = True
+            s.gradient_merge_configs = {"k_steps": gm_k, "avg": True}
+        return s
+
+    def test_k_steps_changes_trajectory_and_matches_big_batch(self):
+        rs = np.random.RandomState(0)
+        X = rs.randn(8, 6).astype(np.float32)
+        Y = rs.randn(8, 3).astype(np.float32)
+
+        def build():
+            paddle.seed(0)
+            net = nn.Linear(6, 3)
+            return net, paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters())
+
+        # merged k=2 over half-batches == plain SGD on the full batch
+        net1, base1 = build()
+        opt1 = _fleet_opt(self._strategy(gm_k=2), net1, base1)
+        loss_fn = nn.MSELoss()
+        for half in (slice(0, 4), slice(4, 8)):
+            loss = loss_fn(net1(paddle.to_tensor(X[half])), paddle.to_tensor(Y[half]))
+            loss.backward()
+            opt1.step()
+            opt1.clear_grad()
+        net2, opt2 = build()
+        loss = loss_fn(net2(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt2.step()
+        np.testing.assert_allclose(
+            net1.weight.numpy(), net2.weight.numpy(), rtol=1e-5, atol=1e-6
+        )
+
+        # and it differs from NOT merging (strategy field actually drives)
+        net3, base3 = build()
+        opt3 = _fleet_opt(self._strategy(None), net3, base3)
+        for half in (slice(0, 4), slice(4, 8)):
+            loss = loss_fn(net3(paddle.to_tensor(X[half])), paddle.to_tensor(Y[half]))
+            loss.backward()
+            opt3.step()
+            opt3.clear_grad()
+        assert not np.allclose(net1.weight.numpy(), net3.weight.numpy())
+
+
+class TestGradientMergeCompiled:
+    def test_compiled_k2_matches_double_batch(self):
+        from paddle_tpu.distributed.mesh import init_mesh, set_mesh
+        from paddle_tpu.parallel.spmd import make_sharded_train_step
+
+        mesh = init_mesh({"dp": 2})
+
+        def loss_fn(out, labels):
+            o = out if not isinstance(out, (tuple, list)) else out[0]
+            return jnp.mean((o - labels) ** 2)
+
+        rs = np.random.RandomState(1)
+        X = rs.randn(8, 6).astype(np.float32)
+        Y = rs.randn(8, 3).astype(np.float32)
+
+        def build(gm_k):
+            paddle.seed(0)
+            net = nn.Linear(6, 3)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+            return make_sharded_train_step(
+                net, loss_fn, opt, mesh, batch_specs=(P("dp"), P("dp")),
+                gradient_merge_k=gm_k,
+            )
+
+        key = jax.random.PRNGKey(0)
+        # k=2 over the two halves
+        step = build(2)
+        params, buffers, opt_state = step.init_state()
+        for half in (slice(0, 4), slice(4, 8)):
+            xs, ys = step.shard_batch(X[half], Y[half])
+            loss, params, buffers, opt_state = step(
+                params, buffers, opt_state, np.float32(0.1), key, xs, ys
+            )
+        w_merged = np.asarray(params["weight"])
+
+        # one step on the full batch, no merging
+        step2 = build(1)
+        params2, buffers2, opt_state2 = step2.init_state()
+        xs, ys = step2.shard_batch(X, Y)
+        loss, params2, buffers2, opt_state2 = step2(
+            params2, buffers2, opt_state2, np.float32(0.1), key, xs, ys
+        )
+        np.testing.assert_allclose(
+            w_merged, np.asarray(params2["weight"]), rtol=1e-5, atol=1e-6
+        )
+        set_mesh(None)
+
+
+class TestLocalSGD:
+    def test_k1_matches_dp_and_k3_diverges_then_syncs(self):
+        from paddle_tpu.distributed.mesh import init_mesh, set_mesh
+        from paddle_tpu.parallel.spmd import (
+            LocalSGDTrainStep,
+            make_sharded_train_step,
+        )
+
+        mesh = init_mesh({"dp": 4})
+
+        def loss_fn(out, labels):
+            o = out if not isinstance(out, (tuple, list)) else out[0]
+            return jnp.mean((o - labels) ** 2)
+
+        rs = np.random.RandomState(2)
+        X = rs.randn(8, 6).astype(np.float32)
+        Y = rs.randn(8, 3).astype(np.float32)
+        key = jax.random.PRNGKey(0)
+
+        def build_net():
+            paddle.seed(0)
+            net = nn.Linear(6, 3)
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=net.parameters())
+            return net, opt
+
+        # k=1 (sync every step) with SGD == grad-averaged DP
+        net, opt = build_net()
+        ls = LocalSGDTrainStep(net, loss_fn, opt, mesh, k_steps=1)
+        params, buffers, opt_state, count = ls.init_state()
+        for _ in range(3):
+            xs, ys = ls.shard_batch(X, Y)
+            loss, params, buffers, opt_state, count = ls(
+                params, buffers, opt_state, count, np.float32(0.05), key, xs, ys
+            )
+        w_local = np.asarray(params["weight"][0])
+
+        net2, opt2 = build_net()
+        dp = make_sharded_train_step(net2, loss_fn, opt2, mesh,
+                                     batch_specs=(P("dp"), P("dp")))
+        p2, b2, o2 = dp.init_state()
+        for _ in range(3):
+            xs, ys = dp.shard_batch(X, Y)
+            loss, p2, b2, o2 = dp(p2, b2, o2, np.float32(0.05), key, xs, ys)
+        np.testing.assert_allclose(
+            w_local, np.asarray(p2["weight"]), rtol=1e-4, atol=1e-5
+        )
+
+        # k=3: after 2 steps replicas have DIVERGED; after the 3rd they agree
+        net3, opt3 = build_net()
+        ls3 = LocalSGDTrainStep(net3, loss_fn, opt3, mesh, k_steps=3)
+        params, buffers, opt_state, count = ls3.init_state()
+        for i in range(3):
+            xs, ys = ls3.shard_batch(X, Y)
+            loss, params, buffers, opt_state, count = ls3(
+                params, buffers, opt_state, count, np.float32(0.05), key, xs, ys
+            )
+            w = np.asarray(params["weight"])
+            spread = np.abs(w - w.mean(0, keepdims=True)).max()
+            if i < 2:
+                assert spread > 1e-6, f"step {i}: replicas did not diverge"
+            else:
+                assert spread < 1e-6, f"sync step: replicas still differ {spread}"
+        # and the local-k3 trajectory differs from the k=1 trajectory
+        assert not np.allclose(np.asarray(params["weight"][0]), w_local)
+        set_mesh(None)
+
+
+class TestLars:
+    def test_strategy_swaps_momentum_for_lars(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.optimizer import Lars
+
+        rs = np.random.RandomState(3)
+        X = rs.randn(8, 6).astype(np.float32)
+        Y = rs.randn(8, 3).astype(np.float32)
+
+        def run(lars):
+            paddle.seed(0)
+            net = nn.Linear(6, 3)
+            base = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                             parameters=net.parameters())
+            s = DistributedStrategy()
+            s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                                "sharding_degree": 1}
+            s.lars = lars
+            opt = _fleet_opt(s, net, base)
+            if lars:
+                assert isinstance(opt._inner_opt, Lars)
+            _train(net, opt, paddle.to_tensor(X), paddle.to_tensor(Y), 3)
+            return net.weight.numpy()
+
+        w_lars = run(True)
+        w_mom = run(False)
+        assert not np.allclose(w_lars, w_mom)
+
+    def test_lars_optimizer_math(self):
+        """One step against the hand-computed LARS update."""
+        from paddle_tpu.optimizer import Lars
+
+        w0 = np.array([[3.0, 4.0]], np.float32)  # ||w|| = 5
+        g = np.array([[0.6, 0.8]], np.float32)   # ||g|| = 1
+        p = paddle.Parameter(w0.copy())
+        opt = Lars(learning_rate=1.0, momentum=0.0, lars_coeff=0.01,
+                   lars_weight_decay=0.0, parameters=[p])
+        from paddle_tpu.core.tensor import Tensor
+
+        p._grad = Tensor(g)
+        opt.step()
+        local_lr = 1.0 * 0.01 * 5.0 / 1.0
+        np.testing.assert_allclose(
+            p.numpy(), w0 - local_lr * g, rtol=1e-5
+        )
