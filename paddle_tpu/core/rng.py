@@ -30,10 +30,27 @@ _state = _KeyState()
 
 
 def seed(s: int):
-    """paddle.seed parity."""
+    """paddle.seed parity: seeds the device RNG stream AND paddle's own
+    host-side generator (DataLoader shuffle, RandomSampler) — the
+    reference's global seed reaches its CPU generators the same way
+    (framework/random.py). numpy's GLOBAL state is deliberately left alone:
+    a library call must not clobber user np.random streams."""
+    import numpy as _np
+
     _state.key = jax.random.PRNGKey(int(s))
     _state.counter = 0
+    _state.host = _np.random.default_rng(int(s) % (2**31))
     return s
+
+
+def host_generator():
+    """paddle's host-side numpy Generator (shuffles, samplers). Seeded by
+    paddle.seed; lazily random otherwise."""
+    import numpy as _np
+
+    if getattr(_state, "host", None) is None:
+        _state.host = _np.random.default_rng()
+    return _state.host
 
 
 def get_rng_state():

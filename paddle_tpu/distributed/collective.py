@@ -465,11 +465,15 @@ _P2P_MAX_NDIM = 8
 _META_BYTES = 1 + 16 + 1 + 8 * _P2P_MAX_NDIM  # flag | dtype str | ndim | dims
 
 
-def _pack_meta(local_np, is_send):
+def _pack_meta(local_np, is_send, abort=False):
     """Fixed-size metadata block: the SendRecvMeta handshake of the reference
-    (pp_utils/p2p_communication.py:53), carried in-band every exchange."""
+    (pp_utils/p2p_communication.py:53), carried in-band every exchange.
+    Byte 0 is a bitfield: bit0 = payload-is-send, bit1 = abort-intent (my
+    recv deadline expired — both sides must stop after THIS exchange, which
+    keeps the lock-step pair from leaving one process stranded inside the
+    next collective)."""
     meta = np.zeros(_META_BYTES, np.uint8)
-    meta[0] = 1 if is_send else 0
+    meta[0] = (1 if is_send else 0) | (2 if abort else 0)
     dt = np.dtype(local_np.dtype).str.encode()[:16]
     meta[1:1 + len(dt)] = np.frombuffer(dt, np.uint8)
     if local_np.ndim > _P2P_MAX_NDIM:
@@ -481,14 +485,15 @@ def _pack_meta(local_np, is_send):
 
 
 def _unpack_meta(meta):
-    flag = bool(meta[0])
+    flag = bool(meta[0] & 1)
+    abort = bool(meta[0] & 2)
     dtype = np.dtype(bytes(meta[1:17]).rstrip(b"\x00").decode())
     ndim = int(meta[17])
     dims = np.frombuffer(bytes(meta[18:18 + 8 * ndim]), np.int64)
-    return flag, dtype, tuple(int(d) for d in dims)
+    return flag, abort, dtype, tuple(int(d) for d in dims)
 
 
-def _pair_exchange(peer, local_np, is_send):
+def _pair_exchange(peer, local_np, is_send, abort=False):
     """One order-matched exchange on the (me, peer) pair.
 
     Two phases, both entering the SAME 2-rank gather program on both
@@ -510,9 +515,11 @@ def _pair_exchange(peer, local_np, is_send):
     local_np = np.ascontiguousarray(local_np)
 
     meta_out = np.asarray(
-        stacked_collective("gather", _stack_local(g, _pack_meta(local_np, is_send)), g._devices)
+        stacked_collective(
+            "gather", _stack_local(g, _pack_meta(local_np, is_send, abort)), g._devices
+        )
     )
-    peer_flag, peer_dtype, peer_shape = _unpack_meta(meta_out[pidx])
+    peer_flag, peer_abort, peer_dtype, peer_shape = _unpack_meta(meta_out[pidx])
     peer_bytes = int(peer_dtype.itemsize * int(np.prod(peer_shape, dtype=np.int64)))
 
     pad = max(local_np.nbytes, peer_bytes)
@@ -524,6 +531,17 @@ def _pair_exchange(peer, local_np, is_send):
             np.ascontiguousarray(out[pidx][:peer_bytes]).tobytes(), dtype=peer_dtype
         ).reshape(peer_shape)
         _P2P_INBOX.setdefault(peer, []).append(payload)
+    return peer_flag, peer_abort
+
+
+# per-peer sequence counters: how many sends/recvs THIS process has completed
+# on each pair — named in timeout errors so a mismatch is debuggable from
+# either side's log alone
+_P2P_SEQ: dict[int, dict] = {}
+
+
+def _seq(peer):
+    return _P2P_SEQ.setdefault(peer, {"sent": 0, "recvd": 0})
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -533,28 +551,66 @@ def send(tensor, dst=0, group=None, sync_op=True):
     if me == dst:
         raise ValueError("cannot send to self")
     _pair_exchange(dst, _to_host(tensor), True)
+    _seq(dst)["sent"] += 1
     return tensor
 
 
-_RECV_MAX_POLLS = 100000  # diagnostic bound for send/recv sequence mismatches
-
-
 def recv(tensor, src=0, group=None, sync_op=True):
+    """Blocking recv with a sequence-mismatch timeout (FLAGS_p2p_timeout_s)
+    and exponential poll backoff (capped at FLAGS_p2p_poll_interval_s).
+
+    Scope of the timeout: each poll is itself an order-matched 2-rank
+    exchange, so the deadline can only be observed while the PEER keeps
+    entering exchanges — it catches the classic deadlock where both sides
+    sit in recv (mismatched send/recv sequences), the case the abort
+    handshake resolves symmetrically. A peer that is fully absent (crashed
+    before entering the collective) blocks inside the underlying XLA
+    collective itself; detecting dead processes is the launcher/elastic
+    layer's job (heartbeats), not this transport's."""
+    import time as _time
+
+    from ..flags import flag as _flag
+
     me = jax.process_index()
     if me == src:
         raise ValueError("cannot recv from self")
+    timeout_s = float(_flag("FLAGS_p2p_timeout_s"))
+    max_sleep = float(_flag("FLAGS_p2p_poll_interval_s"))
     inbox = _P2P_INBOX.setdefault(src, [])
+    deadline = _time.monotonic() + timeout_s
+    sleep = 0.0
     polls = 0
+    peer_was_receiving = False
     while not inbox:
-        _pair_exchange(src, _to_host(tensor), False)
+        # abort-intent rides in the SAME exchange that would otherwise be
+        # the last: the lock-step pair always stops on the same exchange, so
+        # a timeout on one side can never strand the other inside the next
+        # collective
+        abort = _time.monotonic() > deadline
+        peer_flag, peer_abort = _pair_exchange(src, _to_host(tensor), False, abort=abort)
+        peer_was_receiving = peer_was_receiving or not peer_flag
         polls += 1
-        if polls >= _RECV_MAX_POLLS:
+        if inbox:
+            break  # the abort exchange itself delivered the payload
+        if abort or peer_abort:
+            sq = _seq(src)
+            both = (" — BOTH sides are polling in recv: the pair's "
+                    "send/recv sequences are out of step"
+                    if peer_was_receiving else "")
+            who = (f"rank {me} recv deadline ({timeout_s:.0f}s) expired"
+                   if abort else f"peer rank {src} reported its recv timeout")
             raise RuntimeError(
-                f"recv(src={src}) polled {polls} exchanges without a matching "
-                "send — the peer's send/recv sequence is out of step with "
-                "this process (both sides waiting in recv?)"
+                f"recv(src={src}) aborted after {polls} exchanges: {who}. "
+                f"Rank {me} has completed {sq['sent']} sends / "
+                f"{sq['recvd']} recvs on pair ({min(me, src)},{max(me, src)}) "
+                f"and was waiting on recv #{sq['recvd'] + 1}{both}. Raise "
+                "FLAGS_p2p_timeout_s if the peer is legitimately slow."
             )
+        if sleep:
+            _time.sleep(sleep)
+        sleep = min(max(sleep * 2, 0.001), max_sleep)
     payload = inbox.pop(0)
+    _seq(src)["recvd"] += 1
     want = _to_host(tensor)
     if payload.shape != want.shape or payload.dtype != want.dtype:
         raise RuntimeError(

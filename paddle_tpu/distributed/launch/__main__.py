@@ -1,0 +1,6 @@
+"""python -m paddle_tpu.distributed.launch entry point."""
+import sys
+
+from .main import launch_main
+
+sys.exit(launch_main())

@@ -18,16 +18,113 @@ from __future__ import annotations
 
 import argparse
 import os
+import secrets
+import socket
 import subprocess
 import sys
 import time
+
+_RDZV_PORT_OFFSET = 5  # rendezvous store listens beside the coordinator port
+
+
+def _local_ip(master_host):
+    if master_host in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((master_host, 1))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def rendezvous(master, nnodes, rank, job_id, timeout=300.0):
+    """Master-based rendezvous (reference launch/controllers/master.py:65,177
+    HTTP/etcd master, TPU-native over the csrc TCPStore):
+
+    - rank 0 hosts the store at master_port + 5; peers connect to it
+    - rank -1 means "assign me one": an atomic counter hands out ranks, so
+      nodes can join with NO pre-set rank or endpoint env at all
+    - every node publishes its reachable IP; all block until nnodes have
+      registered, then read back the full peer table
+    - rank 0 also mints the per-job RPC authkey (distributed through the
+      store, never typed by a user)
+
+    Returns (rank, endpoints_list, authkey, store).
+    """
+    from ..store import TCPStore
+
+    host, port = master.rsplit(":", 1)
+    store_port = int(port) + _RDZV_PORT_OFFSET
+    want_master = rank in (0, -1)
+    store = None
+    if want_master:
+        # with auto-assigned ranks, every node races to host; losers connect
+        try:
+            store = TCPStore(host, store_port, is_master=True,
+                             world_size=nnodes, timeout=int(timeout))
+        except RuntimeError:
+            store = None
+    if store is None:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                store = TCPStore(host, store_port, is_master=False,
+                                 world_size=nnodes, timeout=int(timeout))
+                break
+            except RuntimeError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+
+    pfx = f"rdzv/{job_id}"
+    # rank claims are atomic counters: mixing explicit NODE_RANK nodes with
+    # auto-assigned (-1) nodes cannot produce duplicates — auto nodes skip
+    # claimed ranks, explicit double-claims fail loudly
+    if rank == -1:
+        while True:
+            cand = store.add(f"{pfx}/next_rank", 1) - 1
+            if cand >= nnodes:
+                raise RuntimeError(
+                    f"rendezvous: all {nnodes} ranks already claimed "
+                    "(more nodes launched than --nnodes?)"
+                )
+            if store.add(f"{pfx}/claim/{cand}", 1) == 1:
+                rank = cand
+                break
+    elif store.add(f"{pfx}/claim/{rank}", 1) != 1:
+        raise RuntimeError(
+            f"rendezvous: rank {rank} claimed twice — two nodes were "
+            "launched with the same NODE_RANK/--rank"
+        )
+    my_ip = _local_ip(host)
+    store.set(f"{pfx}/node/{rank}", f"{my_ip}:{int(port) + 100 + rank}")
+    if rank == 0:
+        store.set(f"{pfx}/authkey", secrets.token_hex(16))
+    n = store.add(f"{pfx}/joined", 1)
+    deadline = time.monotonic() + timeout
+    while n < nnodes:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"rendezvous: only {n}/{nnodes} nodes joined within {timeout}s"
+            )
+        time.sleep(0.2)
+        n = store.add(f"{pfx}/joined", 0)
+    endpoints = [
+        store.get(f"{pfx}/node/{r}").decode() for r in range(nnodes)
+    ]
+    authkey = store.get(f"{pfx}/authkey").decode()
+    return rank, endpoints, authkey, store
 
 
 def launch_main(argv=None):
     parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     parser.add_argument("--master", default=None, help="coordinator host:port")
     parser.add_argument("--nnodes", type=int, default=1)
-    parser.add_argument("--rank", type=int, default=int(os.getenv("NODE_RANK", "0")))
+    parser.add_argument(
+        "--rank", type=int, default=int(os.getenv("NODE_RANK", "-1")),
+        help="-1 = let the master's rendezvous assign one",
+    )
     parser.add_argument("--log_dir", default="log")
     parser.add_argument("--max_restarts", type=int, default=0)
     parser.add_argument("--devices", default=None, help="unused on TPU (SPMD)")
@@ -48,6 +145,18 @@ def launch_main(argv=None):
         script = script[1:]
 
     env = dict(os.environ)
+    store = None
+    if args.master and args.nnodes > 1:
+        # no pre-set rank/endpoint env required: resolve everything through
+        # the rank-0 TCPStore rendezvous
+        args.rank, endpoints, authkey, store = rendezvous(
+            args.master, args.nnodes, args.rank, args.job_id
+        )
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        env["PADDLE_RPC_AUTHKEY"] = authkey
+        env["PADDLE_MASTER"] = args.master
+    elif args.rank < 0:
+        args.rank = 0
     env["PADDLE_TRAINER_ID"] = str(args.rank)
     env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
     if args.master:

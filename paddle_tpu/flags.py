@@ -19,6 +19,8 @@ _DEFS = {
     "FLAGS_pallas_block_q": (256, "flash attention q tile"),
     "FLAGS_pallas_block_k": (1024, "flash attention k tile"),
     "FLAGS_log_compiles": (False, "log XLA compilations"),
+    "FLAGS_p2p_timeout_s": (300.0, "eager send/recv wall-clock timeout"),
+    "FLAGS_p2p_poll_interval_s": (0.05, "max backoff between recv polls"),
     "FLAGS_allocator_strategy": ("auto_growth", "accepted for parity; PjRt allocates"),
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, "accepted for parity"),
     "FLAGS_cudnn_deterministic": (False, "XLA is deterministic per compile"),

@@ -39,6 +39,119 @@ def to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+class _StaticGraphAdapter:
+    """Static-mode driver for Model (reference hapi/model.py:286
+    StaticGraphAdapter vs :763 DynamicGraphAdapter).
+
+    Under `paddle.enable_static()`, Model.prepare routes batches here: the
+    network forward + loss are CAPTURED once into a `static.Program` (op-log
+    dry run on placeholder feeds), and training differentiates the program's
+    pure replay function — capture once, `jax.value_and_grad` over the
+    replay, one XLA executable per feed signature. The loss trajectory is
+    identical to dynamic mode because the replay computes the same math on
+    the same parameter values.
+
+    Known static-mode deltas (documented, reference-consistent): RNG ops
+    (dropout) are captured with their capture-time key, so masks repeat per
+    step unless re-captured; buffer mutations (BN running stats) stay at
+    capture-time values — fetch/update is a user-level concern as in the
+    reference's startup/main program split."""
+
+    def __init__(self, model):
+        self.model = model
+        self._steps = {}  # feed signature -> (jit step, meta)
+
+    def _capture(self, ins, labs):
+        from ..static import program as SP
+
+        model = self.model
+        net = model.network
+        prog = SP.Program()
+        with SP.program_guard(prog):
+            xts = [
+                SP.data(f"x{i}", list(a.shape), str(a.dtype))
+                for i, a in enumerate(ins)
+            ]
+            yts = [
+                SP.data(f"y{i}", list(a.shape), str(a.dtype))
+                for i, a in enumerate(labs)
+            ]
+            net.train()
+            outs = net(*xts)
+            loss = model._apply_loss(outs, yts)
+        feed_names = [f"x{i}" for i in range(len(ins))] + [
+            f"y{i}" for i in range(len(labs))
+        ]
+        out_list = to_list(outs)
+        fetch_ids = [id(loss._array)] + [id(o._array) for o in out_list]
+        externals, run = prog._plan(feed_names, fetch_ids)
+        name_by_id = {
+            id(p): n for n, p in net.named_parameters_dict().items()
+        }
+        trainables = [
+            (pos, name_by_id[id(t)])
+            for pos, (aid, t) in enumerate(externals)
+            if isinstance(t, Tensor) and id(t) in name_by_id and not t.stop_gradient
+        ]
+        return prog, externals, run, trainables, len(out_list)
+
+    def train_batch(self, ins, labs):
+        model = self.model
+        net = model.network
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in ins + labs)
+        if sig not in self._steps:
+            prog, externals, run, trainables, n_outs = self._capture(ins, labs)
+            opt = model._optimizer
+            tr_pos = [p for p, _ in trainables]
+            tr_names = [n for _, n in trainables]
+
+            def step(params, opt_state, lr, feed_vals, ext_rest):
+                def loss_fn(pd):
+                    ev = list(ext_rest)
+                    for pos, name in zip(tr_pos, tr_names):
+                        ev[pos] = pd[name]
+                    res = run(feed_vals, ev)
+                    return res[0], res[1:]
+
+                (loss, outs), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                new_params, new_opt = opt.apply_gradients_arrays(
+                    params, grads, opt_state, lr
+                )
+                return loss, outs, new_params, new_opt
+
+            jstep = jax.jit(step, donate_argnums=(0, 1))
+            self._steps[sig] = (jstep, externals, tr_pos, tr_names)
+        jstep, externals, tr_pos, tr_names = self._steps[sig]
+        # consume one step key exactly like the dynamic adapter does, so the
+        # global RNG stream (and thus e.g. loader shuffle order) is identical
+        # whichever adapter runs — static vs dynamic fit trajectories match
+        rng.next_key()
+        named = net.named_parameters_dict()
+        params = {n: named[n]._array for n in tr_names}
+        if model._opt_state is None:
+            model._opt_state = model._optimizer.state_arrays_for(named)
+        opt_state = {
+            n: model._opt_state.get(n, {}) for n in tr_names
+        }
+        from ..static.program import Program
+
+        prog_vals = Program._external_values(externals)
+        lr = jnp.asarray(model._optimizer.get_lr(), jnp.float32)
+        loss, outs, new_params, new_opt = jstep(
+            params, opt_state, lr, list(ins) + list(labs), prog_vals
+        )
+        for n, v in new_params.items():
+            named[n]._array = v
+        model._opt_state.update(new_opt)
+        model._optimizer._step_count += 1
+        model._optimizer.sync_state_arrays(named, model._opt_state)
+        metrics = model._update_metrics(list(outs), labs)
+        loss_val = [float(np.asarray(loss))]
+        return (loss_val, metrics) if metrics else loss_val
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -51,6 +164,7 @@ class Model:
         self._opt_state = None
         self.stop_training = False
         self._compiled = True
+        self._static_adapter = None
         self.mode = "train"
 
     # ---- preparation -------------------------------------------------------
@@ -63,6 +177,11 @@ class Model:
                 raise TypeError(f"metric must be paddle_tpu.metric.Metric, got {type(m)}")
         self._compiled = compiled
         self._compiled_steps = {}
+        # adapter selection (reference model.py:286): static mode active at
+        # prepare() time routes batches through the captured-Program path
+        from ..static.program import in_static_mode
+
+        self._static_adapter = _StaticGraphAdapter(self) if in_static_mode() else None
 
     # ---- compiled step construction ----------------------------------------
     def _apply_loss(self, outputs, labels):
@@ -186,6 +305,8 @@ class Model:
         self.network.train()
         ins = self._as_arrays(inputs)
         labs = self._as_arrays(labels)
+        if getattr(self, "_static_adapter", None) is not None:
+            return self._static_adapter.train_batch(ins, labs)
         if not self._compiled:
             return self._train_batch_eager(ins, labs)
         params, buffers = state_dict_arrays(self.network)

@@ -1,12 +1,22 @@
-"""incubate.autotune — kernel/layout/dataloader tuning config.
+"""incubate.autotune — kernel/layout/dataloader tuning.
 
-Reference parity: python/paddle/incubate/autotune.py. On TPU, kernel
-selection is XLA's autotuner; this records the config and applies the
-dataloader knobs.
+Reference parity: python/paddle/incubate/autotune.py (set_config with
+kernel/layout/dataloader sections; the reference benchmarks cuDNN algos and
+dataloader num_workers). TPU-native: XLA owns op-level kernel selection, so
+the "kernel" section tunes what XLA cannot see — the Pallas flash-attention
+tile sizes (FLAGS_pallas_block_q/k) — by measuring real candidate configs on
+device. The "dataloader" section sizes num_workers from a measured per-item
+cost, the same decision the reference's dataloader autotuner makes.
 """
 from __future__ import annotations
 
-_CONFIG = {"kernel": {"enable": True}, "layout": {"enable": True}, "dataloader": {"enable": False}}
+import time
+
+_CONFIG = {
+    "kernel": {"enable": True},
+    "layout": {"enable": True},
+    "dataloader": {"enable": False},
+}
 
 
 def set_config(config=None):
@@ -18,3 +28,71 @@ def set_config(config=None):
 
 def get_config():
     return dict(_CONFIG)
+
+
+def tune_flash_attention(batch, seq_len, num_heads, head_dim,
+                         causal=True, dtype="bfloat16",
+                         candidates=((128, 512), (256, 512), (256, 1024),
+                                     (512, 512), (512, 1024)),
+                         iters=5):
+    """Benchmark Pallas flash-attention tile candidates on the REAL shape and
+    set FLAGS_pallas_block_q/k to the winner. Returns {(bq, bk): seconds}.
+
+    Call once at model-setup time (compiles one kernel per candidate)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..flags import set_flags
+    from ..ops.pallas.flash_attention import flash_attention_array
+
+    rs = np.random.RandomState(0)
+    shape = (batch, seq_len, num_heads, head_dim)
+    q = jnp.asarray(rs.rand(*shape).astype(np.float32)).astype(dtype)
+    results = {}
+    for bq, bk in candidates:
+        if seq_len % bq or seq_len % bk:
+            continue
+
+        def run(x):
+            o = flash_attention_array(x, x, x, causal=causal,
+                                      block_q=bq, block_k=bk)
+            return o, x + o * 0  # chained: dedupe-proof
+
+        jf = jax.jit(run)
+        try:
+            out = jf(q)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            cur = q
+            for _ in range(iters):
+                o, cur = jf(cur)
+            jax.block_until_ready(o)
+            results[(bq, bk)] = (time.perf_counter() - t0) / iters
+        except Exception:  # noqa: BLE001 — an invalid tile config just loses
+            continue
+    if results:
+        best = min(results, key=results.get)
+        set_flags({"FLAGS_pallas_block_q": best[0],
+                   "FLAGS_pallas_block_k": best[1]})
+    return results
+
+
+def tune_dataloader_workers(dataset, probe_items=8, target_step_s=0.002):
+    """Pick DataLoader num_workers from a measured per-item decode cost:
+    cheap datasets stay in-process (workers cost more than they save);
+    expensive ones get enough workers to hide their cost."""
+    import os
+
+    n = min(probe_items, len(dataset))
+    if n == 0:
+        return 0
+    t0 = time.perf_counter()
+    for i in range(n):
+        dataset[i]
+    per_item = (time.perf_counter() - t0) / n
+    if per_item < target_step_s:
+        return 0
+    workers = min(os.cpu_count() or 1, max(1, int(per_item / target_step_s)))
+    return min(workers, 8)

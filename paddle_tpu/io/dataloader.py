@@ -148,7 +148,9 @@ class DataLoader:
         ctx = mp.get_context("fork")
         index_queue = ctx.Queue()
         data_queue = ctx.Queue()
-        seed = np.random.randint(0, 2**31)
+        from ..core.rng import host_generator
+
+        seed = int(host_generator().integers(0, 2**31))
         workers = [
             ctx.Process(
                 target=_worker_loop,

@@ -93,10 +93,12 @@ class Subset(Dataset):
 
 
 def random_split(dataset, lengths, generator=None):
+    from ..core.rng import host_generator
+
     total = sum(lengths)
     if total != len(dataset):
         raise ValueError("sum of lengths must equal dataset size")
-    perm = np.random.permutation(total)
+    perm = (generator or host_generator()).permutation(total)
     out, off = [], 0
     for n in lengths:
         out.append(Subset(dataset, perm[off : off + n].tolist()))

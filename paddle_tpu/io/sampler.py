@@ -34,10 +34,13 @@ class RandomSampler(Sampler):
         return self._num_samples or len(self.data_source)
 
     def __iter__(self):
+        from ..core.rng import host_generator
+
         n = len(self.data_source)
+        g = host_generator()
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+            return iter(g.integers(0, n, self.num_samples).tolist())
+        return iter(g.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
@@ -48,7 +51,9 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        return iter(np.random.permutation(self.indices).tolist())
+        from ..core.rng import host_generator
+
+        return iter(host_generator().permutation(self.indices).tolist())
 
     def __len__(self):
         return len(self.indices)
@@ -63,9 +68,11 @@ class WeightedRandomSampler(Sampler):
         self.replacement = replacement
 
     def __iter__(self):
+        from ..core.rng import host_generator
+
         p = self.weights / self.weights.sum()
         return iter(
-            np.random.choice(
+            host_generator().choice(
                 len(self.weights), self.num_samples, self.replacement, p
             ).tolist()
         )

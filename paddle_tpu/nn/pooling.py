@@ -6,13 +6,17 @@ from .layer import Layer
 
 
 class _Pool(Layer):
-    def __init__(self, kernel_size=None, stride=None, padding=0, ceil_mode=False, **kw):
+    def __init__(self, kernel_size=None, stride=None, padding=0, ceil_mode=False, data_format=None, **kw):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
+        self.data_format = data_format
         self.kw = kw
+
+    def _df(self, default):
+        return self.data_format or default
 
     def extra_repr(self):
         return f"kernel_size={self.kernel_size}, stride={self.stride}, padding={self.padding}"
@@ -20,65 +24,78 @@ class _Pool(Layer):
 
 class MaxPool1D(_Pool):
     def forward(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding, ceil_mode=self.ceil_mode)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode, data_format=self._df("NCL"))
 
 
 class MaxPool2D(_Pool):
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding, ceil_mode=self.ceil_mode)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode, data_format=self._df("NCHW"))
 
 
 class MaxPool3D(_Pool):
     def forward(self, x):
-        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding, ceil_mode=self.ceil_mode)
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode, data_format=self._df("NCDHW"))
 
 
 class AvgPool1D(_Pool):
     def forward(self, x):
-        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding, ceil_mode=self.ceil_mode)
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode, data_format=self._df("NCL"))
 
 
 class AvgPool2D(_Pool):
     def forward(self, x):
-        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding, ceil_mode=self.ceil_mode)
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode, data_format=self._df("NCHW"))
 
 
 class AvgPool3D(_Pool):
     def forward(self, x):
-        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding, ceil_mode=self.ceil_mode)
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode, data_format=self._df("NCDHW"))
 
 
 class _AdaptivePool(Layer):
     def __init__(self, output_size, **kw):
         super().__init__()
         self.output_size = output_size
+        self.kw = kw
 
 
 class AdaptiveAvgPool1D(_AdaptivePool):
     def forward(self, x):
-        return F.adaptive_avg_pool1d(x, self.output_size)
+        return F.adaptive_avg_pool1d(x, self.output_size,
+                                      data_format=self.kw.get("data_format") or "NCW")
 
 
 class AdaptiveAvgPool2D(_AdaptivePool):
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     data_format=self.kw.get("data_format") or "NCHW")
 
 
 class AdaptiveAvgPool3D(_AdaptivePool):
     def forward(self, x):
-        return F.adaptive_avg_pool3d(x, self.output_size)
+        return F.adaptive_avg_pool3d(x, self.output_size,
+                                      data_format=self.kw.get("data_format") or "NCDHW")
 
 
 class AdaptiveMaxPool1D(_AdaptivePool):
     def forward(self, x):
-        return F.adaptive_max_pool1d(x, self.output_size)
+        return F.adaptive_max_pool1d(x, self.output_size,
+                                      data_format=self.kw.get("data_format") or "NCW")
 
 
 class AdaptiveMaxPool2D(_AdaptivePool):
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size,
+                                      data_format=self.kw.get("data_format") or "NCHW")
 
 
 class AdaptiveMaxPool3D(_AdaptivePool):
     def forward(self, x):
-        return F.adaptive_max_pool3d(x, self.output_size)
+        return F.adaptive_max_pool3d(x, self.output_size,
+                                      data_format=self.kw.get("data_format") or "NCDHW")

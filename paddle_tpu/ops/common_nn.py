@@ -209,16 +209,18 @@ def scaled_dot_product_attention(
     from .pallas.flash_attention import flash_attention_array
 
     qt, kt, vt = T(query), T(key), T(value)
-    mask_arr = T(attn_mask)._array if attn_mask is not None else None
     drop_key = rng.next_key() if (dropout_p > 0 and training) else None
+    # the mask rides as a real op INPUT (trainable additive biases get
+    # gradients; static capture sees it as data, not a baked constant)
+    args = (qt, kt, vt) + ((T(attn_mask),) if attn_mask is not None else ())
 
-    def f(q, k, v):
+    def f(q, k, v, *mask):
         return flash_attention_array(
-            q, k, v, mask=mask_arr, causal=is_causal,
+            q, k, v, mask=mask[0] if mask else None, causal=is_causal,
             dropout_p=dropout_p if training else 0.0, dropout_key=drop_key,
         )
 
-    out, node = autograd.apply(f, qt, kt, vt, name="sdpa")
+    out, node = autograd.apply(f, *args, name="sdpa")
     return Tensor._from_op(out, node)
 
 

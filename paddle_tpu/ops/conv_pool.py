@@ -46,11 +46,14 @@ def _conv_padding(padding, nsp, strides=None):
 
 
 def _dim_numbers(nsp, channel_last):
+    # weights are ALWAYS stored OI+spatial (paddle convention) — data_format
+    # only changes the activation layout, never the parameter layout, so a
+    # state_dict moves freely between NCHW and NHWC models
     if nsp == 1:
-        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+        return ("NWC", "OIW", "NWC") if channel_last else ("NCW", "OIW", "NCW")
     if nsp == 2:
-        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
-    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+        return ("NHWC", "OIHW", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "OIDHW", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
 
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, nsp, data_format):
@@ -262,8 +265,8 @@ def _adaptive_pool(x, output_size, nsp, data_format, kind):
     return op(f, xt, name=f"adaptive_{kind}_pool{nsp}d")
 
 
-def adaptive_avg_pool1d(x, output_size, name=None):
-    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+def adaptive_avg_pool1d(x, output_size, data_format="NCW", name=None):
+    return _adaptive_pool(x, output_size, 1, data_format, "avg")
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
@@ -274,16 +277,16 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, output_size, 3, data_format, "avg")
 
 
-def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 1, "NCW", "max")
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCW", name=None):
+    return _adaptive_pool(x, output_size, 1, data_format, "max")
 
 
-def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "max")
 
 
-def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+def adaptive_max_pool3d(x, output_size, return_mask=False, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "max")
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):

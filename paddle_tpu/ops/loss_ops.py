@@ -36,11 +36,13 @@ def cross_entropy(
     name=None,
 ):
     it, lt = T(input), T(label)
-    larr = lt._array
     has_w = weight is not None
-    args = [it] + ([T(weight)] if has_w else [])
+    # label is a real op INPUT (not a closure capture): static-graph capture
+    # must see it as data so Executor feeds flow into the replay; jax.vjp
+    # hands integer inputs a float0 cotangent, so autograd is unaffected
+    args = [it, lt] + ([T(weight)] if has_w else [])
 
-    def f(logits, *w):
+    def f(logits, larr, *w):
         lg = jnp.moveaxis(logits, axis, -1) if axis not in (-1, logits.ndim - 1) else logits
         n_classes = lg.shape[-1]
         logp = jax.nn.log_softmax(lg, axis=-1) if use_softmax else jnp.log(
@@ -107,11 +109,11 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", nam
 
 def _nll(input, label, weight, ignore_index, reduction):
     it, lt = T(input), T(label)
-    larr = lt._array.astype(jnp.int32)
     has_w = weight is not None
-    args = [it] + ([T(weight)] if has_w else [])
+    args = [it, lt] + ([T(weight)] if has_w else [])
 
-    def f(logp, *w):
+    def f(logp, larr, *w):
+        larr = larr.astype(jnp.int32)
         valid = larr != ignore_index
         safe = jnp.where(valid, larr, 0)
         picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
@@ -265,19 +267,19 @@ def square_error_cost(input, label, name=None):
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
     lt = T(logit)
     yt = T(label)
-    norm = T(normalizer)._array if normalizer is not None else None
+    args = (lt, yt) + ((T(normalizer),) if normalizer is not None else ())
 
-    def f(x, y):
+    def f(x, y, *norm):
         p = jax.nn.sigmoid(x)
         ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
         p_t = p * y + (1 - p) * (1 - y)
         a_t = alpha * y + (1 - alpha) * (1 - y)
         loss = a_t * jnp.power(1 - p_t, gamma) * ce
-        if norm is not None:
-            loss = loss / norm
+        if norm:
+            loss = loss / norm[0]
         return _reduce(loss, reduction)
 
-    out, node = autograd.apply(f, lt, yt, name="sigmoid_focal_loss")
+    out, node = autograd.apply(f, *args, name="sigmoid_focal_loss")
     return Tensor._from_op(out, node)
 
 

@@ -409,10 +409,11 @@ def multiplex(inputs, index, name=None):
     from ..core import autograd
 
     tensors = tuple(T(t) for t in inputs)
-    idx = T(index)._array.reshape(-1)
     out, node = autograd.apply(
-        lambda *arrs: jnp.stack(arrs)[idx, jnp.arange(arrs[0].shape[0])],
-        *tensors,
+        lambda idx, *arrs: jnp.stack(arrs)[
+            idx.reshape(-1), jnp.arange(arrs[0].shape[0])
+        ],
+        T(index), *tensors,
         name="multiplex",
     )
     return Tensor._from_op(out, node)

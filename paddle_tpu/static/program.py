@@ -106,7 +106,8 @@ class Program:
 
         return externals, run
 
-    def _external_values(self, externals):
+    @staticmethod
+    def _external_values(externals):
         vals = []
         for aid, tref in externals:
             if isinstance(tref, Tensor):

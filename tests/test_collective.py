@@ -152,3 +152,4 @@ def test_two_process_collectives():
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"COLLECTIVE_OK rank={r}" in out, out
+        assert f"P2P_TIMEOUT_OK rank={r}" in out, out
