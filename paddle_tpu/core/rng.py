@@ -28,6 +28,13 @@ class _KeyState(threading.local):
 
 _state = _KeyState()
 
+# host-side numpy Generator: PROCESS-global (not thread-local) because the
+# DataLoader's prefetch thread is where samplers actually iterate — a
+# thread-local would silently hand that thread a fresh OS-entropy stream and
+# paddle.seed would never reach the shuffle order
+_host_lock = threading.Lock()
+_host_gen = None
+
 
 def seed(s: int):
     """paddle.seed parity: seeds the device RNG stream AND paddle's own
@@ -35,30 +42,46 @@ def seed(s: int):
     reference's global seed reaches its CPU generators the same way
     (framework/random.py). numpy's GLOBAL state is deliberately left alone:
     a library call must not clobber user np.random streams."""
-    import numpy as _np
+    global _host_gen
 
     _state.key = jax.random.PRNGKey(int(s))
     _state.counter = 0
-    _state.host = _np.random.default_rng(int(s) % (2**31))
+    with _host_lock:
+        _host_gen = np.random.default_rng(int(s) % (2**31))
     return s
 
 
 def host_generator():
     """paddle's host-side numpy Generator (shuffles, samplers). Seeded by
-    paddle.seed; lazily random otherwise."""
-    import numpy as _np
+    paddle.seed; lazily random otherwise. Process-global so the DataLoader
+    prefetch thread draws from the seeded stream."""
+    global _host_gen
 
-    if getattr(_state, "host", None) is None:
-        _state.host = _np.random.default_rng()
-    return _state.host
+    with _host_lock:
+        if _host_gen is None:
+            _host_gen = np.random.default_rng()
+        return _host_gen
 
 
 def get_rng_state():
-    return (_state.key, _state.counter)
+    """Full RNG snapshot: device (key, counter) + the host generator's
+    bit-generator state, so a round-trip also restores sampler/shuffle
+    streams (the reference's get_rng_state covers its CPU generators too)."""
+    host = host_generator().bit_generator.state
+    return (_state.key, _state.counter, host)
 
 
 def set_rng_state(st):
-    _state.key, _state.counter = st
+    global _host_gen
+
+    if len(st) == 2:  # pre-r4 snapshots: device state only
+        _state.key, _state.counter = st
+        return
+    _state.key, _state.counter, host = st
+    with _host_lock:
+        if _host_gen is None:
+            _host_gen = np.random.default_rng()
+        _host_gen.bit_generator.state = host
 
 
 def next_key():

@@ -42,9 +42,11 @@ def rendezvous(master, nnodes, rank, job_id, timeout=300.0):
     """Master-based rendezvous (reference launch/controllers/master.py:65,177
     HTTP/etcd master, TPU-native over the csrc TCPStore):
 
-    - rank 0 hosts the store at master_port + 5; peers connect to it
-    - rank -1 means "assign me one": an atomic counter hands out ranks, so
-      nodes can join with NO pre-set rank or endpoint env at all
+    - the node on the MASTER HOST serves the store at master_port + 5 (first
+      local binder wins); every other node connects to it
+    - rank -1 means "assign me one": after all nodes register intent,
+      unclaimed ranks are handed out atomically, so nodes can join with NO
+      pre-set rank or endpoint env and mix freely with explicit-rank nodes
     - every node publishes its reachable IP; all block until nnodes have
       registered, then read back the full peer table
     - rank 0 also mints the per-job RPC authkey (distributed through the
@@ -56,15 +58,18 @@ def rendezvous(master, nnodes, rank, job_id, timeout=300.0):
 
     host, port = master.rsplit(":", 1)
     store_port = int(port) + _RDZV_PORT_OFFSET
-    want_master = rank in (0, -1)
+    my_ip = _local_ip(host)
+    # only a node ON the master host may try to serve the store: a bind on a
+    # different machine would succeed locally (the port is free THERE), leak
+    # a listener, and mislead the who-is-master race
+    on_master_host = my_ip == "127.0.0.1" or host in (my_ip, "localhost")
     store = None
-    if want_master:
-        # with auto-assigned ranks, every node races to host; losers connect
+    if on_master_host:
         try:
             store = TCPStore(host, store_port, is_master=True,
                              world_size=nnodes, timeout=int(timeout))
         except RuntimeError:
-            store = None
+            store = None  # another local node already serves it
     if store is None:
         deadline = time.monotonic() + timeout
         while True:
@@ -78,26 +83,37 @@ def rendezvous(master, nnodes, rank, job_id, timeout=300.0):
                 time.sleep(0.5)
 
     pfx = f"rdzv/{job_id}"
-    # rank claims are atomic counters: mixing explicit NODE_RANK nodes with
-    # auto-assigned (-1) nodes cannot produce duplicates — auto nodes skip
-    # claimed ranks, explicit double-claims fail loudly
-    if rank == -1:
-        while True:
-            cand = store.add(f"{pfx}/next_rank", 1) - 1
-            if cand >= nnodes:
-                raise RuntimeError(
-                    f"rendezvous: all {nnodes} ranks already claimed "
-                    "(more nodes launched than --nnodes?)"
-                )
-            if store.add(f"{pfx}/claim/{cand}", 1) == 1:
-                rank = cand
-                break
-    elif store.add(f"{pfx}/claim/{rank}", 1) != 1:
+    # TWO-PHASE rank assignment so explicit NODE_RANK nodes and
+    # auto-assigned (-1) nodes mix safely: phase 1 registers every node's
+    # intent (explicit nodes claim their rank; double-claims fail loudly);
+    # only after ALL nnodes intents are in do auto nodes pick from the
+    # unclaimed ranks — an auto node can never steal a rank an explicit
+    # node is about to claim.
+    if rank >= 0 and store.add(f"{pfx}/claim/{rank}", 1) != 1:
         raise RuntimeError(
             f"rendezvous: rank {rank} claimed twice — two nodes were "
             "launched with the same NODE_RANK/--rank"
         )
-    my_ip = _local_ip(host)
+    n_int = store.add(f"{pfx}/intents", 1)
+    deadline = time.monotonic() + timeout
+    while n_int < nnodes:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"rendezvous: only {n_int}/{nnodes} nodes registered intent "
+                f"within {timeout}s"
+            )
+        time.sleep(0.2)
+        n_int = store.add(f"{pfx}/intents", 0)
+    if rank == -1:
+        for cand in range(nnodes):
+            if store.add(f"{pfx}/claim/{cand}", 1) == 1:
+                rank = cand
+                break
+        else:
+            raise RuntimeError(
+                f"rendezvous: all {nnodes} ranks already claimed "
+                "(more nodes launched than --nnodes?)"
+            )
     store.set(f"{pfx}/node/{rank}", f"{my_ip}:{int(port) + 100 + rank}")
     if rank == 0:
         store.set(f"{pfx}/authkey", secrets.token_hex(16))

@@ -59,43 +59,46 @@ class AvgPool3D(_Pool):
 
 
 class _AdaptivePool(Layer):
-    def __init__(self, output_size, **kw):
+    def __init__(self, output_size, data_format=None, **kw):
         super().__init__()
         self.output_size = output_size
-        self.kw = kw
+        self.data_format = data_format
+
+    def _df(self, default):
+        return self.data_format or default
 
 
 class AdaptiveAvgPool1D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_avg_pool1d(x, self.output_size,
-                                      data_format=self.kw.get("data_format") or "NCW")
+                                      data_format=self._df("NCW"))
 
 
 class AdaptiveAvgPool2D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_avg_pool2d(x, self.output_size,
-                                     data_format=self.kw.get("data_format") or "NCHW")
+                                     data_format=self._df("NCHW"))
 
 
 class AdaptiveAvgPool3D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_avg_pool3d(x, self.output_size,
-                                      data_format=self.kw.get("data_format") or "NCDHW")
+                                      data_format=self._df("NCDHW"))
 
 
 class AdaptiveMaxPool1D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_max_pool1d(x, self.output_size,
-                                      data_format=self.kw.get("data_format") or "NCW")
+                                      data_format=self._df("NCW"))
 
 
 class AdaptiveMaxPool2D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_max_pool2d(x, self.output_size,
-                                      data_format=self.kw.get("data_format") or "NCHW")
+                                      data_format=self._df("NCHW"))
 
 
 class AdaptiveMaxPool3D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size,
-                                      data_format=self.kw.get("data_format") or "NCDHW")
+                                      data_format=self._df("NCDHW"))

@@ -31,21 +31,22 @@ class QuantConfig:
 
 
 class AbsmaxObserver:
-    """Device-side absmax tracker: state is a jax scalar, updates are
-    jnp.maximum — no host sync, so observation compiles under jit and PTQ
-    calibration can run inside the compiled path (r3 verdict weak #6)."""
+    """Standalone absmax tracker (API-parity shim for user calibration
+    loops). The framework's own QAT path does NOT use this — QuantedLinear
+    tracks absmax in a registered buffer so calibration compiles under jit;
+    this class is the plain eager utility with float state."""
 
     def __init__(self, bits=8):
         self.bits = bits
-        self.absmax = jnp.zeros((), jnp.float32)
+        self.absmax = 0.0
 
     def observe(self, arr):
-        self.absmax = jnp.maximum(
-            self.absmax, jnp.abs(arr).max().astype(jnp.float32)
-        )
+        import numpy as _np
+
+        self.absmax = max(self.absmax, float(_np.abs(_np.asarray(arr)).max()))
 
     def scale(self):
-        return jnp.maximum(self.absmax, 1e-8)
+        return max(self.absmax, 1e-8)
 
 
 class QuantedLinear(Layer):

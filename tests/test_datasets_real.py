@@ -242,3 +242,32 @@ def test_esc50_filename_labels(tmp_path):
     ds = paddle.audio.datasets.ESC50(mode="train", split=1.0, archive_path=str(tmp_path))
     labels = sorted(int(ds[i][1]) for i in range(len(ds)))
     assert labels == [7, 14]
+
+
+def test_dataloader_shuffle_deterministic_under_seed():
+    """paddle.seed must reach the shuffle stream even though samplers
+    iterate on the DataLoader's PREFETCH THREAD (r4 review: a thread-local
+    host generator silently broke this)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.io as io
+
+    class DS:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return np.asarray([i], np.int64)
+
+    def epoch():
+        paddle.seed(123)
+        loader = io.DataLoader(DS(), batch_size=8, shuffle=True)
+        return [tuple(np.asarray(b).reshape(-1).tolist()) for b in loader]
+
+    a = epoch()
+    b = epoch()
+    assert a == b, (a, b)
+    # and it IS shuffled
+    flat = [x for t in a for x in t]
+    assert flat != sorted(flat)

@@ -380,6 +380,136 @@ CASES += [
     OpCase("unfold", CP.unfold, lambda rs: (rs.rand(1, 2, 4, 4).astype(np.float32),), None, kwargs={"kernel_sizes": 2}, gtol=1e-2),
 ]
 
+# ---- numpy references for the formerly shape/grad-only cases ---------------
+# (r3 verdict weak #9: burn the skip list down). Each implements the
+# documented paddle semantics independently in numpy — loops over tiny
+# shapes, not a translation of the jnp code.
+
+def _np_conv(x, w, stride=1):
+    """Cross-correlation, VALID padding. x [N,C,*sp], w [O,C,*k]."""
+    N, C = x.shape[:2]
+    O = w.shape[0]
+    sp, k = x.shape[2:], w.shape[2:]
+    nd = len(sp)
+    out_sp = tuple((s - kk) // stride + 1 for s, kk in zip(sp, k))
+    out = np.zeros((N, O) + out_sp, np.float32)
+    for idx in np.ndindex(*out_sp):
+        sl = (slice(None), slice(None)) + tuple(
+            slice(i * stride, i * stride + kk) for i, kk in zip(idx, k)
+        )
+        patch = x[sl]  # [N, C, *k]
+        axes = list(range(1, nd + 2))
+        out[(slice(None), slice(None)) + idx] = np.tensordot(patch, w, (axes, axes))
+    return out
+
+
+def _np_conv_transpose(x, w, stride=1):
+    """x [N,I,*sp], w [I,O,*k] (paddle transpose-conv weight layout)."""
+    N, I = x.shape[:2]
+    O = w.shape[1]
+    sp, k = x.shape[2:], w.shape[2:]
+    out_sp = tuple((s - 1) * stride + kk for s, kk in zip(sp, k))
+    out = np.zeros((N, O) + out_sp, np.float32)
+    for n in range(N):
+        for idx in np.ndindex(*sp):
+            vec = x[(n, slice(None)) + idx]  # [I]
+            for o in range(O):
+                region = tuple(
+                    slice(i * stride, i * stride + kk) for i, kk in zip(idx, k)
+                )
+                out[(n, o) + region] += np.tensordot(vec, w[:, o], (0, 0))
+    return out
+
+
+def _np_pool2d(x, k, mode):
+    N, C, H, W = x.shape
+    out = np.zeros((N, C, H // k, W // k), np.float32)
+    red = np.max if mode == "max" else np.mean
+    for i in range(H // k):
+        for j in range(W // k):
+            out[:, :, i, j] = red(
+                x[:, :, i * k:(i + 1) * k, j * k:(j + 1) * k], axis=(2, 3)
+            )
+    return out
+
+
+def _np_pool1d(x, k, mode):
+    N, C, L = x.shape
+    red = np.max if mode == "max" else np.mean
+    return np.stack(
+        [red(x[:, :, i * k:(i + 1) * k], axis=2) for i in range(L // k)], axis=2
+    )
+
+
+def _np_pixel_shuffle(a, r):
+    n, c, h, w = a.shape
+    a = a.reshape(n, c // (r * r), r, r, h, w)
+    return a.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
+
+
+def _np_pixel_unshuffle(a, r):
+    n, c, h, w = a.shape
+    a = a.reshape(n, c, h // r, r, w // r, r)
+    return a.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+
+
+def _np_unfold(x, k):
+    """im2col: [N, C*k*k, L], channel-major columns, row-major positions."""
+    N, C, H, W = x.shape
+    cols = []
+    for i in range(H - k + 1):
+        for j in range(W - k + 1):
+            cols.append(x[:, :, i:i + k, j:j + k].reshape(N, C * k * k))
+    return np.stack(cols, axis=2)
+
+
+def _np_frame(x, fl, hop):
+    """signal.frame: out[..., l, f] = x[..., f*hop + l]."""
+    n_frames = (x.shape[-1] - fl) // hop + 1
+    return np.stack([x[..., f * hop: f * hop + fl] for f in range(n_frames)], -1)
+
+
+_NEW_REFS = {
+    "scatter_nd_add": lambda x, idx, upd: (
+        lambda o: (np.add.at(o, idx.reshape(-1), upd), o)[1]
+    )(x.copy()),
+    "conv2d": _np_conv,
+    "conv1d": _np_conv,
+    "conv3d": _np_conv,
+    "conv2d_transpose": _np_conv_transpose,
+    "conv1d_transpose": _np_conv_transpose,
+    "max_pool2d": lambda x: _np_pool2d(x, 2, "max"),
+    "avg_pool2d": lambda x: _np_pool2d(x, 2, "avg"),
+    "adaptive_avg_pool2d": lambda x: _np_pool2d(x, 2, "avg"),  # 4->2 = k2
+    "adaptive_max_pool2d": lambda x: _np_pool2d(x, 2, "max"),
+    "max_pool1d": lambda x: _np_pool1d(x, 2, "max"),
+    "avg_pool1d": lambda x: _np_pool1d(x, 2, "avg"),
+    "pixel_shuffle": lambda x: _np_pixel_shuffle(x, 2),
+    "pixel_unshuffle": lambda x: _np_pixel_unshuffle(x, 2),
+    "unfold": lambda x: _np_unfold(x, 2),
+    "signal_frame": lambda x: _np_frame(x, 4, 2),
+    "smooth_l1_loss": lambda a, b: float(np.mean(np.where(
+        np.abs(a - b) < 1.0, 0.5 * (a - b) ** 2, np.abs(a - b) - 0.5))),
+    "huber_loss": lambda a, b: float(np.mean(np.where(
+        np.abs(a - b) < 1.0, 0.5 * (a - b) ** 2, np.abs(a - b) - 0.5))),
+    "kl_div": lambda lp, y: float(np.mean(y * (np.log(np.maximum(y, 1e-30)) - lp))),
+    "softmax_with_cross_entropy": lambda x, t: (
+        -np.log(_softmax_np(x))[np.arange(x.shape[0]), t[:, 0]][:, None]
+    ),
+    "margin_ranking_loss": lambda a, b, y: float(np.mean(np.maximum(0.0, -y * (a - b)))),
+    "hinge_embedding_loss": lambda a, y: float(np.mean(np.where(y == 1, a, np.maximum(0.0, 1.0 - a)))),
+    "sigmoid_focal_loss": lambda x, y: float(np.sum(
+        (0.25 * y + 0.75 * (1 - y))
+        * (1 - (1 / (1 + np.exp(-x)) * y + (1 - 1 / (1 + np.exp(-x))) * (1 - y))) ** 2
+        * (np.maximum(x, 0) - x * y + np.log1p(np.exp(-np.abs(x)))))),
+    "triplet_margin_loss": lambda a, p_, n_: float(np.mean(np.maximum(
+        np.sqrt(np.sum((np.abs(a - p_) + 1e-6) ** 2, -1))
+        - np.sqrt(np.sum((np.abs(a - n_) + 1e-6) ** 2, -1)) + 1.0, 0.0))),
+}
+for c in CASES:
+    if c.ref is None and c.name in _NEW_REFS:
+        c.ref = _NEW_REFS[c.name]
+
 # apply whitelist relaxations / removals
 for c in CASES:
     if c.name in FWD_RTOL:
