@@ -1,0 +1,254 @@
+// C ABI for the inference predictor (reference parity:
+// paddle/fluid/inference/capi_exp/pd_inference_api.h — PD_PredictorCreate /
+// PD_PredictorRun / tensor IO as a stable C surface for non-Python callers).
+//
+// TPU-native design: the predictor executes a jit.save'd StableHLO artifact
+// through jax/PjRt, and jaxlib owns that C++ runtime; re-implementing its
+// loader in C++ would duplicate jaxlib (see README "native C++ PjRt
+// substrate" note). This shim therefore embeds CPython and drives
+// paddle_tpu.inference from C — the same layering as the reference's C API,
+// which wraps its C++ predictor rather than re-implementing it. A C (or Go,
+// via cgo) serving process links this .so, never touches Python headers,
+// and ships float32 buffers in/out.
+//
+// Thread-model: one interpreter; ALL PD_* calls serialize on one library
+// mutex (plus the GIL for the Python work) — the initializer releases the
+// GIL after embedding so other threads can acquire it. Handles are opaque
+// pointers owned by the library; every PD_* call is safe to make from any
+// thread, at mutual-exclusion (not parallel) semantics.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::once_flag g_init_once;
+std::mutex g_call_mutex;  // serializes every PD_* entry point
+std::string g_last_error;
+
+void set_error(const char* what) {
+  g_last_error = what ? what : "unknown error";
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    if (value) {
+      PyObject* s = PyObject_Str(value);
+      if (s) {
+        g_last_error += ": ";
+        g_last_error += PyUnicode_AsUTF8(s);
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+}
+
+struct Predictor {
+  PyObject* predictor;  // paddle_tpu.inference.Predictor
+  std::vector<std::vector<float>> outputs;
+  std::vector<std::vector<int64_t>> output_shapes;
+};
+
+void ensure_python() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // drop the GIL the initializing thread holds, or every OTHER
+      // thread's PyGILState_Ensure would block forever
+      PyEval_SaveThread();
+    }
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* PD_GetLastError() {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  return g_last_error.c_str();
+}
+
+// Create a predictor from a jit.save'd artifact path (model_path as passed
+// to paddle_tpu.jit.save). Returns nullptr on failure (see PD_GetLastError).
+void* PD_PredictorCreate(const char* model_path) {
+  ensure_python();
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Predictor* h = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    set_error("import paddle_tpu.inference failed");
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  PyObject* cfg_cls = PyObject_GetAttrString(mod, "Config");
+  PyObject* create = PyObject_GetAttrString(mod, "create_predictor");
+  PyObject* cfg =
+      cfg_cls ? PyObject_CallFunction(cfg_cls, "s", model_path) : nullptr;
+  PyObject* pred = cfg ? PyObject_CallFunctionObjArgs(create, cfg, nullptr) : nullptr;
+  if (pred) {
+    h = new Predictor();
+    h->predictor = pred;
+  } else {
+    set_error("create_predictor failed");
+  }
+  Py_XDECREF(cfg);
+  Py_XDECREF(create);
+  Py_XDECREF(cfg_cls);
+  Py_DECREF(mod);
+  PyGILState_Release(gil);
+  return h;
+}
+
+// Run on ONE float32 input tensor of the given shape. Returns the number of
+// outputs (>=1) or -1 on error. Outputs are cached on the handle until the
+// next run; read them with PD_GetOutput*.
+int PD_PredictorRun(void* handle, const float* data, const int64_t* shape,
+                    int ndim) {
+  auto* h = static_cast<Predictor*>(handle);
+  if (!h) return -1;
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int n_out = -1;
+  // build a nested-list-free numpy array via the buffer API: construct
+  // bytes + numpy.frombuffer(...).reshape(shape)
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; ++i) numel *= shape[i];
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* arr = nullptr;
+  if (np) {
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(data), numel * sizeof(float));
+    PyObject* frombuffer = PyObject_GetAttrString(np, "frombuffer");
+    PyObject* flat =
+        bytes ? PyObject_CallFunction(frombuffer, "Os", bytes, "float32")
+              : nullptr;
+    if (flat) {
+      PyObject* shp = PyTuple_New(ndim);
+      for (int i = 0; i < ndim; ++i)
+        PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+      arr = PyObject_CallMethod(flat, "reshape", "O", shp);
+      Py_DECREF(shp);
+      Py_DECREF(flat);
+    }
+    Py_XDECREF(frombuffer);
+    Py_XDECREF(bytes);
+  }
+  if (arr) {
+    PyObject* inputs = PyList_New(1);
+    Py_INCREF(arr);
+    PyList_SET_ITEM(inputs, 0, arr);
+    PyObject* outs =
+        PyObject_CallMethod(h->predictor, "run", "O", inputs);
+    Py_DECREF(inputs);
+    if (outs) {
+      h->outputs.clear();
+      h->output_shapes.clear();
+      Py_ssize_t n = PySequence_Length(outs);
+      PyObject* npmod = PyImport_ImportModule("numpy");
+      PyObject* ascontig =
+          PyObject_GetAttrString(npmod, "ascontiguousarray");
+      bool conv_ok = true;
+      for (Py_ssize_t i = 0; i < n && conv_ok; ++i) {
+        PyObject* o = PySequence_GetItem(outs, i);
+        PyObject* of =
+            o ? PyObject_CallMethod(o, "astype", "s", "float32") : nullptr;
+        PyObject* oc =
+            of ? PyObject_CallFunctionObjArgs(ascontig, of, nullptr) : nullptr;
+        PyObject* shape_obj = oc ? PyObject_GetAttrString(oc, "shape") : nullptr;
+        PyObject* flat = oc ? PyObject_CallMethod(oc, "reshape", "i", -1) : nullptr;
+        PyObject* bytes_obj =
+            flat ? PyObject_CallMethod(flat, "tobytes", nullptr) : nullptr;
+        if (shape_obj && bytes_obj) {
+          std::vector<int64_t> shp;
+          Py_ssize_t nd = PySequence_Length(shape_obj);
+          for (Py_ssize_t d = 0; d < nd; ++d) {
+            PyObject* di = PySequence_GetItem(shape_obj, d);
+            shp.push_back(PyLong_AsLongLong(di));
+            Py_DECREF(di);
+          }
+          const char* buf = PyBytes_AsString(bytes_obj);
+          Py_ssize_t nbytes = PyBytes_Size(bytes_obj);
+          std::vector<float> vals(nbytes / sizeof(float));
+          std::memcpy(vals.data(), buf, nbytes);
+          h->outputs.push_back(std::move(vals));
+          h->output_shapes.push_back(std::move(shp));
+        } else {
+          set_error("output conversion to contiguous float32 failed");
+          conv_ok = false;
+        }
+        Py_XDECREF(bytes_obj);
+        Py_XDECREF(flat);
+        Py_XDECREF(shape_obj);
+        Py_XDECREF(oc);
+        Py_XDECREF(of);
+        Py_XDECREF(o);
+      }
+      Py_XDECREF(ascontig);
+      Py_XDECREF(npmod);
+      n_out = conv_ok ? static_cast<int>(h->outputs.size()) : -1;
+      Py_DECREF(outs);
+    } else {
+      set_error("Predictor.run failed");
+    }
+    Py_DECREF(arr);
+  } else {
+    set_error("building input array failed");
+  }
+  Py_XDECREF(np);
+  PyGILState_Release(gil);
+  return n_out;
+}
+
+int PD_GetOutputNumDims(void* handle, int idx) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  auto* h = static_cast<Predictor*>(handle);
+  if (!h || idx < 0 || idx >= static_cast<int>(h->output_shapes.size()))
+    return -1;
+  return static_cast<int>(h->output_shapes[idx].size());
+}
+
+int PD_GetOutputShape(void* handle, int idx, int64_t* shape_out) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  auto* h = static_cast<Predictor*>(handle);
+  if (!h || idx < 0 || idx >= static_cast<int>(h->output_shapes.size()))
+    return -1;
+  const auto& s = h->output_shapes[idx];
+  for (size_t i = 0; i < s.size(); ++i) shape_out[i] = s[i];
+  return static_cast<int>(s.size());
+}
+
+int64_t PD_GetOutputNumel(void* handle, int idx) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  auto* h = static_cast<Predictor*>(handle);
+  if (!h || idx < 0 || idx >= static_cast<int>(h->outputs.size())) return -1;
+  return static_cast<int64_t>(h->outputs[idx].size());
+}
+
+int PD_GetOutputData(void* handle, int idx, float* out) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  auto* h = static_cast<Predictor*>(handle);
+  if (!h || idx < 0 || idx >= static_cast<int>(h->outputs.size())) return -1;
+  std::memcpy(out, h->outputs[idx].data(),
+              h->outputs[idx].size() * sizeof(float));
+  return 0;
+}
+
+void PD_PredictorDestroy(void* handle) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  auto* h = static_cast<Predictor*>(handle);
+  if (!h) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->predictor);
+  PyGILState_Release(gil);
+  delete h;
+}
+
+}  // extern "C"

@@ -163,6 +163,7 @@ from .flags import get_flags, set_flags  # noqa: F401
 
 from .device import get_device, set_device  # noqa: F401
 from .framework.io import load, save  # noqa: F401
+from .io import batch  # noqa: F401  (legacy reader decorator, paddle.batch)
 from .hapi.model import Model  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .jit.api import to_static  # noqa: F401

@@ -1,0 +1,146 @@
+"""Tree index for tree-based retrieval (TDM-style models).
+
+Reference parity: python/paddle/distributed/fleet/dataset/index_dataset.py
+(TreeIndex over the C++ IndexWrapper — height/branch/travel/ancestor/
+layer-code queries + layerwise negative sampling).
+
+TPU-native design: the index is pure host-side integer bookkeeping feeding
+a compiled model — a complete b-ary code tree in numpy arrays (code math:
+parent(c) = (c-1)//b, children(c) = b*c+1..b*c+b) replaces the C++ wrapper;
+queries are O(height) arithmetic, layerwise sampling draws from paddle's
+seeded host generator. Build from an item list (build_from_items) rather
+than the reference's serialized proto file format."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Index:
+    def __init__(self, name):
+        self._name = name
+
+
+class TreeIndex(Index):
+    """Complete b-ary tree over item ids. Leaf codes occupy the last layer;
+    every item maps to one leaf (left-aligned)."""
+
+    def __init__(self, name, path=None, branch=2, items=None):
+        super().__init__(name)
+        if path is not None:
+            data = np.load(path, allow_pickle=False)
+            items = data["items"]
+            branch = int(data["branch"])
+        if items is None:
+            raise ValueError("TreeIndex needs `path` (saved .npz) or `items`")
+        self._build(np.asarray(items, np.int64), int(branch))
+
+    # ---- construction ------------------------------------------------------
+    def _build(self, items, branch):
+        self._branch = branch
+        n_leaf = max(1, len(items))
+        height = 1
+        while branch ** (height - 1) < n_leaf:
+            height += 1
+        self._height = height
+        first_leaf = (branch ** (height - 1) - 1) // (branch - 1) if branch > 1 else height - 1
+        self._first_leaf = first_leaf
+        self._total = first_leaf + branch ** (height - 1)
+        self._items = items
+        self._leaf_code = {int(it): first_leaf + i for i, it in enumerate(items)}
+        self._code_item = {c: i for i, c in self._leaf_code.items()}
+
+    def save(self, path):
+        np.savez(path, items=self._items, branch=self._branch)
+
+    # ---- reference query surface ------------------------------------------
+    def height(self):
+        return self._height
+
+    def branch(self):
+        return self._branch
+
+    def total_node_nums(self):
+        return self._total
+
+    def emb_size(self):
+        return self._total  # one embedding row per node code
+
+    def get_all_leafs(self):
+        return [self._leaf_code[int(i)] for i in self._items]
+
+    def get_nodes(self, codes):
+        return [self._code_item.get(int(c), -1) for c in codes]
+
+    def get_layer_codes(self, level):
+        b = self._branch
+        start = (b ** level - 1) // (b - 1) if b > 1 else level
+        return list(range(start, start + b ** level))
+
+    def get_travel_codes(self, item_id, start_level=0):
+        """Leaf-to-root ancestor codes of item_id, stopping at start_level."""
+        c = self._leaf_code[int(item_id)]
+        out = []
+        level = self._height - 1
+        while level >= start_level:
+            out.append(c)
+            c = (c - 1) // self._branch
+            level -= 1
+        return out
+
+    def get_ancestor_codes(self, ids, level):
+        out = []
+        for i in ids:
+            c = self._leaf_code[int(i)]
+            for _ in range(self._height - 1 - level):
+                c = (c - 1) // self._branch
+            out.append(c)
+        return out
+
+    def get_children_codes(self, ancestor, level):
+        """Codes at `level` descending from ancestor (one level above)."""
+        b = self._branch
+        return [b * int(ancestor) + 1 + k for k in range(b)]
+
+    def get_travel_path(self, child, ancestor):
+        out = []
+        c = int(child)
+        while c > int(ancestor):
+            c = (c - 1) // self._branch
+            out.append(c)
+        return out[:-1] if out and out[-1] == int(ancestor) else out
+
+    def get_pi_relation(self, ids, level):
+        return dict(zip([int(i) for i in ids], self.get_ancestor_codes(ids, level)))
+
+    # ---- layerwise sampling ------------------------------------------------
+    def init_layerwise_sampler(self, layer_sample_counts, start_sample_layer=1,
+                               seed=0):
+        self._sample_counts = list(layer_sample_counts)
+        self._start_layer = int(start_sample_layer)
+
+    def layerwise_sample(self, user_input, index_input, with_hierarchy=False):
+        """For each (user, positive item): per layer, the positive ancestor
+        (label 1) + n negatives drawn from the same layer (label 0) —
+        the reference's tdm sampler contract. Returns list of rows
+        [user..., node_code, label]."""
+        from ...core.rng import host_generator
+
+        if not hasattr(self, "_sample_counts"):
+            raise RuntimeError("call init_layerwise_sampler first")
+        g = host_generator()
+        out = []
+        for user, pos in zip(user_input, index_input):
+            user = list(np.atleast_1d(user))
+            for li, n_neg in enumerate(self._sample_counts):
+                level = self._start_layer + li
+                if level >= self._height:
+                    break
+                pos_code = self.get_ancestor_codes([pos], level)[0]
+                layer = self.get_layer_codes(level)
+                out.append(user + [pos_code, 1])
+                negs = g.choice(len(layer), size=min(n_neg, len(layer)), replace=False)
+                for k in negs:
+                    code = layer[int(k)]
+                    if code != pos_code:
+                        out.append(user + [code, 0])
+        return out

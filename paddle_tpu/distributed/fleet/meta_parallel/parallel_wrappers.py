@@ -338,6 +338,8 @@ class HybridParallelOptimizer:
         if strategy is not None:
             if getattr(strategy, "lars", False):
                 self._inner_opt = self._to_lars(optimizer, strategy)
+            if getattr(strategy, "dgc", False):
+                self._inner_opt = self._to_dgc(self._inner_opt, strategy)
             if getattr(strategy, "gradient_merge", False):
                 cfg = getattr(strategy, "gradient_merge_configs", {})
                 self._gm_k = int(cfg.get("k_steps", 1))
@@ -346,6 +348,29 @@ class HybridParallelOptimizer:
                 cfg = getattr(strategy, "localsgd_configs", {"k_steps": 1})
                 self._local_k = int(cfg.get("k_steps", 1))
                 self._local_begin = int(cfg.get("begin_step", 1))
+
+    @staticmethod
+    def _to_dgc(optimizer, strategy):
+        """Reference DGCOptimizer meta (dgc_optimizer.py:442) applies to
+        Momentum; the swap reproduces its sparse+error-feedback trajectory
+        (see DGCMomentum for the TPU communication note)."""
+        from ....optimizer import Momentum
+        from ....optimizer.optimizers import DGCMomentum
+
+        if not isinstance(optimizer, Momentum):
+            return optimizer
+        cfg = getattr(strategy, "dgc_configs", {})
+        sparsity = cfg.get("sparsity", [0.999])
+        return DGCMomentum(
+            learning_rate=optimizer._learning_rate,
+            momentum=optimizer._momentum,
+            sparsity=float(sparsity[-1] if isinstance(sparsity, (list, tuple)) else sparsity),
+            rampup_begin_step=int(cfg.get("rampup_begin_step", 0)),
+            parameters=optimizer._parameter_list,
+            weight_decay=optimizer._weight_decay,
+            grad_clip=optimizer._grad_clip,
+            use_nesterov=optimizer._use_nesterov,
+        )
 
     @staticmethod
     def _to_lars(optimizer, strategy):

@@ -50,6 +50,7 @@ class DistributedStrategy:
         self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
                              "epsilon": 0.0}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.999]}
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.fuse_all_reduce_ops = True

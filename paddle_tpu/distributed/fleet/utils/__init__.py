@@ -12,10 +12,10 @@ import jax
 
 
 def recompute(function, *args, **kwargs):
-    from ...core.autograd import apply, trace_mode
-    from ...core.functional import swap_state
-    from ...core.tensor import Tensor
-    from ...nn.layer import Layer
+    from ....core.autograd import apply, trace_mode
+    from ....core.functional import swap_state
+    from ....core.tensor import Tensor
+    from ....nn.layer import Layer
 
     kwargs.pop("preserve_rng_state", True)
     kwargs.pop("use_reentrant", True)
@@ -50,3 +50,6 @@ def recompute(function, *args, **kwargs):
     if isinstance(out, tuple):
         return tuple(Tensor._from_op(o, node, i) for i, o in enumerate(out))
     return Tensor._from_op(out, node)
+
+from . import fs  # noqa: F401,E402
+from .fs import HDFSClient, LocalFS  # noqa: F401,E402

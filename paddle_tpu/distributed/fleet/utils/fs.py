@@ -1,0 +1,224 @@
+"""Filesystem clients for checkpoint/data staging.
+
+Reference parity: python/paddle/distributed/fleet/utils/fs.py (FS base,
+LocalFS full implementation, HDFSClient shelling to `hadoop fs`).
+
+TPU-native note: TPU pods stage checkpoints through GCS/NFS mounts that
+look like local paths, so LocalFS is the workhorse; HDFSClient keeps the
+reference's shell contract for clusters that have a hadoop binary.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=False):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Reference LocalFS (fs.py:113): full local implementation."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name)) else files).append(name)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if test_exists:
+            if not self.is_exist(src_path):
+                raise FSFileNotExistsError(src_path)
+            if not overwrite and self.is_exist(dst_path):
+                raise FSFileExistsError(dst_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def cat(self, fs_path=None):
+        with open(fs_path) as f:
+            return f.read()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient(FS):
+    """Reference HDFSClient: shells out to `hadoop fs` (fs.py's shell
+    contract). Raises ExecuteError with the command output on failure;
+    construction does NOT require hadoop — only use does."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._base = [os.path.join(hadoop_home, "bin", "hadoop") if hadoop_home
+                      else "hadoop", "fs"]
+        for k, v in (configs or {}).items():
+            self._base += [f"-D{k}={v}"]
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args):
+        try:
+            p = subprocess.run(self._base + list(args), capture_output=True,
+                               text=True, timeout=self._timeout)
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                f"hadoop binary not found ({self._base[0]}) — HDFSClient "
+                "requires a hadoop installation"
+            ) from e
+        except subprocess.TimeoutExpired as e:
+            raise FSTimeOut(str(e)) from e
+        if p.returncode != 0:
+            raise ExecuteError(f"{' '.join(args)}: {p.stderr[-500:]}")
+        return p.stdout
+
+    def need_upload_download(self):
+        return True
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            toks = line.split()
+            if len(toks) < 8:
+                continue
+            name = os.path.basename(toks[-1])
+            (dirs if toks[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def mv(self, src, dst, overwrite=False, test_exists=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path=None):
+        return self._run("-cat", fs_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]

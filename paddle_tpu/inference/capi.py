@@ -1,0 +1,30 @@
+"""Build/load helper for the serving C ABI (csrc/predictor_capi.cc).
+
+Reference parity: paddle/fluid/inference/capi_exp/ (PD_PredictorCreate /
+PD_PredictorRun / PD_GetOutput* as a stable C surface). `build_capi()`
+compiles libpd_capi.so; a C/Go serving process links it and calls the PD_*
+functions — see tests/test_capi_serving.py for a complete C consumer.
+"""
+from __future__ import annotations
+
+import os
+import sysconfig
+
+from ..utils.cpp_extension import load as _load
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc", "predictor_capi.cc")
+
+
+def build_capi(verbose=False):
+    """Compile the C ABI shared library; returns its absolute path."""
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var("VERSION")
+    lib = _load(
+        "pd_capi", [_SRC],
+        extra_cxx_flags=[f"-I{inc}"],
+        extra_ldflags=[f"-L{libdir}", f"-lpython{ver}", f"-Wl,-rpath,{libdir}"],
+        verbose=verbose,
+    )
+    return lib._name

@@ -24,3 +24,21 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .fleet_dataset import InMemoryDataset, QueueDataset  # noqa: F401
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference python/paddle/batch.py): wraps an
+    item-yielding reader() into a batch-list-yielding reader()."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

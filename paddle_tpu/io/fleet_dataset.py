@@ -1,0 +1,168 @@
+"""InMemoryDataset / QueueDataset — the trainer/DataFeed dataset family.
+
+Reference parity: python/paddle/distributed/fleet/dataset/dataset.py
+(InMemoryDataset:291 with load_into_memory/local_shuffle/global_shuffle,
+QueueDataset:1000 streaming variant) over the C++ MultiSlotDataFeed
+(paddle/fluid/framework/data_feed.cc).
+
+TPU-native design: the reference's role for these classes is feeding slot-
+formatted text through a C++ pipeline into trainer threads. Here the C++
+layer is csrc/data_feed.cc (shuffle + parallel gather-collate) and the
+consumer is the compiled train step: parse once into contiguous arrays,
+shuffle/batch natively, iterate numpy batches ready for device_put.
+Slot format: each line is whitespace-separated `slot_size value...` groups,
+one group per declared variable (the reference's MultiSlot text format for
+dense slots).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import native_feed
+
+
+def _parse_line(toks, var_dims):
+    """One slot-text line -> list of per-slot dense value lists (pad or
+    truncate each slot to its declared dim)."""
+    out = []
+    pos = 0
+    for dim in var_dims:
+        n = int(toks[pos])
+        pos += 1
+        vals = [float(t) for t in toks[pos:pos + n]]
+        pos += n
+        if len(vals) < dim:
+            vals += [0.0] * (dim - len(vals))
+        out.append(vals[:dim])
+    return out
+
+
+class InMemoryDataset:
+    """Load slot-text files fully into memory; shuffle natively; iterate
+    fixed-size dense batches."""
+
+    def __init__(self):
+        self._var_names = []
+        self._var_dims = []
+        self._batch_size = 1
+        self._thread = 1
+        self._arrays = None  # list of [N, dim] arrays, one per slot
+        self._seed = 0
+        self._drop_last = False
+
+    # ---- reference-surface config ----------------------------------------
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", download_cmd=""):
+        self._batch_size = int(batch_size)
+        self._thread = int(thread_num)
+        if use_var:
+            self.set_use_var(use_var)
+
+    def set_use_var(self, var_list):
+        """var_list: names (str) or objects with .name/.shape; declares the
+        slot order and per-slot dense dims."""
+        self._var_names = []
+        self._var_dims = []
+        for v in var_list:
+            if isinstance(v, str):
+                self._var_names.append(v)
+                self._var_dims.append(1)
+            else:
+                self._var_names.append(getattr(v, "name", str(v)))
+                shape = list(getattr(v, "shape", [1]))
+                dim = 1
+                for d in shape[1:] if len(shape) > 1 else shape:
+                    if d and int(d) > 0:
+                        dim *= int(d)
+                self._var_dims.append(dim)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_drop_last(self, drop_last):
+        self._drop_last = bool(drop_last)
+
+    def set_thread(self, thread_num):
+        self._thread = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    # ---- loading / shuffling ----------------------------------------------
+    def load_into_memory(self):
+        if not self._var_names:
+            raise ValueError("call set_use_var before load_into_memory")
+        rows = [[] for _ in self._var_names]
+        for path in getattr(self, "_filelist", []):
+            with open(path) as f:
+                for line in f:
+                    toks = line.split()
+                    if not toks:
+                        continue
+                    for si, vals in enumerate(_parse_line(toks, self._var_dims)):
+                        rows[si].append(vals)
+        self._arrays = [np.asarray(r, np.float32) for r in rows]
+
+    def local_shuffle(self):
+        if self._arrays is None:
+            raise ValueError("load_into_memory first")
+        n = len(self._arrays[0])
+        idx = native_feed.shuffle_indices(n, self._seed)
+        self._seed += 1
+        self._arrays = [
+            native_feed.gather_collate(a, idx, self._thread) for a in self._arrays
+        ]
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Single-controller SPMD loads per-process shards, so the local
+        shuffle IS the global shuffle for this process's shard."""
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return 0 if self._arrays is None else len(self._arrays[0])
+
+    def release_memory(self):
+        self._arrays = None
+
+    # ---- iteration ---------------------------------------------------------
+    def __iter__(self):
+        if self._arrays is None:
+            raise ValueError("load_into_memory first")
+        n = len(self._arrays[0])
+        bs = self._batch_size
+        stop = (n // bs) * bs if self._drop_last else n
+        for i in range(0, stop, bs):
+            yield tuple(a[i:i + bs] for a in self._arrays)
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference QueueDataset): files are parsed lazily
+    per epoch instead of held resident; no shuffle (stream order)."""
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from files; use set_filelist + iterate "
+            "(reference QueueDataset has no load_into_memory either)"
+        )
+
+    def local_shuffle(self):
+        raise RuntimeError("QueueDataset cannot shuffle a stream (reference parity)")
+
+    def __iter__(self):
+        if not self._var_names:
+            raise ValueError("call set_use_var first")
+        batch = [[] for _ in self._var_names]
+        for path in getattr(self, "_filelist", []):
+            with open(path) as f:
+                for line in f:
+                    toks = line.split()
+                    if not toks:
+                        continue
+                    for si, vals in enumerate(_parse_line(toks, self._var_dims)):
+                        batch[si].append(vals)
+                    if len(batch[0]) == self._batch_size:
+                        yield tuple(np.asarray(b, np.float32) for b in batch)
+                        batch = [[] for _ in self._var_names]
+        if batch[0] and not self._drop_last:
+            # the tail partial batch is data, not waste (drop_last opts out)
+            yield tuple(np.asarray(b, np.float32) for b in batch)

@@ -21,20 +21,28 @@ _LOCK = threading.Lock()
 _LOADED = {}
 
 
-def _hash_sources(sources, extra_flags):
+def _hash_sources(sources, cxx_flags, ld_flags=()):
     h = hashlib.sha256()
     for s in sources:
         with open(s, "rb") as f:
             h.update(f.read())
-    h.update(" ".join(extra_flags).encode())
+    # compile and link flags hashed SEPARATELY: moving a -l between the two
+    # lists changes linker order (and thus the artifact) even though the
+    # concatenated token sequence is identical
+    h.update("|".join(cxx_flags).encode())
+    h.update(b"##")
+    h.update("|".join(ld_flags).encode())
     return h.hexdigest()[:16]
 
 
-def load(name, sources, extra_cxx_flags=None, verbose=False, build_directory=None):
+def load(name, sources, extra_cxx_flags=None, extra_ldflags=None, verbose=False, build_directory=None):
     """Compile `sources` into lib<name>.so (cached by content hash) and
-    return the ctypes.CDLL handle."""
+    return the ctypes.CDLL handle. extra_ldflags (e.g. -lpython3.12) are
+    appended AFTER the sources — the GNU linker resolves library symbols
+    left to right, so libraries must follow the objects that need them."""
     extra = list(extra_cxx_flags or [])
-    key = (name, _hash_sources(sources, extra))
+    ld = list(extra_ldflags or [])
+    key = (name, _hash_sources(sources, extra, ld))
     with _LOCK:
         if key in _LOADED:
             return _LOADED[key]
@@ -50,6 +58,7 @@ def load(name, sources, extra_cxx_flags=None, verbose=False, build_directory=Non
                 + extra
                 + list(sources)
                 + ["-o", tmp_path]
+                + ld
             )
             if verbose:
                 print("cpp_extension:", " ".join(cmd))

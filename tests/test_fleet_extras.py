@@ -1,0 +1,98 @@
+"""Fleet extras: TreeIndex (index dataset), LocalFS/HDFSClient, and their
+reference query contracts.
+
+Reference: distributed/fleet/dataset/index_dataset.py (TreeIndex),
+fleet/utils/fs.py (LocalFS:113, HDFSClient).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTreeIndex:
+    def _tree(self):
+        from paddle_tpu.distributed.fleet.index_dataset import TreeIndex
+
+        return TreeIndex("t", branch=2, items=list(range(100, 108)))  # 8 leaves
+
+    def test_shape_queries(self):
+        t = self._tree()
+        assert t.branch() == 2
+        assert t.height() == 4          # 8 leaves -> 4 levels (1,2,4,8)
+        assert t.total_node_nums() == 15
+        assert len(t.get_all_leafs()) == 8
+        assert t.get_layer_codes(0) == [0]
+        assert t.get_layer_codes(3) == list(range(7, 15))
+
+    def test_travel_and_ancestors(self):
+        t = self._tree()
+        travel = t.get_travel_codes(100)  # first leaf -> root
+        assert travel[0] == 7 and travel[-1] == 0
+        assert len(travel) == 4
+        # parent arithmetic consistency
+        for child, parent in zip(travel[:-1], travel[1:]):
+            assert (child - 1) // 2 == parent
+        anc = t.get_ancestor_codes([100, 107], 1)
+        assert anc[0] == 1 and anc[1] == 2  # opposite subtrees
+        rel = t.get_pi_relation([100], 2)
+        assert rel[100] == 3
+
+    def test_nodes_roundtrip_and_save(self, tmp_path):
+        from paddle_tpu.distributed.fleet.index_dataset import TreeIndex
+
+        t = self._tree()
+        leafs = t.get_all_leafs()
+        assert t.get_nodes(leafs) == list(range(100, 108))
+        p = str(tmp_path / "tree.npz")
+        t.save(p)
+        t2 = TreeIndex("t2", path=p)
+        assert t2.get_all_leafs() == leafs
+
+    def test_layerwise_sample(self):
+        paddle.seed(0)
+        t = self._tree()
+        t.init_layerwise_sampler([2, 2], start_sample_layer=2)
+        rows = t.layerwise_sample([[7], [9]], [100, 107])
+        assert rows, "no samples"
+        for row in rows:
+            user, code, label = row[0], row[1], row[2]
+            assert label in (0, 1)
+        # each (user, layer) group has exactly one positive
+        pos = [r for r in rows if r[2] == 1]
+        assert len(pos) == 2 * 2  # 2 users x 2 layers
+
+
+class TestLocalFS:
+    def test_full_surface(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+
+        fs = LocalFS()
+        d = str(tmp_path / "d")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = os.path.join(d, "a.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        with open(f, "w") as fh:
+            fh.write("hello")
+        assert fs.cat(f) == "hello"
+        dirs, files = fs.ls_dir(d)
+        assert files == ["a.txt"] and dirs == []
+        f2 = os.path.join(d, "b.txt")
+        fs.mv(f, f2)
+        assert fs.is_file(f2) and not fs.is_exist(f)
+        with pytest.raises(Exception):
+            fs.mv(f, f2, test_exists=True)  # src gone
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_client_errors_without_hadoop(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+        from paddle_tpu.distributed.fleet.utils.fs import ExecuteError
+
+        c = HDFSClient(hadoop_home="/nonexistent")
+        with pytest.raises(ExecuteError):
+            c.mkdirs("/tmp/x")
