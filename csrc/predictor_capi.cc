@@ -152,9 +152,8 @@ int PD_PredictorRun(void* handle, const float* data, const int64_t* shape,
       h->outputs.clear();
       h->output_shapes.clear();
       Py_ssize_t n = PySequence_Length(outs);
-      PyObject* npmod = PyImport_ImportModule("numpy");
       PyObject* ascontig =
-          PyObject_GetAttrString(npmod, "ascontiguousarray");
+          PyObject_GetAttrString(np, "ascontiguousarray");
       bool conv_ok = true;
       for (Py_ssize_t i = 0; i < n && conv_ok; ++i) {
         PyObject* o = PySequence_GetItem(outs, i);
@@ -192,7 +191,6 @@ int PD_PredictorRun(void* handle, const float* data, const int64_t* shape,
         Py_XDECREF(o);
       }
       Py_XDECREF(ascontig);
-      Py_XDECREF(npmod);
       n_out = conv_ok ? static_cast<int>(h->outputs.size()) : -1;
       Py_DECREF(outs);
     } else {

@@ -102,12 +102,15 @@ class TreeIndex(Index):
         return [b * int(ancestor) + 1 + k for k in range(b)]
 
     def get_travel_path(self, child, ancestor):
+        """Codes from child (inclusive) up to, excluding, ancestor — the
+        reference contract (index_dataset.py get_travel_path appends the
+        child before stepping)."""
         out = []
         c = int(child)
         while c > int(ancestor):
-            c = (c - 1) // self._branch
             out.append(c)
-        return out[:-1] if out and out[-1] == int(ancestor) else out
+            c = (c - 1) // self._branch
+        return out
 
     def get_pi_relation(self, ids, level):
         return dict(zip([int(i) for i in ids], self.get_ancestor_codes(ids, level)))
@@ -117,17 +120,16 @@ class TreeIndex(Index):
                                seed=0):
         self._sample_counts = list(layer_sample_counts)
         self._start_layer = int(start_sample_layer)
+        self._sampler_rng = np.random.default_rng(int(seed))
 
     def layerwise_sample(self, user_input, index_input, with_hierarchy=False):
         """For each (user, positive item): per layer, the positive ancestor
         (label 1) + n negatives drawn from the same layer (label 0) —
         the reference's tdm sampler contract. Returns list of rows
         [user..., node_code, label]."""
-        from ...core.rng import host_generator
-
         if not hasattr(self, "_sample_counts"):
             raise RuntimeError("call init_layerwise_sampler first")
-        g = host_generator()
+        g = self._sampler_rng
         out = []
         for user, pos in zip(user_input, index_input):
             user = list(np.atleast_1d(user))
@@ -136,11 +138,13 @@ class TreeIndex(Index):
                 if level >= self._height:
                     break
                 pos_code = self.get_ancestor_codes([pos], level)[0]
-                layer = self.get_layer_codes(level)
+                # draw negatives from the layer EXCLUDING the positive, so
+                # the per-layer row count is deterministic (1 + n_neg when
+                # the layer is big enough)
+                candidates = [c for c in self.get_layer_codes(level) if c != pos_code]
                 out.append(user + [pos_code, 1])
-                negs = g.choice(len(layer), size=min(n_neg, len(layer)), replace=False)
-                for k in negs:
-                    code = layer[int(k)]
-                    if code != pos_code:
-                        out.append(user + [code, 0])
+                k = min(n_neg, len(candidates))
+                if k:
+                    for j in g.choice(len(candidates), size=k, replace=False):
+                        out.append(user + [candidates[int(j)], 0])
         return out

@@ -41,29 +41,55 @@ class DenseTable:
 
 
 class SparseTable:
-    """memory_sparse_table.h role: id -> row embedding with lazy init and a
+    """memory_sparse_table.h role: id -> row embedding with lazy init, a
     per-row optimizer rule (sgd | adagrad, reference SparseSgdRule /
-    SparseAdaGradSGDRule in ps/table/sparse_sgd_rule.h)."""
+    SparseAdaGradSGDRule in ps/table/sparse_sgd_rule.h), and the reference's
+    capacity management (memory_sparse_table's shrink by unseen-days /
+    access-frequency accessor policy, ps/table/memory_sparse_table.cc):
+
+    - `max_rows` caps resident rows; overflow evicts the least-recently
+      USED rows first (pull or push counts as use), never below capacity.
+    - `shrink(threshold)` is the reference's explicit Shrink() op: drop
+      rows whose access count since the last shrink is below `threshold`.
+    Both default off (max_rows=None), preserving grow-forever semantics."""
 
     def __init__(self, dim, lr=0.01, optimizer="sgd", init_scale=0.01,
-                 seed=0, dtype=np.float32):
+                 seed=0, dtype=np.float32, max_rows=None):
+        from collections import OrderedDict
+
         self.dim = int(dim)
         self.lr = float(lr)
         self.optimizer = optimizer
         if optimizer not in ("sgd", "adagrad"):
             raise ValueError(f"unsupported sparse optimizer {optimizer!r}")
-        self.rows = {}
+        self.rows = OrderedDict()  # id -> row, LRU order (oldest first)
         self.g2 = {}  # adagrad accumulators
+        self._access = {}  # id -> uses since last shrink
+        self.max_rows = None if max_rows is None else int(max_rows)
+        self.evictions = 0
         self._rng = np.random.RandomState(seed)
         self._init_scale = init_scale
         self._dtype = dtype
         self._lock = threading.Lock()
+
+    def _touch(self, i):
+        self.rows.move_to_end(i)
+        self._access[i] = self._access.get(i, 0) + 1
+
+    def _evict_to_capacity(self):
+        while self.max_rows is not None and len(self.rows) > self.max_rows:
+            old, _ = self.rows.popitem(last=False)  # least recently used
+            self.g2.pop(old, None)
+            self._access.pop(old, None)
+            self.evictions += 1
 
     def _row(self, i):
         r = self.rows.get(i)
         if r is None:
             r = (self._rng.rand(self.dim).astype(self._dtype) - 0.5) * 2 * self._init_scale
             self.rows[i] = r
+            self._evict_to_capacity()
+        self._touch(i)
         return r
 
     def pull(self, ids):
@@ -84,6 +110,17 @@ class SparseTable:
                 else:
                     row -= self.lr * g
 
+    def shrink(self, threshold=1):
+        """Drop rows accessed fewer than `threshold` times since the last
+        shrink (reference Table::Shrink). Returns rows dropped."""
+        with self._lock:
+            cold = [i for i in self.rows if self._access.get(i, 0) < threshold]
+            for i in cold:
+                del self.rows[i]
+                self.g2.pop(i, None)
+            self._access = dict.fromkeys(self.rows, 0)
+            return len(cold)
+
     def size(self):
         with self._lock:
             return len(self.rows)
@@ -93,8 +130,17 @@ class SparseTable:
             return {int(k): v.copy() for k, v in self.rows.items()}
 
     def load(self, rows):
+        from collections import OrderedDict
+
         with self._lock:
-            self.rows = {int(k): np.asarray(v, self._dtype) for k, v in rows.items()}
+            self.rows = OrderedDict(
+                (int(k), np.asarray(v, self._dtype)) for k, v in rows.items()
+            )
+            # optimizer state belongs to the snapshot being replaced: stale
+            # accumulators for vanished ids would throttle re-appearing rows
+            self.g2 = {}
+            self._access = dict.fromkeys(self.rows, 0)
+            self._evict_to_capacity()
 
 
 # ---- the in-process service (hosted by a server worker) ---------------------
@@ -146,6 +192,11 @@ def _svc_save(name):
     return _TABLES[name].save()
 
 
+def _svc_shrink(name, threshold=1):
+    with _TLOCK:
+        return _TABLES[name].shrink(threshold)
+
+
 def _svc_table_size(name):
     return _TABLES[name].size()
 
@@ -169,8 +220,12 @@ class PSClient:
     def create_dense_table(self, name, shape, lr=0.01, init=None):
         return self._call(_svc_create_table, name, "dense", shape=shape, lr=lr, init=init)
 
-    def create_sparse_table(self, name, dim, lr=0.01, optimizer="sgd"):
-        return self._call(_svc_create_table, name, "sparse", dim=dim, lr=lr, optimizer=optimizer)
+    def create_sparse_table(self, name, dim, lr=0.01, optimizer="sgd", max_rows=None):
+        return self._call(_svc_create_table, name, "sparse", dim=dim, lr=lr,
+                          optimizer=optimizer, max_rows=max_rows)
+
+    def shrink_table(self, name, threshold=1):
+        return self._call(_svc_shrink, name, threshold)
 
     def pull_dense(self, name):
         return self._call(_svc_pull_dense, name)

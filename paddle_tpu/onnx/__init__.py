@@ -120,11 +120,13 @@ def _emit_layer(em, layer, x):
             x = _emit_layer(em, sub, x)
         return x
     if isinstance(layer, nn.Linear):
+        # MatMul+Add, not Gemm: ONNX Gemm is rank-2-only, while paddle
+        # Linear applies to any leading batch dims — MatMul broadcasts
         w = em.init("w", layer.weight.numpy())           # [in, out]
-        b = (em.init("b", layer.bias.numpy())
-             if layer.bias is not None else None)
-        ins = [x, w] + ([b] if b else [])
-        return em.node("Gemm", ins, alpha=1.0, beta=1.0, transB=0)
+        y = em.node("MatMul", [x, w])
+        if layer.bias is not None:
+            y = em.node("Add", [y, em.init("b", layer.bias.numpy())])
+        return y
     if isinstance(layer, nn.ReLU):
         return em.node("Relu", [x])
     if isinstance(layer, nn.Tanh):
@@ -155,6 +157,10 @@ def _emit_layer(em, layer, x):
             )
         return em.node("Flatten", [x], axis=1)
     if isinstance(layer, nn.Conv2D):
+        if (getattr(layer, "_data_format", "NCHW") or "NCHW") != "NCHW":
+            raise NotImplementedError(
+                "paddle.onnx.export: Conv2D is exported NCHW-only"
+            )
         w = em.init("w", layer.weight.numpy())           # OIHW
         ins = [x, w]
         if layer.bias is not None:
@@ -165,12 +171,29 @@ def _emit_layer(em, layer, x):
             dilations=_pair(layer._dilation), group=int(layer._groups),
         )
     if isinstance(layer, nn.MaxPool2D):
+        if getattr(layer, "ceil_mode", False):
+            raise NotImplementedError(
+                "paddle.onnx.export: MaxPool2D(ceil_mode=True) — ONNX "
+                "defaults to floor and this exporter does not emit ceil_mode"
+            )
+        if (getattr(layer, "data_format", None) or "NCHW") != "NCHW":
+            raise NotImplementedError(
+                "paddle.onnx.export: pools are exported NCHW-only"
+            )
         return em.node(
             "MaxPool", [x], kernel_shape=_pair(layer.kernel_size),
             strides=_pair(layer.stride or layer.kernel_size),
             pads=_onnx_pads(layer.padding, "MaxPool2D"),
         )
     if isinstance(layer, nn.AvgPool2D):
+        if getattr(layer, "ceil_mode", False):
+            raise NotImplementedError(
+                "paddle.onnx.export: AvgPool2D(ceil_mode=True) is not emitted"
+            )
+        if (getattr(layer, "data_format", None) or "NCHW") != "NCHW":
+            raise NotImplementedError(
+                "paddle.onnx.export: pools are exported NCHW-only"
+            )
         # count_include_pad pinned to 0: paddle AvgPool2D default
         # (exclusive=True) and the ONNX default agree — stated explicitly
         # so consumers cannot mis-default
@@ -309,6 +332,8 @@ def load(path):
                 y = ins[0] @ (ins[1].T if attrs.get("transB") else ins[1])
                 if len(ins) > 2:
                     y = y + ins[2]
+            elif op == "MatMul":
+                y = ins[0] @ ins[1]
             elif op == "Relu":
                 y = jnp.maximum(ins[0], 0)
             elif op == "Tanh":
