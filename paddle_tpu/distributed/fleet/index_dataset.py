@@ -117,9 +117,16 @@ class TreeIndex(Index):
 
     # ---- layerwise sampling ------------------------------------------------
     def init_layerwise_sampler(self, layer_sample_counts, start_sample_layer=1,
-                               seed=0):
+                               seed=None):
+        """seed=None (default) derives the stream from paddle's host
+        generator, so paddle.seed governs sampling; an explicit seed pins an
+        independent stream."""
         self._sample_counts = list(layer_sample_counts)
         self._start_layer = int(start_sample_layer)
+        if seed is None:
+            from ...core.rng import host_generator
+
+            seed = int(host_generator().integers(0, 2**63 - 1))
         self._sampler_rng = np.random.default_rng(int(seed))
 
     def layerwise_sample(self, user_input, index_input, with_hierarchy=False):
@@ -138,13 +145,19 @@ class TreeIndex(Index):
                 if level >= self._height:
                     break
                 pos_code = self.get_ancestor_codes([pos], level)[0]
-                # draw negatives from the layer EXCLUDING the positive, so
-                # the per-layer row count is deterministic (1 + n_neg when
-                # the layer is big enough)
-                candidates = [c for c in self.get_layer_codes(level) if c != pos_code]
+                # negatives drawn ARITHMETICALLY from the layer's contiguous
+                # code range minus the positive (no O(branch**level) list):
+                # indices >= (pos - start) shift by one to skip it, giving a
+                # deterministic 1 + n_neg rows per (user, layer)
+                b = self._branch
+                start = (b ** level - 1) // (b - 1) if b > 1 else level
+                n_layer = b ** level
                 out.append(user + [pos_code, 1])
-                k = min(n_neg, len(candidates))
+                k = min(n_neg, n_layer - 1)
                 if k:
-                    for j in g.choice(len(candidates), size=k, replace=False):
-                        out.append(user + [candidates[int(j)], 0])
+                    draws = g.choice(n_layer - 1, size=k, replace=False)
+                    off = pos_code - start
+                    for j in draws:
+                        j = int(j)
+                        out.append(user + [start + (j + 1 if j >= off else j), 0])
         return out

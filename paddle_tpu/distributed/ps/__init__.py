@@ -66,6 +66,8 @@ class SparseTable:
         self.g2 = {}  # adagrad accumulators
         self._access = {}  # id -> uses since last shrink
         self.max_rows = None if max_rows is None else int(max_rows)
+        if self.max_rows is not None and self.max_rows < 1:
+            raise ValueError(f"max_rows must be >= 1 or None, got {max_rows}")
         self.evictions = 0
         self._rng = np.random.RandomState(seed)
         self._init_scale = init_scale
@@ -193,8 +195,9 @@ def _svc_save(name):
 
 
 def _svc_shrink(name, threshold=1):
-    with _TLOCK:
-        return _TABLES[name].shrink(threshold)
+    with _TLOCK:  # registry lookup only; shrink takes the table's own lock
+        table = _TABLES[name]
+    return table.shrink(threshold)
 
 
 def _svc_table_size(name):

@@ -215,11 +215,21 @@ def one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches, labels, mesh,
             fwd_in, bwd_in, buf, gacc, hacc, dmbs, loss_acc = carry
 
             # ---- forward micro-step ----------------------------------
+            # stage compute gated behind a per-core HLO conditional (same
+            # mechanism as the head gate below): during warmup/cooldown an
+            # inactive core SKIPS the FLOPs and idles at the cycle's
+            # ppermute — warmup cycles cost fwd-only wall time instead of
+            # fwd+bwd, trimming the bubble's compute price
             i_f = t - s
             fwd_active = (i_f >= 0) & (i_f < M)
             inject = mbs[jnp.clip(i_f, 0, M - 1)]
             x_in = jnp.where(s == 0, inject, fwd_in)
-            y = stage_fn(params_here, x_in)
+            y = jax.lax.cond(
+                fwd_active,
+                lambda xi: stage_fn(params_here, xi),
+                lambda xi: jnp.zeros_like(xi),
+                x_in,
+            )
             # single-slot dynamic-update-slice (a full-array where would copy
             # the whole ring buffer every cycle)
             slot = i_f % B
@@ -227,38 +237,52 @@ def one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches, labels, mesh,
             fwd_out = jax.lax.ppermute(y, axis, perm_fwd)
 
             # ---- backward micro-step ---------------------------------
+            # the ENTIRE recompute+vjp (and, on the last stage, the head
+            # fwd+bwd) sits behind per-core HLO conditionals: under
+            # shard_map each core takes its own branch, and none of this
+            # contains collectives, so inactive warmup/cooldown cores skip
+            # the FLOPs and idle at the cycle's ppermute. The r3 verdict's
+            # head overhead (P*(M+2P-2)/M x) drops to 1x, and bubble cycles
+            # cost only the half (fwd or bwd) actually running.
             i_b = t - (2 * n_stages - 2 - s)
             bwd_active = (i_b >= 0) & (i_b < M)
             x_saved = buf[jnp.clip(i_b, 0, M - 1) % B]
-            yb, vjp_fn = jax.vjp(lambda p_, x_: stage_fn(p_, x_), params_here, x_saved)
             lab = jax.tree_util.tree_map(
                 lambda l: l[jnp.clip(i_b, 0, M - 1)], labs
             )
 
-            # head fwd+bwd (for GPT: ln_f + vocab unembed + CE) gated behind
-            # a REAL runtime conditional: under shard_map each core takes its
-            # own HLO-conditional branch, and the head has no collectives, so
-            # only the last stage's M active backward cycles pay its FLOPs —
-            # the r3 verdict's P*(M+2P-2)/M x overhead drops to 1x. The
-            # ppermutes outside the cond re-synchronize the cores each cycle.
-            def _do_head(_):
-                lj, (dh_, dyl) = jax.value_and_grad(
-                    head_loss, argnums=(0, 1)
-                )(head_p, yb, lab)
-                return lj, dh_, dyl
+            def _do_bwd(_):
+                yb, vjp_fn = jax.vjp(
+                    lambda p_, x_: stage_fn(p_, x_), params_here, x_saved
+                )
 
-            def _skip_head(_):
+                def _do_head(_):
+                    lj, (dh_, dyl) = jax.value_and_grad(
+                        head_loss, argnums=(0, 1)
+                    )(head_p, yb, lab)
+                    return lj, dh_, dyl
+
+                def _skip_head(_):
+                    return (
+                        jnp.zeros((), jnp.float32),
+                        jax.tree_util.tree_map(jnp.zeros_like, head_p),
+                        jnp.zeros_like(yb),
+                    )
+
+                lj, dh_, dy_last = jax.lax.cond(is_last, _do_head, _skip_head, None)
+                g = jnp.where(is_last, dy_last.astype(yb.dtype), bwd_in)
+                dp_, dx_ = vjp_fn(g)
+                return lj, dh_, dp_, dx_
+
+            def _skip_bwd(_):
                 return (
                     jnp.zeros((), jnp.float32),
                     jax.tree_util.tree_map(jnp.zeros_like, head_p),
-                    jnp.zeros_like(yb),
+                    jax.tree_util.tree_map(jnp.zeros_like, params_here),
+                    jnp.zeros_like(x_saved),
                 )
 
-            loss_j, dh, dy_last = jax.lax.cond(
-                is_last & bwd_active, _do_head, _skip_head, None
-            )
-            g = jnp.where(is_last, dy_last.astype(yb.dtype), bwd_in)
-            dp, dx = vjp_fn(g)
+            loss_j, dh, dp, dx = jax.lax.cond(bwd_active, _do_bwd, _skip_bwd, None)
             gacc = _tree_where(bwd_active, _tree_add(gacc, dp), gacc)
             hacc = _tree_where(bwd_active & is_last, _tree_add(hacc, dh), hacc)
             if return_input_grads:
@@ -391,13 +415,15 @@ def interleaved_one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches,
     Per device the forward cycles r = t - s decompose uniquely as
     r = (i div P)*VP + c*P + (i mod P), so forwards are dense in t (one per
     cycle) and likewise backwards — T = M*V + V*P + P - 2 chunk-cycles.
-    Since a chunk-cycle costs (tf+tb)/V of a full stage, the bubble is
-    (P + (P-2)/V) * (tf+tb) versus plain 1F1B's (2P-2)*(tf+tb): a
-    (1+1/V)/2 reduction (V=2: 25%, V->inf: 50%). This is the best the
-    uniform gated-cycle XLA form allows — the reference's asymmetric
-    warmup/cooldown (forward-only cycles costing tf, not tf+tb) would get
-    closer to the paper's 1/V but needs data-dependent cycle shapes XLA
-    can't compile into one scan.
+
+    Bubble accounting: cycles are structurally uniform (one scan), but the
+    fwd and bwd halves each sit behind a per-core HLO conditional, so a
+    warmup cycle where only forwards are live COSTS only tf/V wall time —
+    the asymmetric warmup/cooldown economics the reference gets from
+    data-dependent cycle shapes, recovered at runtime inside one compiled
+    scan. (The pre-gating uniform-cost analysis gave (1+1/V)/2 of 1F1B's
+    bubble; with gating the residual gap to the paper's 1/V is only the
+    per-cycle ppermute synchronization, not wasted compute.)
 
     The ring ppermute's wrap-around edge (device P-1 -> device 0) carries an
     activation from chunk c to chunk c+1 (and the mirrored edge carries
@@ -448,7 +474,14 @@ def interleaved_one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches,
             inject = mbs[i_fc]
             g_f = c_f * n_stages + s
             x_in = jnp.where(g_f == 0, inject, fwd_in)
-            y = stage_fn(chunk_params(c_f), x_in)
+            # per-core conditional: inactive warmup/cooldown cycles skip the
+            # chunk's FLOPs entirely (same mechanism as one_f_one_b)
+            y = jax.lax.cond(
+                fwd_active,
+                lambda xi: stage_fn(chunk_params(c_f), xi),
+                lambda xi: jnp.zeros_like(xi),
+                x_in,
+            )
             slot_f = jnp.mod(i_fc, B)
             buf = buf.at[c_f, slot_f].set(
                 jnp.where(fwd_active, x_in, buf[c_f, slot_f])
@@ -471,15 +504,29 @@ def interleaved_one_f_one_b(stage_fn, loss_fn, stacked_params, microbatches,
             is_last = g_b == VP - 1
             i_bc = jnp.clip(i_b, 0, M - 1)
             x_saved = buf[c_b, jnp.mod(i_bc, B)]
-            yb, vjp_fn = jax.vjp(
-                lambda p_, x_: stage_fn(p_, x_), chunk_params(c_b), x_saved
-            )
             lab = jax.tree_util.tree_map(lambda l: l[i_bc], labs)
-            loss_j, dy_last = jax.value_and_grad(
-                lambda yy: loss_fn(yy, lab).astype(jnp.float32)
-            )(yb)
-            gcot = jnp.where(is_last, dy_last.astype(yb.dtype), bwd_in)
-            dp, dx = vjp_fn(gcot)
+
+            def _do_bwd(_):
+                yb, vjp_fn = jax.vjp(
+                    lambda p_, x_: stage_fn(p_, x_), chunk_params(c_b), x_saved
+                )
+                lj, dy_last = jax.value_and_grad(
+                    lambda yy: loss_fn(yy, lab).astype(jnp.float32)
+                )(yb)
+                gcot = jnp.where(is_last, dy_last.astype(yb.dtype), bwd_in)
+                dp_, dx_ = vjp_fn(gcot)
+                return lj, dp_, dx_
+
+            def _skip_bwd(_):
+                return (
+                    jnp.zeros((), jnp.float32),
+                    jax.tree_util.tree_map(
+                        lambda a: jnp.zeros_like(a[0]), params_here
+                    ),
+                    jnp.zeros_like(x_saved),
+                )
+
+            loss_j, dp, dx = jax.lax.cond(bwd_active, _do_bwd, _skip_bwd, None)
             gacc = jax.tree_util.tree_map(
                 lambda acc, d: acc.at[c_b].set(
                     jnp.where(bwd_active, acc[c_b] + d, acc[c_b])

@@ -322,15 +322,20 @@ def test_distributions():
     assert abs(c.entropy().item() - np.log(3)) < 1e-5
 
 
-def test_onnx_export_writes_stablehlo(tmp_path):
+def test_onnx_export_writes_onnx_and_stablehlo(tmp_path):
+    """export now emits a REAL .onnx ModelProto plus the StableHLO artifact
+    XLA serving consumes (full round-trip coverage in test_onnx_export.py)."""
     net = nn.Linear(4, 2)
     from paddle_tpu.static import InputSpec
 
     out = paddle.onnx.export(net, str(tmp_path / "m"), input_spec=[InputSpec([1, 4])])
     import os
 
-    assert os.path.exists(out)
-    assert "stablehlo" in open(out).read() or "func" in open(out).read()
+    assert out.endswith(".onnx") and os.path.getsize(out) > 0
+    mlir = out + ".stablehlo.mlir"
+    assert os.path.exists(mlir)
+    text = open(mlir).read()
+    assert "stablehlo" in text or "func" in text
 
 
 def test_deform_conv2d():
