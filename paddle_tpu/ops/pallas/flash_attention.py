@@ -24,6 +24,12 @@ Kernel design (TPU):
 - Dropout draws keep-bits in-kernel (pltpu.prng_*) seeded per (bh, q, k)
   tile, so forward and backward regenerate identical masks with no stored
   dropout state.
+- Why the wrapper reshapes [B,S,H,D] -> [B*H,S,D] around the kernels
+  (tried and rejected in r4): reading the native layout via 4-D blocks
+  (1, bq, 1, d) is not lowerable — Mosaic requires the block's minor two
+  dims to be (8, 128)-divisible or equal to the array dims, and the head
+  axis sits second-to-minor. The transposes XLA inserts around the
+  custom-calls are the price of the paddle-native [B,S,H,D] API layout.
 """
 from __future__ import annotations
 
