@@ -175,6 +175,14 @@ def _input_avals(input_spec, scope):
     return avals
 
 
+# custom-calls every exported artifact must allow (shared by jit.save and
+# static.save_inference_model — extend HERE when a new kernel needs one)
+_EXPORT_DISABLED_CHECKS = (
+    jax.export.DisabledSafetyCheck.custom_call("tpu_custom_call"),
+    jax.export.DisabledSafetyCheck.custom_call("Sharding"),
+)
+
+
 def save(layer, path, input_spec=None, **configs):
     """jit.save: persist an EXECUTABLE program artifact + weights.
 
@@ -208,11 +216,7 @@ def save(layer, path, input_spec=None, **configs):
         scope = jax.export.SymbolicScope()
         avals = _input_avals(list(input_spec), scope)
         exp = jax.export.export(
-            jax.jit(fwd),
-            disabled_checks=[
-                jax.export.DisabledSafetyCheck.custom_call("tpu_custom_call"),
-                jax.export.DisabledSafetyCheck.custom_call("Sharding"),
-            ],
+            jax.jit(fwd), disabled_checks=list(_EXPORT_DISABLED_CHECKS)
         )(params, buffers, *avals)
         artifact = {
             "format": "paddle_tpu.stablehlo.v1",

@@ -49,3 +49,8 @@ from .program import (  # noqa: E402,F401
     program_guard,
     scope_guard,
 )
+from .io import (  # noqa: E402,F401
+    LoadedInferenceProgram,
+    load_inference_model,
+    save_inference_model,
+)

@@ -1,0 +1,143 @@
+"""static.save_inference_model / load_inference_model.
+
+Reference parity: python/paddle/static/io.py:442 (serialize a pruned
+ProgramDesc + persistables; load returns
+[inference_program, feed_target_names, fetch_targets] consumable by
+Executor.run).
+
+TPU-native design: the captured op-log Program is pruned to the
+feed->fetch slice by `Program._plan`, the CURRENT parameter values are
+baked in as constants, and the whole slice is serialized as StableHLO via
+jax.export — the same artifact family as jit.save, but program-level
+(no Layer required, mirroring the static-graph workflow). load returns a
+`LoadedInferenceProgram` that `static.Executor.run` executes directly.
+Feed shapes are the capture-time placeholder shapes (static.data's
+None dims were dried to 1): feed the same shapes at inference, or
+re-capture with the serving batch size.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .program import default_main_program
+
+_FORMAT = "paddle_tpu.static_inference.v1"
+
+
+class LoadedInferenceProgram:
+    """Executable handle for a loaded inference artifact; `Executor.run`
+    accepts it as `program` (the reference's inference_program role)."""
+
+    def __init__(self, exported, feed_names, n_fetch):
+        self._exported = exported
+        self.feed_names = list(feed_names)
+        self.n_fetch = int(n_fetch)
+        self._call = None
+
+    def run_feed(self, feed):
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"load_inference_model program needs feeds {missing}")
+        vals = [
+            v._array if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            for v in (feed[n] for n in self.feed_names)
+        ]
+        if self._call is None:
+            self._call = jax.jit(self._exported.call)
+        out = self._call(*vals)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize the feed->fetch slice of a captured Program with its
+    current parameter values baked in."""
+    prog = program if program is not None else default_main_program()
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    feed_names = [getattr(t, "name", None) for t in feed_vars]
+    if any(n is None for n in feed_names):
+        raise ValueError(
+            "save_inference_model: feed_vars must be static.data placeholders "
+            "(they carry the feed name)"
+        )
+    unknown = [n for n in feed_names if n not in prog._feeds]
+    if unknown:
+        raise ValueError(
+            f"save_inference_model: feeds {unknown} are not registered in "
+            "this program (placeholders from a different Program?)"
+        )
+    fetch_ids = [id(t._array) for t in fetch_vars]
+    externals, run = prog._plan(feed_names, fetch_ids)
+    # a placeholder that feeds the fetch slice but is NOT in feed_vars would
+    # be baked in as its capture-time zeros — silent wrong inference; refuse
+    feed_aids = set(prog._feeds.values())
+    listed = {prog._feeds[n] for n in feed_names}
+    baked_placeholders = [
+        n for n, aid in prog._feeds.items()
+        if aid in feed_aids - listed and any(aid == e[0] for e in externals)
+    ]
+    if baked_placeholders:
+        raise ValueError(
+            "save_inference_model: placeholders "
+            f"{sorted(baked_placeholders)} reach the fetch targets but are "
+            "not in feed_vars — they would be baked into the artifact as "
+            "capture-time zeros"
+        )
+    ext_vals = prog._external_values(externals)
+
+    # feed avals from the capture-time placeholder arrays (registration
+    # guarantees they are in _keepalive)
+    by_id = {id(a): a for a in prog._keepalive}
+    avals = [
+        jax.ShapeDtypeStruct(by_id[prog._feeds[n]].shape,
+                             by_id[prog._feeds[n]].dtype)
+        for n in feed_names
+    ]
+
+    def fn(*feed_vals):
+        return tuple(run(list(feed_vals), ext_vals))  # weights baked
+
+    from ..jit.api import _EXPORT_DISABLED_CHECKS
+
+    exp = jax.export.export(
+        jax.jit(fn), disabled_checks=list(_EXPORT_DISABLED_CHECKS)
+    )(*avals)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(
+            {
+                "format": _FORMAT,
+                "stablehlo": exp.serialize(),
+                "feed_names": feed_names,
+                "n_fetch": len(fetch_ids),
+            },
+            f,
+        )
+    return path_prefix + ".pdmodel"
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns [inference_program, feed_target_names, fetch_targets] — the
+    reference contract; pass the program + fetch_targets straight to
+    `Executor.run`."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        artifact = pickle.load(f)
+    if artifact.get("format") != _FORMAT:
+        raise ValueError(
+            f"not a static inference artifact: {artifact.get('format')!r} "
+            "(jit.save artifacts load via paddle_tpu.jit.load)"
+        )
+    exported = jax.export.deserialize(artifact["stablehlo"])
+    prog = LoadedInferenceProgram(
+        exported, artifact["feed_names"], artifact["n_fetch"]
+    )
+    fetch_targets = list(range(prog.n_fetch))
+    return [prog, list(prog.feed_names), fetch_targets]

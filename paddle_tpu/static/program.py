@@ -193,6 +193,16 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
         prog = program if program is not None else _default_main
         feed = feed or {}
+        # loaded inference artifacts (static.load_inference_model) execute
+        # their baked StableHLO directly — same Executor.run call site as
+        # the reference's inference_program
+        if hasattr(prog, "run_feed"):
+            outs = prog.run_feed(feed)
+            if fetch_list:
+                outs = [outs[int(i)] for i in fetch_list]
+            if return_numpy:
+                return [np.asarray(o) for o in outs]
+            return outs
         fetch_list = fetch_list or []
         feed_names = tuple(sorted(feed))
         fetch_ids = tuple(

@@ -201,3 +201,60 @@ def test_cond_under_to_static_grad():
     g_neg = jax.grad(loss)(jnp.array([-1.0, -1.0]))
     assert np.allclose(np.asarray(g_pos), 2.0)
     assert np.allclose(np.asarray(g_neg), 3.0)
+
+
+def test_save_load_inference_model_roundtrip(tmp_path):
+    """static.save_inference_model bakes the feed->fetch slice + current
+    weights into a StableHLO artifact; load_inference_model returns the
+    reference [program, feed_names, fetch_targets] triple that Executor.run
+    executes in a fresh-graph world (reference static/io.py:442)."""
+    import os
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    net.eval()
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [3, 4], "float32")
+        y = net(x)
+    exe = static.Executor()
+    rs = np.random.RandomState(0)
+    xv = rs.rand(3, 4).astype(np.float32)
+    want = exe.run(prog, feed={"x": xv}, fetch_list=[y])[0]
+
+    prefix = str(tmp_path / "inf")
+    out_path = static.save_inference_model(prefix, [x], [y], exe, program=prog)
+    assert os.path.exists(out_path)
+
+    # weights changing AFTER save must not affect the baked artifact
+    for p in net.parameters():
+        p.set_value(np.zeros_like(p.numpy()))
+
+    loaded, feed_names, fetch_targets = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    got = exe.run(loaded, feed={"x": xv}, fetch_list=fetch_targets)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_save_inference_model_refuses_baked_placeholder(tmp_path):
+    """A placeholder reaching the fetch but missing from feed_vars must be
+    refused (it would bake in as capture-time zeros — silent wrong output)."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.seed(0)
+    prog = static.Program()
+    with static.program_guard(prog):
+        a = static.data("a", [2, 3], "float32")
+        b = static.data("b", [2, 3], "float32")
+        y = a + b
+    with pytest.raises(ValueError, match="baked"):
+        static.save_inference_model(str(tmp_path / "m"), [a], [y], program=prog)
