@@ -1,19 +1,40 @@
-"""Benchmark suite: flagship GPT + ResNet-50 + LeNet on the local chip.
+"""Benchmark suite: flagship GPT + ResNet-50 + LeNet + PP-YOLOE on the local chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-Primary metric stays the flagship GPT train throughput; `extras` carries the
-rest of the BASELINE matrix (BASELINE.json configs): resnet50 samples/sec
-(config 1), LeNet step time (config 0). vs_baseline: the reference publishes
-no numbers (BASELINE.md) — 1.0 = recorded placeholder until an A100 anchor
-measurement exists.
+Driver contract: prints JSON lines of the form
+{"metric", "value", "unit", "vs_baseline", ...extras}.
+The flagship GPT line is printed and FLUSHED the moment the GPT bench
+finishes, so a driver that kills the suite mid-run still captures the
+primary number (round 4's bench exceeded the driver budget and recorded
+rc=124 with no output — never again). The final line repeats the primary
+metric with all extras merged; both lines are valid driver output.
+
+Budget discipline:
+- whole-suite hard wall clock (BENCH_BUDGET_S, default 1140 s)
+- per-bench subprocess timeout bounded by remaining budget
+- inside each child, the sweep checks the deadline before each batch and
+  stops early, so the child always prints what it measured
+- one attempt per batch size; no retry sleeps. Errors are carried in the
+  "errors" field of the output rather than swallowed.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md) — 1.0 = recorded
+placeholder until an A100 anchor measurement exists.
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+_T0 = time.monotonic()
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1140"))
+
+
+def _remaining():
+    return _BUDGET_S - (time.monotonic() - _T0)
+
 
 # bf16 peak FLOP/s by TPU generation (public spec sheets)
 _PEAK_FLOPS = {
@@ -49,27 +70,30 @@ def _train_flops_per_token(cfg) -> float:
     return 6.0 * n_matmul + attn
 
 
-def _retrying_sweep(run, batches, iters, errors, name=""):
-    """Run `run(batch, iters)` per batch with OOM short-circuit + transient
-    retry (remote-compile transport resets); returns {batch: value}."""
+def _log(msg):
+    print(f"[bench +{time.monotonic() - _T0:.0f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _sweep(run, batches, iters, errors, deadline_s, name=""):
+    """Run `run(batch, iters)` once per batch. OOM short-circuits (a larger
+    batch will OOM too); the deadline stops the sweep so the child always
+    gets to print. All failures land in `errors` — nothing is retried or
+    silently dropped (a batch that fails shows up in the output)."""
     sweep = {}
-    oom = False
     for b in batches:
-        for attempt in range(3):
-            try:
-                sweep[b] = run(b, iters)
-                break
-            except Exception as e:  # noqa: BLE001 — a red bench gate helps no one
-                msg = f"{type(e).__name__}: {e}"
-                errors.append(f"{name} batch={b} attempt={attempt + 1}: {msg[:300]}")
-                if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
-                    oom = True
-                    break  # OOM is deterministic — larger batches will too
-                if "tpu_compile_helper" in msg:
-                    break
-                time.sleep(5.0 * (attempt + 1))
-        if oom:
+        if time.monotonic() > deadline_s:
+            errors.append(f"{name}: deadline before batch={b}; partial sweep")
             break
+        t0 = time.monotonic()
+        try:
+            sweep[b] = run(b, iters)
+            _log(f"{name} batch={b}: {sweep[b]:.1f} in {time.monotonic() - t0:.0f}s")
+        except Exception as e:  # noqa: BLE001 — a red bench gate helps no one
+            msg = f"{type(e).__name__}: {e}"
+            errors.append(f"{name} batch={b}: {msg[:300]}")
+            _log(f"{name} batch={b}: FAILED after {time.monotonic() - t0:.0f}s")
+            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+                break
     return sweep
 
 
@@ -77,7 +101,7 @@ def _retrying_sweep(run, batches, iters, errors, name=""):
 # GPT (primary metric)
 # ---------------------------------------------------------------------------
 
-def bench_gpt(on_tpu, errors):
+def bench_gpt(on_tpu, errors, deadline_s):
     import jax
     import jax.numpy as jnp
 
@@ -124,8 +148,8 @@ def bench_gpt(on_tpu, errors):
     lr = jnp.asarray(1e-4, jnp.float32)
     rs = np.random.RandomState(0)
 
-    # host snapshot: donation invalidates device buffers, so any retry after
-    # a mid-step failure must re-materialize state from host copies
+    # host snapshot: donation invalidates device buffers, so a fresh batch
+    # size must re-materialize state from host copies
     snap = jax.tree_util.tree_map(np.asarray, (params, buffers, opt_state))
 
     def run(batch, iters):
@@ -145,9 +169,10 @@ def bench_gpt(on_tpu, errors):
         dt = time.perf_counter() - t0
         return batch * seq * iters / dt
 
-    batches = (8, 16, 32, 64) if on_tpu else (2,)
+    # r4 sweep: batch 16 won (98.5k) and 64 OOM'd/regressed — 3 sizes suffice
+    batches = (8, 16, 32) if on_tpu else (2,)
     iters = 20 if on_tpu else 3
-    sweep = _retrying_sweep(run, batches, iters, errors, name="gpt")
+    sweep = _sweep(run, batches, iters, errors, deadline_s, name="gpt")
     if not sweep:
         return None
     best_batch = max(sweep, key=sweep.get)
@@ -163,10 +188,10 @@ def bench_gpt(on_tpu, errors):
 
 
 # ---------------------------------------------------------------------------
-# ResNet-50 (BASELINE config 1)
+# ResNet-50 (BASELINE config 1) — NHWC, the TPU-native layout
 # ---------------------------------------------------------------------------
 
-def bench_resnet50(on_tpu, errors):
+def bench_resnet50(on_tpu, errors, deadline_s):
     import jax
     import jax.numpy as jnp
 
@@ -176,7 +201,10 @@ def bench_resnet50(on_tpu, errors):
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
-    model = resnet50()
+    # NHWC: channels-minor makes BN reductions lane-contiguous and feeds the
+    # MXU directly (resnet.py module docstring); NCHW was the round-4 number
+    # (2,253 img/s MFU 0.14) with conv absent from the top-25 self-time ops.
+    model = resnet50(data_format="NHWC")
     model.to(dtype="bfloat16")
     opt = paddle.optimizer.Momentum(
         learning_rate=0.1, momentum=0.9, parameters=model.parameters()
@@ -210,7 +238,7 @@ def bench_resnet50(on_tpu, errors):
     def run(batch, iters):
         params, buffers, opt_state = jax.tree_util.tree_map(jnp.asarray, snap)
         images = jnp.asarray(
-            rs.rand(batch, 3, side, side).astype(np.float32), jnp.bfloat16
+            rs.rand(batch, side, side, 3).astype(np.float32), jnp.bfloat16
         )
         labels = jnp.asarray(rs.randint(0, 1000, (batch,), dtype=np.int32))
         loss, params, buffers, opt_state = jstep(
@@ -225,9 +253,9 @@ def bench_resnet50(on_tpu, errors):
         float(np.asarray(loss))
         return batch * iters / (time.perf_counter() - t0)
 
-    batches = (64, 128, 256) if on_tpu else (2,)
+    batches = (128, 256) if on_tpu else (2,)
     iters = 20 if on_tpu else 2
-    sweep = _retrying_sweep(run, batches, iters, errors, name="resnet50")
+    sweep = _sweep(run, batches, iters, errors, deadline_s, name="resnet50")
     if not sweep:
         return None
     best = max(sweep, key=sweep.get)
@@ -238,6 +266,7 @@ def bench_resnet50(on_tpu, errors):
         "samples_per_sec": round(sweep[best], 1),
         "mfu": round(sweep[best] * train_flops / peak, 4),
         "batch": best,
+        "layout": "NHWC",
         "sweep": {str(k): round(v, 1) for k, v in sweep.items()},
     }
 
@@ -246,7 +275,7 @@ def bench_resnet50(on_tpu, errors):
 # PP-YOLOE-s inference latency (BASELINE config 4)
 # ---------------------------------------------------------------------------
 
-def bench_ppyoloe(on_tpu, errors):
+def bench_ppyoloe(on_tpu, errors, deadline_s):
     """Batch-1 detection latency: PP-YOLOE-s net + decode + matrix NMS as
     ONE compiled program (the predictor's bucket machinery is exercised in
     tests/test_detection.py; here we time the compiled detect step itself)."""
@@ -291,11 +320,13 @@ def bench_ppyoloe(on_tpu, errors):
 # LeNet Model.fit step time (BASELINE config 0)
 # ---------------------------------------------------------------------------
 
-def bench_lenet(on_tpu, errors):
+def bench_lenet(on_tpu, errors, deadline_s):
     import jax
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
+    from paddle_tpu.core import rng
+    from paddle_tpu.core.functional import functional_call, state_dict_arrays
     from paddle_tpu.vision.models import LeNet
 
     paddle.seed(0)
@@ -314,8 +345,10 @@ def bench_lenet(on_tpu, errors):
         model.train_batch([x], [y])
     dt = (time.perf_counter() - t0) / iters
     # train_batch syncs the loss to host every step; through the remote-TPU
-    # tunnel that round trip dominates tiny models. Record it so step_ms is
-    # interpretable: compute time ~= step_ms - sync overhead.
+    # tunnel that round trip dominates tiny models. Record the measured
+    # round-trip AND a device-resident number so the framework's own step
+    # cost is visible: a lax.scan of 50 training steps inside ONE program
+    # has no per-step host sync (what a real input-pipelined run achieves).
     f = jax.jit(lambda a: a + 1.0)
     z = jnp.zeros(8)
     np.asarray(f(z))
@@ -323,26 +356,65 @@ def bench_lenet(on_tpu, errors):
     for _ in range(10):
         np.asarray(f(z))
     sync_ms = (time.perf_counter() - t0) / 10 * 1e3
+
+    net = LeNet()
+    opt2 = paddle.optimizer.Adam(learning_rate=1e-3, parameters=net.parameters())
+    params, buffers = state_dict_arrays(net)
+    opt_state = opt2.init_state_arrays(params)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    xs = jnp.asarray(rs.rand(64, 1, 28, 28).astype(np.float32))
+    ys = jnp.asarray(rs.randint(0, 10, (64,), dtype=np.int32))
+
+    def one(carry, key):
+        params, buffers, opt_state = carry
+
+        def loss_fn(p):
+            logits, nb = functional_call(
+                net, p, buffers, args=(xs,), rng_key=key, training=True
+            )
+            lg = logits.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(lg, axis=-1)
+            picked = jnp.take_along_axis(lg, ys[:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - picked), nb
+
+        (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        np_, no_ = opt2.apply_gradients_arrays(params, grads, opt_state, lr)
+        return (np_, nb, no_), loss
+
+    @jax.jit
+    def scan_steps(carry, keys):
+        return jax.lax.scan(one, carry, keys)
+
+    keys = jax.random.split(rng.next_key(), 50)
+    carry = (params, buffers, opt_state)
+    carry, losses = scan_steps(carry, keys)  # compile
+    jax.block_until_ready(losses)
+    t0 = time.perf_counter()
+    carry, losses = scan_steps(carry, keys)
+    jax.block_until_ready(losses)
+    device_ms = (time.perf_counter() - t0) / 50 * 1e3
     return {"step_ms": round(dt * 1e3, 3), "batch": 64,
-            "host_sync_roundtrip_ms": round(sync_ms, 2)}
+            "host_sync_roundtrip_ms": round(sync_ms, 2),
+            "device_resident_step_ms": round(device_ms, 3)}
 
 
 _BENCHES = {
-    "gpt": lambda on_tpu, errors: bench_gpt(on_tpu, errors),
-    "resnet50": lambda on_tpu, errors: bench_resnet50(on_tpu, errors),
-    "lenet": lambda on_tpu, errors: bench_lenet(on_tpu, errors),
-    "ppyoloe": lambda on_tpu, errors: bench_ppyoloe(on_tpu, errors),
+    "gpt": bench_gpt,
+    "resnet50": bench_resnet50,
+    "lenet": bench_lenet,
+    "ppyoloe": bench_ppyoloe,
 }
 
 
-def _child(name):
+def _child(name, soft_deadline_s):
     """Run ONE benchmark and print its JSON on the last line."""
     import jax
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
+    deadline = time.monotonic() + soft_deadline_s
     errors = []
     try:
-        result = _BENCHES[name](on_tpu, errors)
+        result = _BENCHES[name](on_tpu, errors, deadline)
     except Exception as e:  # noqa: BLE001
         errors.append(f"{name}: {type(e).__name__}: {str(e)[:300]}")
         result = None
@@ -350,15 +422,20 @@ def _child(name):
     return 0
 
 
-def _run_isolated(name, timeout_s=2400):
+def _run_isolated(name, timeout_s):
     """Each benchmark gets its own process: device memory fully released
     between benches, and one bench's OOM cannot poison the next (an
-    in-process OOM leaves the PjRt allocator poisoned for later benches)."""
+    in-process OOM leaves the PjRt allocator poisoned for later benches).
+    The child gets a soft deadline 30 s inside the hard kill so it can
+    print a partial sweep before the subprocess timeout fires."""
     import subprocess
 
+    if timeout_s < 60:
+        return {"result": None,
+                "errors": [f"{name}: skipped — {timeout_s:.0f}s left in budget"]}
     try:
         proc = subprocess.run(
-            [sys.executable, __file__, name],
+            [sys.executable, __file__, name, str(max(30.0, timeout_s - 30.0))],
             capture_output=True, text=True, timeout=timeout_s,
         )
         for line in reversed(proc.stdout.strip().splitlines()):
@@ -368,48 +445,72 @@ def _run_isolated(name, timeout_s=2400):
         return {"result": None,
                 "errors": [f"{name}: no output (rc={proc.returncode}) "
                            f"{proc.stderr[-200:]}"]}
-    except subprocess.TimeoutExpired:
-        return {"result": None, "errors": [f"{name}: timed out after {timeout_s}s"]}
+    except subprocess.TimeoutExpired as e:
+        # the child may have printed its (partial-sweep) JSON just before
+        # the hard kill — salvage it rather than reporting 0.0
+        out = e.stdout
+        if out:
+            if isinstance(out, bytes):
+                out = out.decode("utf-8", "replace")
+            for line in reversed(out.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        r = json.loads(line)
+                        r.setdefault("errors", []).append(
+                            f"{name}: hard timeout after {timeout_s:.0f}s "
+                            "(salvaged last JSON line)"
+                        )
+                        return r
+                    except ValueError:
+                        break
+        return {"result": None, "errors": [f"{name}: timed out after {timeout_s:.0f}s"]}
     except Exception as e:  # noqa: BLE001
         return {"result": None, "errors": [f"{name}: {type(e).__name__}: {e}"]}
 
 
+def _emit(gpt, extras, errors):
+    out = {
+        "metric": "gpt_train_tokens_per_sec_per_chip",
+        "value": (gpt or {}).get("value", 0.0),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0 if gpt else 0.0,
+    }
+    if gpt:
+        out["mfu"] = gpt["mfu"]
+        out["batch"] = gpt["batch"]
+        out["sweep"] = gpt["sweep"]
+    out.update(extras)
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def main():
-    if len(sys.argv) > 1:
-        return _child(sys.argv[1])
+    if len(sys.argv) > 2:
+        return _child(sys.argv[1], float(sys.argv[2]))
+    if len(sys.argv) > 1:  # legacy single-arg child invocation
+        return _child(sys.argv[1], 600.0)
 
     errors = []
     extras = {}
-    gpt = None
-    for name in ("gpt", "resnet50", "lenet", "ppyoloe"):
-        r = _run_isolated(name)
+
+    # GPT first: the primary metric must land even if the driver kills us.
+    r = _run_isolated("gpt", min(540.0, _remaining()))
+    errors.extend(r.get("errors") or [])
+    gpt = r.get("result")
+    _emit(gpt, {}, errors)  # flushed immediately — this line alone is valid
+
+    for name in ("resnet50", "ppyoloe", "lenet"):
+        r = _run_isolated(name, min(300.0, _remaining()))
         errors.extend(r.get("errors") or [])
-        if name == "gpt":
-            gpt = r.get("result")
-        elif r.get("result"):
+        if r.get("result"):
             extras[name] = r["result"]
 
-    if gpt is None:
-        print(json.dumps({
-            "metric": "gpt_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0,
-            "errors": errors, **extras,
-        }))
-        return 1
-    out = {
-        "metric": "gpt_train_tokens_per_sec_per_chip",
-        "value": gpt["value"],
-        "unit": "tokens/sec",
-        "vs_baseline": 1.0,
-        "mfu": gpt["mfu"],
-        "batch": gpt["batch"],
-        "sweep": gpt["sweep"],
-        **extras,
-    }
-    if errors:
-        out["errors"] = errors
-    print(json.dumps(out))
-    return 0
+    # Final line: primary metric + everything that completed in budget.
+    _emit(gpt, extras, errors)
+    return 0 if gpt else 1
 
 
 if __name__ == "__main__":

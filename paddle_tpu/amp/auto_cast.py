@@ -75,13 +75,25 @@ def amp_dtype_for(op_name):
 
 
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None, save_dtype=None):
-    """O2: cast model params to low precision (master weights stay fp32 in the
-    optimizer's fp32 slots — Adam already keeps fp32 moments+update)."""
+    """O2: cast model params to low precision and keep fp32 MASTER weights in
+    the optimizer (reference amp/auto_cast.py:730 + optimizer/adam.py:92
+    `multi_precision`). The master copy is seeded from the fp32 params BEFORE
+    the cast, lives as a `master_weight` optimizer-state slot, receives the
+    update in fp32, and re-casts the low-precision working param each step —
+    so updates below the bf16 epsilon are not lost. `master_weight=None`
+    defaults to True at O2, matching the reference."""
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
+    opt_single = optimizers is not None and not isinstance(optimizers, (list, tuple))
+    opt_list = [] if optimizers is None else ([optimizers] if opt_single else list(optimizers))
     if level == "O2":
+        use_master = True if master_weight is None else bool(master_weight)
+        if use_master:
+            for opt in opt_list:
+                # seed fp32 masters from the not-yet-cast params
+                opt._seed_master_weights()
         for m in model_list:
             m.to(dtype=dtype)
     if optimizers is None:
         return models if single else model_list
-    return (models if single else model_list), optimizers
+    return (models if single else model_list), (optimizers if opt_single else opt_list)

@@ -161,6 +161,17 @@ def apply(fn, *tensors, name=None, num_outputs=None):
     return out, node
 
 
+def register_state_write(*tensors):
+    """Mark each tensor's CURRENT array (just produced by a recorded op) as a
+    program state write: executors fetch the per-run value and write it back
+    into the tensor, so buffer mutations (BN running stats) persist across
+    static-mode steps instead of freezing at capture time. No-op outside
+    capture."""
+    if _tls.capture is not None and not _tls.trace_mode and _tls.apply_depth == 0:
+        for t in tensors:
+            _tls.capture._register_state_write(id(t._array), t)
+
+
 def _is_float0(x):
     return getattr(x, "dtype", None) == jax.dtypes.float0
 

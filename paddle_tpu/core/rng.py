@@ -94,6 +94,35 @@ def next_key():
     return jax.random.fold_in(_state.key, _state.counter)
 
 
+def capture_key():
+    """Key for an RNG op that may be captured into a static Program.
+
+    Under static-graph capture (paddle.static.program_guard /
+    enable_static), the key is registered as an *RNG slot* of the program:
+    a placeholder input that Executor.run (and the hapi StaticGraphAdapter)
+    substitutes with a fresh per-step key, so dropout masks vary per step
+    instead of being frozen at their capture-time value (reference: random
+    ops re-run per Executor.run). The placeholder itself does not advance
+    the global stream — capture is a dry run, not a training step.
+    Everywhere else this is exactly next_key()."""
+    from . import autograd
+
+    cap = getattr(autograd._tls, "capture", None)
+    if (
+        cap is not None
+        and _state.override is None
+        and not autograd._tls.trace_mode
+        and autograd._tls.apply_depth == 0
+    ):
+        slot = len(cap._rng_aids) + 1
+        # distinct placeholder per slot, high offset so it cannot collide
+        # with the 1-based per-step stream
+        key = jax.random.fold_in(_state.key, 0x7FFF0000 + slot)
+        cap._register_rng_key(key)
+        return key
+    return next_key()
+
+
 @contextlib.contextmanager
 def key_scope(key):
     """Route next_key() through `key` (possibly a tracer) for the duration.

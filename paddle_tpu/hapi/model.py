@@ -51,11 +51,11 @@ class _StaticGraphAdapter:
     identical to dynamic mode because the replay computes the same math on
     the same parameter values.
 
-    Known static-mode deltas (documented, reference-consistent): RNG ops
-    (dropout) are captured with their capture-time key, so masks repeat per
-    step unless re-captured; buffer mutations (BN running stats) stay at
-    capture-time values — fetch/update is a user-level concern as in the
-    reference's startup/main program split."""
+    Un-frozen state (round 5): RNG ops are captured as RNG *slots* re-keyed
+    every step from the same per-step key stream the dynamic adapter uses,
+    so dropout masks vary per step; buffer mutations (BN running stats) are
+    recorded as state writes, fetched each step and written back — static
+    training updates BN state like the reference's in-program state ops."""
 
     def __init__(self, model):
         self.model = model
@@ -84,6 +84,8 @@ class _StaticGraphAdapter:
         ]
         out_list = to_list(outs)
         fetch_ids = [id(loss._array)] + [id(o._array) for o in out_list]
+        # buffer updates (BN stats) ride as extra fetches, written back per step
+        fetch_ids += [aid for aid, _ in prog._state_writes]
         externals, run = prog._plan(feed_names, fetch_ids)
         name_by_id = {
             id(p): n for n, p in net.named_parameters_dict().items()
@@ -111,23 +113,24 @@ class _StaticGraphAdapter:
                     for pos, name in zip(tr_pos, tr_names):
                         ev[pos] = pd[name]
                     res = run(feed_vals, ev)
-                    return res[0], res[1:]
+                    return res[0], (res[1 : 1 + n_outs], res[1 + n_outs :])
 
-                (loss, outs), grads = jax.value_and_grad(
+                (loss, (outs, bufs)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params)
                 new_params, new_opt = opt.apply_gradients_arrays(
                     params, grads, opt_state, lr
                 )
-                return loss, outs, new_params, new_opt
+                return loss, outs, bufs, new_params, new_opt
 
             jstep = jax.jit(step, donate_argnums=(0, 1))
-            self._steps[sig] = (jstep, externals, tr_pos, tr_names)
-        jstep, externals, tr_pos, tr_names = self._steps[sig]
-        # consume one step key exactly like the dynamic adapter does, so the
-        # global RNG stream (and thus e.g. loader shuffle order) is identical
-        # whichever adapter runs — static vs dynamic fit trajectories match
-        rng.next_key()
+            self._steps[sig] = (jstep, prog, externals, tr_pos, tr_names)
+        jstep, prog, externals, tr_pos, tr_names = self._steps[sig]
+        # one step key per batch, exactly like the dynamic adapter (it hands
+        # the key to functional_call; we fold it into the program's RNG
+        # slots the same way key_scope would) — the global stream advances
+        # identically under either adapter, so fit trajectories match
+        step_key = rng.next_key()
         named = net.named_parameters_dict()
         params = {n: named[n]._array for n in tr_names}
         if model._opt_state is None:
@@ -138,12 +141,16 @@ class _StaticGraphAdapter:
         from ..static.program import Program
 
         prog_vals = Program._external_values(externals)
+        prog_vals = prog._substitute_rng(externals, prog_vals, step_key)
         lr = jnp.asarray(model._optimizer.get_lr(), jnp.float32)
-        loss, outs, new_params, new_opt = jstep(
+        loss, outs, bufs, new_params, new_opt = jstep(
             params, opt_state, lr, list(ins) + list(labs), prog_vals
         )
         for n, v in new_params.items():
             named[n]._array = v
+        # persist buffer mutations (BN running stats) computed this step
+        for (aid, target), v in zip(prog._state_writes, bufs):
+            target._array = v
         model._opt_state.update(new_opt)
         model._optimizer._step_count += 1
         model._optimizer.sync_state_arrays(named, model._opt_state)

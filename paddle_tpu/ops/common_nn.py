@@ -60,19 +60,22 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         from .creation import zeros_like
 
         return zeros_like(xt)
-    key = rng.next_key()
+    # the key rides as a real op INPUT (rng.capture_key): under static
+    # capture it becomes an RNG slot the executor re-keys per step, so
+    # masks vary across steps instead of freezing at capture time
+    key = rng.capture_key()
 
-    def f(a):
+    def f(a, k):
         shape = list(a.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
             shape = [s if i in axes else 1 for i, s in enumerate(shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
 
-    return op(f, xt, name="dropout")
+    return op(f, xt, key, name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -90,18 +93,18 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     xt = T(x)
     if not training or p == 0.0:
         return xt
-    key = rng.next_key()
+    key = rng.capture_key()
     alpha = 1.6732632423543772848170429916717
     scale = 1.0507009873554804934193349852946
     alpha_p = -alpha * scale
 
-    def f(a):
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+    def f(a, k):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
         coef_a = (1.0 - p + p * alpha_p**2 * (1.0 - p)) ** -0.5
         coef_b = -coef_a * alpha_p * p
         return coef_a * jnp.where(keep, a, alpha_p) + coef_b
 
-    return op(f, xt, name="alpha_dropout")
+    return op(f, xt, key, name="alpha_dropout")
 
 
 def interpolate(
@@ -209,15 +212,21 @@ def scaled_dot_product_attention(
     from .pallas.flash_attention import flash_attention_array
 
     qt, kt, vt = T(query), T(key), T(value)
-    drop_key = rng.next_key() if (dropout_p > 0 and training) else None
-    # the mask rides as a real op INPUT (trainable additive biases get
-    # gradients; static capture sees it as data, not a baked constant)
+    use_drop = dropout_p > 0 and training
+    # the mask and the dropout key ride as real op INPUTS (trainable
+    # additive biases get gradients; static capture sees data, not baked
+    # constants — and the key becomes a per-step-re-keyed RNG slot)
     args = (qt, kt, vt) + ((T(attn_mask),) if attn_mask is not None else ())
+    has_mask = attn_mask is not None
+    if use_drop:
+        args = args + (T(rng.capture_key()),)
 
-    def f(q, k, v, *mask):
+    def f(q, k, v, *rest):
+        rest = list(rest)
+        dk = rest.pop() if use_drop else None
         return flash_attention_array(
-            q, k, v, mask=mask[0] if mask else None, causal=is_causal,
-            dropout_p=dropout_p if training else 0.0, dropout_key=drop_key,
+            q, k, v, mask=rest[0] if has_mask else None, causal=is_causal,
+            dropout_p=dropout_p if training else 0.0, dropout_key=dk,
         )
 
     out, node = autograd.apply(f, *args, name="sdpa")

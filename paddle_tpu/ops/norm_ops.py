@@ -85,9 +85,25 @@ def batch_norm(
         n = 1
         for ax in axes:
             n *= xt._array.shape[ax]
-        unbiased = bv * (n / max(n - 1, 1))
-        rm._array = momentum * rm._array + (1.0 - momentum) * bm.astype(rm._array.dtype)
-        rv._array = momentum * rv._array + (1.0 - momentum) * unbiased.astype(rv._array.dtype)
+        factor = n / max(n - 1, 1)
+
+        # the running-stat update is itself an op through the funnel: under
+        # static capture it lands in the op log (+ a state-write registration)
+        # so Executor.run recomputes AND persists buffers every step — the
+        # reference updates BN state inside the main program the same way
+        def upd(rm_a, rv_a, bm_a, bv_a):
+            new_rm = momentum * rm_a + (1.0 - momentum) * bm_a.astype(rm_a.dtype)
+            new_rv = momentum * rv_a + (1.0 - momentum) * (
+                bv_a.astype(rv_a.dtype) * factor
+            )
+            return new_rm, new_rv
+
+        upd_out, _ = autograd.apply(
+            upd, rm, rv, Tensor._from_op(bm), Tensor._from_op(bv),
+            name="bn_stats_update",
+        )
+        rm._array, rv._array = upd_out
+        autograd.register_state_write(rm, rv)
         return Tensor._from_op(out, node, 0)
 
     m_arr, v_arr = rm._array, rv._array

@@ -47,6 +47,11 @@ class Optimizer:
         self._name = name
         self._jit_cache = {}  # per-instance jitted update fns
         self._apply_decay_param_fun = None
+        # multi_precision (reference optimizer/adam.py:92 master weights):
+        # when on, low-precision params get an fp32 "master_weight" state
+        # slot; the update applies to the master and the working param is a
+        # re-cast of it, so sub-epsilon bf16 updates are not lost.
+        self._multi_precision = False
 
     # ---- lr ----------------------------------------------------------------
     def get_lr(self):
@@ -64,11 +69,38 @@ class Optimizer:
     def _get_state(self, p):
         key = id(p)
         if key not in self._accumulators:
-            self._accumulators[key] = self._init_slots(p._array)
+            self._accumulators[key] = self._init_state(p._array)
         return self._accumulators[key]
 
     def _init_slots(self, arr):
         return {}
+
+    _MASTER_DTYPES = ("bfloat16", "float16")
+
+    def _init_state(self, arr):
+        st = self._init_slots(arr)
+        if self._multi_precision and str(arr.dtype) in self._MASTER_DTYPES:
+            st["master_weight"] = arr.astype(jnp.float32)
+        return st
+
+    def _seed_master_weights(self):
+        """Capture fp32 master copies of the CURRENT params. Called by
+        `amp.decorate(..., level="O2", master_weight=True)` before the model
+        is cast to low precision, so masters start from the true fp32 values
+        rather than an already-rounded bf16 copy."""
+        self._multi_precision = True
+        for p in self._params:
+            st = self._get_state(p)
+            if "master_weight" not in st:
+                st["master_weight"] = p._array.astype(jnp.float32)
+
+    @staticmethod
+    def _split_master(state):
+        """(work_state_without_master, master_or_None)."""
+        if "master_weight" in state:
+            st = dict(state)
+            return st, st.pop("master_weight")
+        return state, None
 
     # ---- the pure update rule (override) ------------------------------------
     def _update(self, param, grad, lr, state, **hyper):
@@ -100,11 +132,16 @@ class Optimizer:
         decoupled = self._decoupled_wd
 
         def f(param, grad, lr, state, hyper):
+            state, master = Optimizer._split_master(state)
+            work = param if master is None else master
             if wd and not decoupled:
-                grad = grad + wd * param.astype(grad.dtype)
-            new_p, new_s = update(param, grad, lr, state, **hyper)
+                grad = grad + wd * work.astype(grad.dtype)
+            new_p, new_s = update(work, grad, lr, state, **hyper)
             if wd and decoupled:
-                new_p = new_p - (lr * wd * param.astype(jnp.float32)).astype(new_p.dtype)
+                new_p = new_p - (lr * wd * work.astype(jnp.float32)).astype(new_p.dtype)
+            if master is not None:
+                new_s = dict(new_s)
+                new_s["master_weight"] = new_p.astype(jnp.float32)
             return new_p.astype(param.dtype), new_s
 
         jf = jax.jit(f, donate_argnums=(0, 3))
@@ -153,7 +190,7 @@ class Optimizer:
 
     # ---- functional API (compiled train step) -------------------------------
     def init_state_arrays(self, params: dict):
-        return {k: self._init_slots(a) for k, a in params.items()}
+        return {k: self._init_state(a) for k, a in params.items()}
 
     def state_arrays_for(self, named_params: dict):
         """Compiled-path state seeded from eager accumulators when present.
@@ -166,7 +203,7 @@ class Optimizer:
         out = {}
         for k, p in named_params.items():
             st = self._accumulators.get(id(p))
-            out[k] = dict(st) if st else self._init_slots(p._array)
+            out[k] = dict(st) if st else self._init_state(p._array)
         return out
 
     def sync_state_arrays(self, named_params: dict, state: dict):
@@ -194,15 +231,20 @@ class Optimizer:
                 new_params[k] = p
                 new_state[k] = state.get(k, {})
                 continue
-            g = g.astype(p.dtype)
+            st, master = self._split_master(state[k])
+            work = p if master is None else master
+            g = g.astype(work.dtype)
             if grad_scale is not None:
                 g = g * grad_scale
             wd_k = wd if (decay_fn is None or decay_fn(k)) else 0.0
             if wd_k and not self._decoupled_wd:
-                g = g + wd_k * p
-            np_, ns = self._update(p, g, lr, state[k], **hyper)
+                g = g + wd_k * work.astype(g.dtype)
+            np_, ns = self._update(work, g, lr, st, **hyper)
             if wd_k and self._decoupled_wd:
-                np_ = np_ - (lr * wd_k * p.astype(jnp.float32)).astype(np_.dtype)
+                np_ = np_ - (lr * wd_k * work.astype(jnp.float32)).astype(np_.dtype)
+            if master is not None:
+                ns = dict(ns)
+                ns["master_weight"] = np_.astype(jnp.float32)
             new_params[k] = np_.astype(p.dtype)
             new_state[k] = ns
         return new_params, new_state
@@ -242,7 +284,7 @@ class Optimizer:
             if p.name not in names:
                 names.append(p.name)
             slots = {}
-            for slot in self._slot_names:
+            for slot in tuple(self._slot_names) + ("master_weight",):
                 for nm in names:
                     k = f"{nm}_{slot}"
                     if k in state_dict:
@@ -257,7 +299,7 @@ class Optimizer:
                         slots[slot] = arr
                         break
             if slots:
-                st = self._init_slots(p._array)
+                st = self._init_state(p._array)
                 st.update(slots)
                 self._accumulators[id(p)] = st
 

@@ -12,8 +12,9 @@ from .optimizer import L2Decay, Optimizer
 
 
 class SGD(Optimizer):
-    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._multi_precision = bool(multi_precision)
 
     def _update(self, param, grad, lr, state):
         return param - lr.astype(param.dtype) * grad, state
@@ -22,13 +23,17 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     _slot_names = ("velocity",)
 
-    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+        self._multi_precision = bool(multi_precision)
 
     def _init_slots(self, arr):
-        return {"velocity": jnp.zeros_like(arr)}
+        # velocity accumulates in f32 regardless of param dtype: a bf16
+        # accumulator drops gradient contributions below ~2^-8 of the
+        # velocity magnitude — the exact loss multi_precision exists to stop
+        return {"velocity": jnp.zeros_like(arr, jnp.float32)}
 
     def _update(self, param, grad, lr, state):
         mu = self._momentum
@@ -48,6 +53,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._multi_precision = bool(multi_precision)
 
     def _init_slots(self, arr):
         return {
@@ -297,7 +303,12 @@ class Lars(Optimizer):
         upd = functools.partial(self._update, apply_lars_wd=bool(apply_wd))
 
         def f(param, grad, lr, state, hyper):
-            new_p, new_s = upd(param, grad, lr, state, **hyper)
+            state, master = Optimizer._split_master(state)
+            work = param if master is None else master
+            new_p, new_s = upd(work, grad, lr, state, **hyper)
+            if master is not None:
+                new_s = dict(new_s)
+                new_s["master_weight"] = new_p.astype(jnp.float32)
             return new_p.astype(param.dtype), new_s
 
         jf = jax.jit(f, donate_argnums=(0, 3))
@@ -318,12 +329,17 @@ class Lars(Optimizer):
                 new_params[k] = p
                 new_state[k] = state.get(k, {})
                 continue
-            g = g.astype(p.dtype)
+            st, master = self._split_master(state[k])
+            work = p if master is None else master
+            g = g.astype(work.dtype)
             if grad_scale is not None:
                 g = g * grad_scale
             np_, ns = self._update(
-                p, g, lr, state[k], apply_lars_wd=self._name_decays(k)
+                work, g, lr, st, apply_lars_wd=self._name_decays(k)
             )
+            if master is not None:
+                ns = dict(ns)
+                ns["master_weight"] = np_.astype(jnp.float32)
             new_params[k] = np_.astype(p.dtype)
             new_state[k] = ns
         return new_params, new_state

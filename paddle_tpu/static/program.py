@@ -40,6 +40,13 @@ class Program:
         self._feeds = {}  # name -> placeholder array id
         self._keepalive = []  # captured arrays (id stability)
         self.random_seed = None
+        # RNG slots: capture-time placeholder key arrays (by id) that every
+        # run substitutes with fresh per-step keys (rng.capture_key)
+        self._rng_aids = set()
+        # state writes: (aid_of_new_value, target_tensor) — buffer mutations
+        # (BN running stats) recorded as ops; executors fetch the new values
+        # and write them back so static-mode training updates buffers
+        self._state_writes = []
 
     # ---- capture ----------------------------------------------------------
     def _record_op(self, fn, tensors, arrays, out):
@@ -54,6 +61,32 @@ class Program:
         self._feeds[name] = id(placeholder_array)
         self._keepalive.append(placeholder_array)
         self.version += 1
+
+    def _register_rng_key(self, key_array):
+        self._rng_aids.add(id(key_array))
+        self._keepalive.append(key_array)
+        self.version += 1
+
+    def _register_state_write(self, aid, tensor):
+        self._state_writes.append((aid, tensor))
+        self.version += 1
+
+    def _substitute_rng(self, externals, vals, step_key):
+        """Replace RNG-slot placeholder values with keys derived from
+        `step_key` exactly the way key_scope derives them (fold_in with a
+        1-based counter, in first-use program order) — so a static run and a
+        functional_call with the same step key draw the same masks."""
+        if not self._rng_aids:
+            return vals
+        out = []
+        i = 0
+        for (aid, _), v in zip(externals, vals):
+            if aid in self._rng_aids:
+                i += 1
+                out.append(jax.random.fold_in(step_key, i))
+            else:
+                out.append(v)
+        return out
 
     # ---- introspection (parity helpers) -----------------------------------
     def num_ops(self):
@@ -212,15 +245,28 @@ class Executor:
             f._array if isinstance(f, Tensor) else jnp.asarray(np.asarray(f))
             for f in (feed[n] for n in feed_names)
         ]
+        # buffer mutations (BN running stats) ride as extra fetches and are
+        # written back after the run — static-mode training updates state
+        # exactly like the reference's in-program state ops
+        sw_aids = tuple(aid for aid, _ in prog._state_writes)
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals)
         key = (prog.id, prog.version, feed_names, sig, fetch_ids)
         entry = self._cache.get(key)
         if entry is None:
-            externals, run = prog._plan(feed_names, fetch_ids)
+            externals, run = prog._plan(feed_names, fetch_ids + sw_aids)
             entry = (externals, jax.jit(run))
             self._cache[key] = entry
         externals, jrun = entry
-        outs = jrun(feed_vals, prog._external_values(externals))
+        ext_vals = prog._external_values(externals)
+        if prog._rng_aids:
+            from ..core import rng as _rng
+
+            ext_vals = prog._substitute_rng(externals, ext_vals, _rng.next_key())
+        outs = jrun(feed_vals, ext_vals)
+        if sw_aids:
+            for (aid, target), v in zip(prog._state_writes, outs[len(fetch_ids):]):
+                target._array = v
+            outs = outs[: len(fetch_ids)]
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return outs

@@ -1,0 +1,162 @@
+"""Static mode is no longer frozen (VERDICT r4 item 4 / Weak #4).
+
+Two capture-time freezes are gone:
+- RNG ops (dropout) captured into a Program are RNG *slots*: Executor.run
+  and the hapi StaticGraphAdapter substitute a fresh per-step key, so masks
+  vary across steps (reference: random ops re-execute per Executor.run).
+- Buffer mutations (BN running stats) are recorded as state writes: the
+  executor fetches the new values each run and writes them back, so
+  `enable_static()` training updates BN statistics like the reference's
+  in-program state ops (fluid/executor.py:1394 runs the full main program).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def _fresh_program():
+    return static.Program()
+
+
+def test_executor_dropout_varies_per_run():
+    prog = _fresh_program()
+    paddle.seed(7)
+    with static.program_guard(prog):
+        x = static.data("x", [32, 64], "float32")
+        y = nn.functional.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    feed = {"x": np.ones((32, 64), np.float32)}
+    a = exe.run(prog, feed=feed, fetch_list=[y])[0]
+    b = exe.run(prog, feed=feed, fetch_list=[y])[0]
+    # masks actually drop ~half, and DIFFER between runs
+    assert 0.3 < (a == 0).mean() < 0.7
+    assert not np.array_equal(a, b)
+
+
+def test_executor_dropout_seeded_reproducibility():
+    def run_twice(seed):
+        prog = _fresh_program()
+        paddle.seed(seed)
+        with static.program_guard(prog):
+            x = static.data("x", [16, 32], "float32")
+            y = nn.functional.dropout(x, p=0.5, training=True)
+        exe = static.Executor()
+        feed = {"x": np.ones((16, 32), np.float32)}
+        return [exe.run(prog, feed=feed, fetch_list=[y])[0] for _ in range(2)]
+
+    r1 = run_twice(3)
+    r2 = run_twice(3)
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_executor_bn_stats_update_per_run():
+    prog = _fresh_program()
+    paddle.seed(0)
+    bn = nn.BatchNorm1D(8)
+    bn.train()
+    rs = np.random.RandomState(0)
+    with static.program_guard(prog):
+        x = static.data("x", [16, 8], "float32")
+        y = bn(x)
+    exe = static.Executor()
+
+    mean0 = np.asarray(bn._mean._array).copy()
+    x1 = rs.rand(16, 8).astype(np.float32) + 2.0
+    exe.run(prog, feed={"x": x1}, fetch_list=[y])
+    mean1 = np.asarray(bn._mean._array).copy()
+    # EMA moved toward the batch mean (momentum 0.9)
+    expected1 = 0.9 * mean0 + 0.1 * x1.mean(0)
+    np.testing.assert_allclose(mean1, expected1, rtol=1e-5)
+
+    x2 = rs.rand(16, 8).astype(np.float32) - 1.0
+    exe.run(prog, feed={"x": x2}, fetch_list=[y])
+    mean2 = np.asarray(bn._mean._array).copy()
+    expected2 = 0.9 * mean1 + 0.1 * x2.mean(0)
+    np.testing.assert_allclose(mean2, expected2, rtol=1e-5)
+    # variance buffer moves too (unbiased batch var)
+    assert not np.allclose(np.asarray(bn._variance._array), 1.0)
+
+
+class DropBNNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.bn = nn.BatchNorm1D(32)
+        self.drop = nn.Dropout(0.5)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.drop(self.bn(self.fc1(x))))
+
+
+def _fit_losses(static_mode, steps=6):
+    rs = np.random.RandomState(0)
+    X = rs.rand(steps * 16, 16).astype(np.float32)
+    Y = rs.randint(0, 4, (steps * 16, 1))
+    paddle.seed(11)
+    net = DropBNNet()
+    model = paddle.Model(net)
+    if static_mode:
+        paddle.enable_static()
+    try:
+        model.prepare(
+            paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+            nn.CrossEntropyLoss(),
+        )
+        losses = []
+        for i in range(steps):
+            out = model.train_batch(
+                [paddle.to_tensor(X[i * 16 : (i + 1) * 16])],
+                [paddle.to_tensor(Y[i * 16 : (i + 1) * 16])],
+            )
+            loss = out[0] if not isinstance(out, tuple) else out[0][0]
+            losses.append(float(np.asarray(loss)))
+    finally:
+        if static_mode:
+            paddle.disable_static()
+    return losses, np.asarray(net.bn._mean._array).copy()
+
+
+def test_hapi_static_dropout_and_bn_match_dynamic():
+    """With dropout AND BatchNorm in the model, the static adapter's loss
+    trajectory and final BN running stats match dynamic mode: the per-step
+    keys and the buffer updates are the same computation."""
+    dyn_losses, dyn_mean = _fit_losses(static_mode=False)
+    st_losses, st_mean = _fit_losses(static_mode=True)
+    np.testing.assert_allclose(st_losses, dyn_losses, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(st_mean, dyn_mean, rtol=1e-4)
+    # and the BN stats actually moved off their init (mean starts at 0)
+    assert np.abs(st_mean).max() > 1e-3
+
+
+def test_hapi_static_dropout_masks_vary():
+    """Identical consecutive batches yield different losses (masks differ)."""
+    rs = np.random.RandomState(1)
+    X = rs.rand(16, 16).astype(np.float32)
+    Y = rs.randint(0, 4, (16, 1))
+    paddle.seed(5)
+    net = DropBNNet()
+    model = paddle.Model(net)
+    paddle.enable_static()
+    try:
+        # lr=0 isolates the dropout mask as the ONLY source of variation
+        model.prepare(
+            paddle.optimizer.SGD(learning_rate=0.0, parameters=net.parameters()),
+            nn.CrossEntropyLoss(),
+        )
+        l1 = model.train_batch([paddle.to_tensor(X)], [paddle.to_tensor(Y)])
+        l2 = model.train_batch([paddle.to_tensor(X)], [paddle.to_tensor(Y)])
+    finally:
+        paddle.disable_static()
+    v1 = l1[0] if not isinstance(l1, tuple) else l1[0][0]
+    v2 = l2[0] if not isinstance(l2, tuple) else l2[0][0]
+    assert v1 != v2, (v1, v2)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
