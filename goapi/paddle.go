@@ -1,0 +1,119 @@
+// Package paddle is the Go client for the paddle_tpu serving C ABI
+// (reference parity: /root/reference/paddle/fluid/inference/goapi/ —
+// config.go / predictor.go wrap the C API via cgo; this file wraps
+// csrc/predictor_capi.cc's PD_* surface the same way).
+//
+// Build: the shared library comes from
+//
+//	python -c "import paddle_tpu.inference.capi as c; print(c.build_capi())"
+//
+// then
+//
+//	CGO_CFLAGS="-I${REPO}/goapi" CGO_LDFLAGS="-L${LIBDIR} -lpd_capi" go build
+//
+// Thread-safety matches the C ABI: calls on one Predictor serialize on its
+// handle; distinct Predictors run concurrently.
+package paddle
+
+/*
+#cgo LDFLAGS: -lpd_capi
+#include <stdint.h>
+#include <stdlib.h>
+
+extern const char* PD_GetLastError();
+extern void* PD_PredictorCreate(const char* model_path);
+extern int PD_PredictorRun(void* handle, const float* data,
+                           const int64_t* shape, int ndim);
+extern int PD_GetOutputNumDims(void* handle, int idx);
+extern int PD_GetOutputShape(void* handle, int idx, int64_t* shape_out);
+extern int64_t PD_GetOutputNumel(void* handle, int idx);
+extern int PD_GetOutputData(void* handle, int idx, float* out);
+extern void PD_PredictorDestroy(void* handle);
+*/
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// Predictor executes a jit.save'd paddle_tpu artifact.
+type Predictor struct {
+	handle unsafe.Pointer
+}
+
+func lastError() error {
+	return errors.New(C.GoString(C.PD_GetLastError()))
+}
+
+// NewPredictor loads the artifact at modelPath (the path passed to
+// paddle_tpu.jit.save, without extension).
+//
+// PD_GetLastError is thread-local in the C ABI, so the failing call and the
+// error fetch must run on the same OS thread: every wrapper pins its
+// goroutine with runtime.LockOSThread for the call + error read.
+func NewPredictor(modelPath string) (*Predictor, error) {
+	runtime.LockOSThread()
+	defer runtime.UnlockOSThread()
+	cPath := C.CString(modelPath)
+	defer C.free(unsafe.Pointer(cPath))
+	h := C.PD_PredictorCreate(cPath)
+	if h == nil {
+		return nil, lastError()
+	}
+	p := &Predictor{handle: h}
+	runtime.SetFinalizer(p, func(p *Predictor) { p.Destroy() })
+	return p, nil
+}
+
+// Run feeds one float32 tensor of the given shape and returns every output
+// as (data, shape) pairs.
+func (p *Predictor) Run(data []float32, shape []int64) ([][]float32, [][]int64, error) {
+	if p.handle == nil {
+		return nil, nil, errors.New("predictor destroyed")
+	}
+	runtime.LockOSThread()
+	defer runtime.UnlockOSThread()
+	nOut := C.PD_PredictorRun(
+		p.handle,
+		(*C.float)(unsafe.Pointer(&data[0])),
+		(*C.int64_t)(unsafe.Pointer(&shape[0])),
+		C.int(len(shape)),
+	)
+	if nOut < 0 {
+		return nil, nil, lastError()
+	}
+	outs := make([][]float32, int(nOut))
+	shapes := make([][]int64, int(nOut))
+	for i := 0; i < int(nOut); i++ {
+		nd := C.PD_GetOutputNumDims(p.handle, C.int(i))
+		if nd < 0 {
+			return nil, nil, lastError()
+		}
+		shp := make([]int64, int(nd))
+		if nd > 0 {
+			C.PD_GetOutputShape(p.handle, C.int(i),
+				(*C.int64_t)(unsafe.Pointer(&shp[0])))
+		}
+		numel := C.PD_GetOutputNumel(p.handle, C.int(i))
+		buf := make([]float32, int64(numel))
+		if numel > 0 {
+			if C.PD_GetOutputData(p.handle, C.int(i),
+				(*C.float)(unsafe.Pointer(&buf[0]))) != 0 {
+				return nil, nil, lastError()
+			}
+		}
+		outs[i] = buf
+		shapes[i] = shp
+	}
+	return outs, shapes, nil
+}
+
+// Destroy releases the native handle (also registered as a finalizer).
+func (p *Predictor) Destroy() {
+	if p.handle != nil {
+		C.PD_PredictorDestroy(p.handle)
+		p.handle = nil
+	}
+}

@@ -36,6 +36,29 @@ def _enable_jax_compile_cache():
         pass
 
 
+def _apply_platform_override():
+    """Honor PADDLE_TPU_PLATFORM (e.g. "cpu") before any jax backend use.
+
+    The TPU plugin's sitecustomize forces jax_platforms programmatically, so
+    the plain JAX_PLATFORMS env var is ignored; this package-level override
+    is how SPAWNED processes (distributed.launch children, DataLoader
+    workers, test scripts) reliably run CPU-only — without it they would try
+    to claim the TPU (or hang if the tunnel is down) just by importing
+    paddle_tpu. tests/conftest.py sets it so every subprocess a test spawns
+    inherits the fake-backend platform."""
+    import os
+
+    plat = os.environ.get("PADDLE_TPU_PLATFORM")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # never block import
+            pass
+
+
+_apply_platform_override()
 _enable_jax_compile_cache()
 
 # --- core ------------------------------------------------------------------

@@ -247,6 +247,16 @@ class Tensor:
         return prefix + np.array2string(np.asarray(self._array), prefix="       ") + ")"
 
     def __bool__(self):
+        import jax as _jax
+
+        if isinstance(self._array, _jax.core.Tracer):
+            # a named, actionable error instead of jax's deep trace error —
+            # jit.to_static catches it and retries with AST-converted
+            # control flow (jit/dy2static.py; reference
+            # jit/dy2static/ifelse_transformer.py:56)
+            from ..jit.dy2static import _HINT, Dy2StaticControlFlowError
+
+            raise Dy2StaticControlFlowError(_HINT)
         return bool(self._array)
 
     def __int__(self):

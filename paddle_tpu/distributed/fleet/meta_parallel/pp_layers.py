@@ -42,7 +42,7 @@ class SharedLayerDesc(LayerDesc):
 
 
 class PipelineLayer(Layer):
-    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None, seg_method="uniform", recompute_interval=0, recompute_ctx=None, num_virtual_pipeline_stages=None):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None, seg_method="uniform", recompute_interval=0, recompute_ctx=None, num_virtual_pipeline_stages=None, seg_sample_input=None):
         super().__init__()
         self._loss_fn = loss_fn
         self._topo = topology
@@ -62,7 +62,6 @@ class PipelineLayer(Layer):
         else:
             self.stage_id = 0
 
-        self.segment_parts = self._segment(seg_method)
         self.run_all = True  # single-process: run every stage
         built = []
         for i, desc in enumerate(self._layer_descs):
@@ -70,6 +69,22 @@ class PipelineLayer(Layer):
             built.append(layer)
         self.run_function = LayerList([l for l in built if isinstance(l, Layer)])
         self._funcs = built
+        self.seg_cost_us = None
+        if seg_method == "cost":
+            # measured-cost balancing (cost_model over XLA's compile-time
+            # analysis) instead of uniform layer counts
+            if seg_sample_input is None:
+                raise ValueError(
+                    "seg_method='cost' needs seg_sample_input=<example batch> "
+                    "to measure per-layer cost (XLA cost analysis)"
+                )
+            from ....cost_model import segment_layers_by_cost
+
+            self.segment_parts, self.seg_cost_us = segment_layers_by_cost(
+                self._funcs, self.num_stages, seg_sample_input
+            )
+        else:
+            self.segment_parts = self._segment(seg_method)
 
     def _build_one(self, desc):
         if isinstance(desc, SharedLayerDesc):

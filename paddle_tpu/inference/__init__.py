@@ -26,8 +26,13 @@ class Config:
         self.model_path = model_path
         self.params_path = params_path or (model_path + ".pdparams" if model_path else None)
         self._model_factory = None
-        self._buckets = []  # allowed batch sizes, ascending
+        self._buckets = []  # allowed batch sizes, ascending (axis-0 sugar)
+        self._dim_buckets = {}  # axis -> sorted allowed sizes (any dim)
+        self._slice_output_axes = "auto"
         self._pad_value = 0.0
+        self._mesh = None
+        self._input_pspec = None
+        self._param_spec_fn = None
         self.use_tpu = True
 
     # TPU predictor extensions ------------------------------------------------
@@ -37,6 +42,38 @@ class Config:
 
     def set_batch_buckets(self, buckets):
         self._buckets = sorted(int(b) for b in buckets)
+
+    def set_shape_buckets(self, dim_buckets, pad_value=0.0,
+                          slice_output_axes="auto"):
+        """Bucket ANY dynamic dim (reference capability: TRT dynamic-shape
+        profiles, analysis_predictor.h:95). `dim_buckets` maps axis ->
+        allowed sizes; inputs pad up to the nearest bucket on each axis and
+        outputs slice back, so variable-length serving (seq len for NLP,
+        spatial for detection) compiles at most prod(len(buckets)) programs
+        instead of one per shape.
+
+        `slice_output_axes` controls un-padding of NON-batch output axes:
+        "auto" slices an output axis whose size equals the padded input size
+        (right for token-aligned outputs like [B, S, C]; WRONG if an
+        unrelated output dim coincides with a bucket size — e.g. a hidden
+        width equal to a seq bucket); pass an explicit list of axes to slice,
+        or [] to slice the batch axis only."""
+        self._dim_buckets = {
+            int(ax): sorted(int(b) for b in bs) for ax, bs in dim_buckets.items()
+        }
+        self._pad_value = pad_value
+        self._slice_output_axes = slice_output_axes
+
+    def set_device_mesh(self, mesh, input_spec=None, param_spec_fn=None):
+        """GSPMD-sharded serving (closes the reference's dist-inference
+        DistModel role, fleet_executor/dist_model.cc, the TPU way): compile
+        the predictor over `mesh`. `input_spec`: PartitionSpec for inputs
+        (e.g. P("dp") to shard the batch). `param_spec_fn(name, arr) ->
+        PartitionSpec` places parameters (e.g. tensor-parallel column/row
+        splits on an "mp" axis); default replicates them."""
+        self._mesh = mesh
+        self._input_pspec = input_spec
+        self._param_spec_fn = param_spec_fn
 
     # reference-API knobs the compiler owns: accepted for parity, each logs
     # ONCE what actually happens on TPU so a silently-ignored flag can never
@@ -107,7 +144,30 @@ class Predictor:
                 self.model.set_state_dict(load(config.params_path))
             self.model.eval()
             self._params, self._buffers = state_dict_arrays(self.model)
+            if config._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                mesh = config._mesh
+                fn = config._param_spec_fn
+
+                def place(name, arr):
+                    spec = fn(name, arr) if fn is not None else PartitionSpec()
+                    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+                self._params = {k: place(k, v) for k, v in self._params.items()}
+                self._buffers = {
+                    k: jax.device_put(
+                        v, NamedSharding(mesh, PartitionSpec())
+                    )
+                    for k, v in self._buffers.items()
+                }
         elif config.model_path and os.path.exists(config.model_path + ".pdmodel"):
+            if config._mesh is not None:
+                raise ValueError(
+                    "set_device_mesh requires set_model_factory: a jit.save "
+                    "artifact is an already-lowered single-device program — "
+                    "re-export or serve the model class for sharded serving"
+                )
             # deployment artifact from jit.save: serialized StableHLO +
             # weights, no Python model class needed (reference
             # analysis_predictor loading a saved inference program)
@@ -137,21 +197,41 @@ class Predictor:
     def get_output_handle(self, name):
         return self._outputs.setdefault(name, PredictorTensor(name))
 
+    @staticmethod
+    def _pick_bucket(n, buckets, what):
+        i = bisect.bisect_left(buckets, n)
+        if i == len(buckets):
+            if n > buckets[-1]:
+                raise ValueError(f"{what} {n} exceeds largest bucket {buckets[-1]}")
+            return buckets[-1]
+        return buckets[i]
+
     def _bucket_pad(self, arr):
-        if not self.config._buckets:
-            return arr, arr.shape[0]
-        n = arr.shape[0]
-        i = bisect.bisect_left(self.config._buckets, n)
-        if i == len(self.config._buckets):
-            target = self.config._buckets[-1]
-            if n > target:
-                raise ValueError(f"batch {n} exceeds largest bucket {target}")
-        else:
-            target = self.config._buckets[i]
-        if target != n:
-            pad = np.zeros((target - n,) + arr.shape[1:], arr.dtype)
-            arr = np.concatenate([arr, pad])
-        return arr, n
+        """Pad every bucketed axis up to its nearest bucket. Returns the
+        padded array and [(axis, padded_size, real_size)] so outputs can be
+        sliced back."""
+        dim_buckets = dict(self.config._dim_buckets)
+        if self.config._buckets:
+            dim_buckets.setdefault(0, self.config._buckets)
+        pads = []
+        if not dim_buckets:
+            return arr, [(0, arr.shape[0] if arr.ndim else 0, arr.shape[0] if arr.ndim else 0)]
+        widths = [(0, 0)] * arr.ndim
+        for ax, buckets in sorted(dim_buckets.items()):
+            if ax >= arr.ndim:
+                continue
+            n = arr.shape[ax]
+            target = self._pick_bucket(n, buckets, f"axis-{ax} size")
+            pads.append((ax, target, n))
+            widths[ax] = (0, target - n)
+        if any(hi for _, hi in widths):
+            fill = self.config._pad_value
+            if np.issubdtype(arr.dtype, np.integer):
+                fill = int(fill)
+            arr = np.pad(arr, widths, constant_values=fill)
+        if not any(ax == 0 for ax, _, _ in pads):
+            pads.insert(0, (0, arr.shape[0] if arr.ndim else 0, arr.shape[0] if arr.ndim else 0))
+        return arr, pads
 
     def _get_compiled(self, shapes_key, n_inputs):
         if shapes_key not in self._compiled:
@@ -173,13 +253,13 @@ class Predictor:
         if inputs is None:
             inputs = [self._inputs[n]._data for n in self._input_names if n in self._inputs]
         arrays = []
-        real_n = None
+        pads = None
         for a in inputs:
             a = np.asarray(a)
             if a.dtype == np.float64:
                 a = a.astype(np.float32)
-            padded, n = self._bucket_pad(a)
-            real_n = n if real_n is None else real_n
+            padded, p = self._bucket_pad(a)
+            pads = p if pads is None else pads  # first input drives slicing
             arrays.append(padded)
         key = tuple((a.shape, str(a.dtype)) for a in arrays)
         if self._artifact is not None:
@@ -190,8 +270,14 @@ class Predictor:
                 is_leaf=lambda t: isinstance(t, Tensor),
             )
         else:
+            device_in = [np.asarray(a) for a in arrays]
+            if self.config._mesh is not None and self.config._input_pspec is not None:
+                from jax.sharding import NamedSharding
+
+                sh = NamedSharding(self.config._mesh, self.config._input_pspec)
+                device_in = [jax.device_put(a, sh) for a in device_in]
             fwd = self._get_compiled(key, len(arrays))
-            out = fwd(self._params, rng.next_key(), *[np.asarray(a) for a in arrays])
+            out = fwd(self._params, rng.next_key(), *device_in)
         # nested model outputs (e.g. a detection head's (cls_list, reg_list))
         # flatten to the reference's positional-output contract
         outs = jax.tree_util.tree_leaves(
@@ -200,8 +286,19 @@ class Predictor:
         results = []
         for i, o in enumerate(outs):
             o = np.asarray(o)
-            if real_n is not None and o.shape and o.shape[0] >= real_n:
-                o = o[:real_n]
+            # un-pad per the configured policy (see set_shape_buckets)
+            allowed = self.config._slice_output_axes
+            for ax, padded_size, real_size in pads or ():
+                if padded_size == real_size:
+                    continue
+                if ax == 0 and o.shape and o.shape[0] >= real_size:
+                    o = o[:real_size]
+                elif (
+                    ax < o.ndim
+                    and o.shape[ax] == padded_size
+                    and (allowed == "auto" or (allowed and ax in allowed))
+                ):
+                    o = np.take(o, np.arange(real_size), axis=ax)
             results.append(o)
             name = f"output_{i}" if i else "output"
             self.get_output_handle(name)._data = o

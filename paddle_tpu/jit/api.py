@@ -40,14 +40,52 @@ class StaticFunction:
         self._input_spec = input_spec
         self._layer = layer
         self._cache = {}
+        self._tried_convert = False
         functools.update_wrapper(self, function)
+
+    def _convert_control_flow(self, cause):
+        """Tracing hit data-dependent Python control flow: retry once with
+        the AST-converted function (dy2static fallback). Raises the
+        actionable error when conversion is not possible."""
+        from .dy2static import Dy2StaticControlFlowError, convert_control_flow
+
+        if self._tried_convert:
+            raise cause
+        self._tried_convert = True
+        fn = self._function
+        target = getattr(fn, "__func__", fn)
+        converted = convert_control_flow(target)
+        if converted is None:
+            raise Dy2StaticControlFlowError(
+                f"to_static({getattr(fn, '__name__', fn)}): could not "
+                "auto-convert the data-dependent control flow (only "
+                "assignment-style if/while bodies are convertible — "
+                "return/break/continue inside the branch are not)"
+            ) from cause
+        if self._layer is not None and hasattr(fn, "__self__"):
+            converted = converted.__get__(fn.__self__, type(fn.__self__))
+        self._function = converted
+        self._cache.clear()
 
     def __get__(self, instance, owner):
         if instance is None:
             return self
-        bound = StaticFunction(
-            self._function.__get__(instance, owner), self._input_spec, layer=instance
-        )
+        # ONE bound wrapper per instance: repeated attribute access must
+        # return the same object, or per-instance state (the compiled-entry
+        # cache, a dy2static-converted body) would be rebuilt/lost on every
+        # call through the class descriptor
+        cache = self.__dict__.get("_bound_cache")
+        if cache is None:
+            import weakref
+
+            cache = self.__dict__["_bound_cache"] = weakref.WeakKeyDictionary()
+        bound = cache.get(instance)
+        if bound is None:
+            bound = StaticFunction(
+                self._function.__get__(instance, owner), self._input_spec,
+                layer=instance,
+            )
+            cache[instance] = bound
         return bound
 
     def _key(self, args):
@@ -91,15 +129,19 @@ class StaticFunction:
             self._cache[key] = entry
         params, buffers = state_dict_arrays(layer)
         arrays = tuple(a._array if isinstance(a, Tensor) else a for a in args)
-        out, new_buf = entry(params, buffers, rng.next_key(), *arrays)
+        from .dy2static import Dy2StaticControlFlowError
+
+        try:
+            out, new_buf = entry(params, buffers, rng.next_key(), *arrays)
+        except Dy2StaticControlFlowError as e:
+            self._convert_control_flow(e)  # swaps self._function, clears cache
+            return self.__call__(*args, **kwargs)
         from ..core.functional import load_state_arrays, tree_to_tensors
 
         load_state_arrays(layer, buffers=new_buf)
         return tree_to_tensors(out)
 
     def _call_function(self, *args, **kwargs):
-        fn = self._function
-
         key = self._key(args)
         entry = self._cache.get(key)
         if entry is None:
@@ -111,7 +153,9 @@ class StaticFunction:
                     Tensor._from_op(a) if isinstance(a, jax.Array) else a for a in arrays
                 )
                 with autograd.trace_mode(), rng.key_scope(key_):
-                    out = fn(*tensors, **kwargs)
+                    # read self._function at trace time: the dy2static
+                    # fallback may have swapped in a converted body
+                    out = self._function(*tensors, **kwargs)
                 return jax.tree_util.tree_map(
                     lambda x: x._array if isinstance(x, Tensor) else x,
                     out,
@@ -121,7 +165,13 @@ class StaticFunction:
             entry = compiled
             self._cache[key] = entry
         arrays = tuple(a._array if isinstance(a, Tensor) else a for a in args)
-        out = entry(rng.next_key(), *arrays)
+        from .dy2static import Dy2StaticControlFlowError
+
+        try:
+            out = entry(rng.next_key(), *arrays)
+        except Dy2StaticControlFlowError as e:
+            self._convert_control_flow(e)
+            return self._call_function(*args, **kwargs)
         from ..core.functional import tree_to_tensors
 
         return tree_to_tensors(out)

@@ -1,0 +1,371 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+Reference parity: /root/reference/python/paddle/jit/dy2static/
+(ifelse_transformer.py:56, loop_transformer.py, program_translator.py:299).
+The reference rewrites `if`/`while` on tensors through 20+ AST transformers;
+the TPU-native `to_static` is trace-based, so this module is the *fallback*:
+when tracing hits `bool(tracer)` (a data-dependent `if x:` / `while x:`),
+`to_static` retries with a minimally AST-transformed function whose
+`if`/`while` statements dispatch at runtime — Python semantics when the
+condition is concrete, `static.nn.cond` / `static.nn.while_loop`
+(lax.cond/lax.while_loop) when it is traced.
+
+Scope (documented, loud on violation): branch/loop bodies that communicate
+through variable ASSIGNMENT are converted; `return`/`break`/`continue`
+inside a data-dependent branch are not convertible to XLA control flow and
+keep the actionable error.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+class Dy2StaticControlFlowError(TypeError):
+    """bool() on a traced tensor: data-dependent Python control flow."""
+
+
+_HINT = (
+    "data-dependent Python control flow reached bool() on a traced tensor. "
+    "Inside jit/to_static, `if x:` / `while x:` on a Tensor cannot branch at "
+    "trace time. Options: (1) let jit.to_static convert it — simple "
+    "assignment-style if/while bodies are auto-converted to "
+    "static.nn.cond/while_loop; (2) rewrite explicitly with "
+    "paddle.static.nn.cond(pred, true_fn, false_fn) or "
+    "paddle.static.nn.while_loop(cond, body, loop_vars); (3) hoist the "
+    "branch out of the compiled function."
+)
+
+
+class _Undefined:
+    """Sentinel for names not yet bound before a converted branch (the
+    reference's UndefinedVar, dy2static/utils.py)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+_UNDEF = _Undefined()
+
+
+def _is_traced(x):
+    arr = x._array if isinstance(x, Tensor) else x
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _jst_peek(frame_locals, name):
+    return frame_locals.get(name, _UNDEF)
+
+
+def _jst_bool(cond):
+    """Concrete truthiness for the Python fallback path."""
+    if isinstance(cond, Tensor):
+        return bool(cond._array)
+    return bool(cond)
+
+
+def _jst_if(cond, true_fn, false_fn, names):
+    """Runtime dispatch for a converted `if`: Python branch on concrete
+    conditions, static.nn.cond on traced ones."""
+    if not _is_traced(cond):
+        return true_fn() if _jst_bool(cond) else false_fn()
+    t_out = true_fn()
+    f_out = false_fn()
+    for branch, res in (("true", t_out), ("false", f_out)):
+        for n, v in zip(names, res):
+            if isinstance(v, _Undefined):
+                raise Dy2StaticControlFlowError(
+                    f"converted `if` on a traced condition: variable '{n}' "
+                    f"is undefined in the {branch} branch (XLA cond outputs "
+                    "need matching shapes/dtypes in BOTH branches)"
+                )
+    from ..static import nn as snn
+
+    return snn.cond(
+        cond if isinstance(cond, Tensor) else Tensor._from_op(cond),
+        lambda: t_out, lambda: f_out,
+    )
+
+
+def _jst_while(cond_fn, body_fn, init, names):
+    """Runtime dispatch for a converted `while`."""
+    for n, v in zip(names, init):
+        if v is _UNDEF:
+            raise Dy2StaticControlFlowError(
+                f"converted `while`: loop variable '{n}' is read before "
+                "assignment"
+            )
+    first = cond_fn(*init)
+    if not _is_traced(first) and not any(_is_traced(v) for v in init):
+        state = tuple(init)
+        while _jst_bool(cond_fn(*state)):
+            state = body_fn(*state)
+            if not isinstance(state, tuple):
+                state = (state,)
+        return state
+    from ..static import nn as snn
+
+    out = snn.while_loop(
+        lambda *vs: cond_fn(*vs),
+        lambda *vs: list(body_fn(*vs)),
+        list(init),
+    )
+    return tuple(out)
+
+
+def _assigned_names(stmts):
+    """Names bound by simple assignments in a statement list (incl. nested
+    for/if bodies; functions/classes/imports deliberately excluded)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):  # don't descend
+            names.append(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            names.append(node.name)
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.append(node.id)
+
+        def visit_For(self, node):
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    seen, out = set(), []
+    for n in names:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def _has_flow_escape(stmts):
+    """True if the statements contain return/break/continue at a level that
+    would escape the extracted branch function."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass  # its own scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_For(self, node):
+            # break/continue bound to this inner loop are fine; returns not.
+            for s in node.body + node.orelse:
+                rv = _ReturnOnly()
+                rv.visit(s)
+                self.found = self.found or rv.found
+
+        visit_While = visit_For
+
+    class _ReturnOnly(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _call(func_name, args):
+    return ast.Call(func=_name(func_name), args=args, keywords=[])
+
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    """Rewrites if/while into runtime-dispatched closures.
+
+    if c: A else: B   (A/B assign x, y) ->
+        x = _jst_peek(locals(), 'x'); y = ...
+        def _jst_true_0(x=x, y=y):  A;  return (x, y)
+        def _jst_false_0(x=x, y=y): B;  return (x, y)
+        (x, y) = _jst_if(c, _jst_true_0, _jst_false_0, ('x', 'y'))
+
+    while c: A        (A assigns x, y; c reads them) ->
+        def _jst_cond_0(x, y):  return c
+        def _jst_body_0(x, y):  A; return (x, y)
+        (x, y) = _jst_while(_jst_cond_0, _jst_body_0, (x, y), ('x', 'y'))
+    """
+
+    def __init__(self):
+        self.count = 0
+        self.changed = False
+
+    def _ret_tuple(self, names):
+        return ast.Return(
+            value=ast.Tuple(elts=[_name(n) for n in names], ctx=ast.Load())
+        )
+
+    def _target_tuple(self, names):
+        return ast.Tuple(
+            elts=[_name(n, ast.Store()) for n in names], ctx=ast.Store()
+        )
+
+    def _peek_stmts(self, names):
+        return [
+            ast.Assign(
+                targets=[_name(n, ast.Store())],
+                value=_call("_jst_peek", [_call("locals", []), ast.Constant(n)]),
+            )
+            for n in names
+        ]
+
+    def _fn_def(self, fname, body, names, defaults=True):
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[_name(n) for n in names] if defaults else [],
+        )
+        return ast.FunctionDef(
+            name=fname, args=args, body=body + [self._ret_tuple(names)],
+            decorator_list=[], returns=None, type_params=[],
+        )
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            return node
+        names = _assigned_names(node.body + node.orelse)
+        if not names:
+            return node
+        i = self.count
+        self.count += 1
+        self.changed = True
+        tname, fname = f"_jst_true_{i}", f"_jst_false_{i}"
+        names_const = ast.Tuple(
+            elts=[ast.Constant(n) for n in names], ctx=ast.Load()
+        )
+        stmts = self._peek_stmts(names)
+        stmts.append(self._fn_def(tname, node.body, names))
+        stmts.append(self._fn_def(fname, node.orelse or [ast.Pass()], names))
+        stmts.append(
+            ast.Assign(
+                targets=[self._target_tuple(names)],
+                value=_call(
+                    "_jst_if", [node.test, _name(tname), _name(fname), names_const]
+                ),
+            )
+        )
+        return stmts
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body):
+            return node
+        names = _assigned_names(node.body)
+        if not names:
+            return node
+        i = self.count
+        self.count += 1
+        self.changed = True
+        cname, bname = f"_jst_cond_{i}", f"_jst_body_{i}"
+        names_const = ast.Tuple(
+            elts=[ast.Constant(n) for n in names], ctx=ast.Load()
+        )
+        cond_def = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[],
+            ),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[],
+        )
+        stmts = self._peek_stmts(names)
+        stmts.append(cond_def)
+        stmts.append(self._fn_def(bname, node.body, names, defaults=False))
+        stmts.append(
+            ast.Assign(
+                targets=[self._target_tuple(names)],
+                value=_call(
+                    "_jst_while",
+                    [
+                        _name(cname), _name(bname),
+                        ast.Tuple(elts=[_name(n) for n in names], ctx=ast.Load()),
+                        names_const,
+                    ],
+                ),
+            )
+        )
+        return stmts
+
+
+def convert_control_flow(fn):
+    """AST-convert `fn`'s if/while statements; returns the new function, or
+    None when nothing was (or could be) converted. Closure variables are
+    re-bound by value into the new function's globals."""
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []  # the caller re-wraps; avoid recursive to_static
+    tr = _CtrlFlowTransformer()
+    tree = tr.visit(tree)
+    if not tr.changed:
+        return None
+    ast.fix_missing_locations(tree)
+    ns = dict(getattr(fn, "__globals__", {}))
+    for name, cell in zip(
+        fn.__code__.co_freevars, fn.__closure__ or ()
+    ):
+        try:
+            ns[name] = cell.cell_contents
+        except ValueError:
+            pass
+    ns["_jst_if"] = _jst_if
+    ns["_jst_while"] = _jst_while
+    ns["_jst_peek"] = _jst_peek
+    code = compile(tree, f"<dy2static:{fn.__name__}>", "exec")
+    exec(code, ns)
+    new_fn = ns[fdef.name]
+    new_fn.__dy2static_converted__ = True
+    return new_fn

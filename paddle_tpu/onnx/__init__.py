@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import numpy as np
 
-_OPSET = 17
+_OPSET = 18  # LayerNormalization needs >=17; Split num_outputs needs >=18
 
 
 def _pb():
@@ -31,16 +31,20 @@ def _pb():
 def _tensor(pb, name, arr):
     t = pb.TensorProto()
     t.name = name
-    t.data_type = 1  # FLOAT
-    t.dims.extend(arr.shape)
-    t.raw_data = np.ascontiguousarray(arr, np.float32).tobytes()
+    if np.issubdtype(np.asarray(arr).dtype, np.integer):
+        t.data_type = 7  # INT64
+        t.raw_data = np.ascontiguousarray(arr, np.int64).tobytes()
+    else:
+        t.data_type = 1  # FLOAT
+        t.raw_data = np.ascontiguousarray(arr, np.float32).tobytes()
+    t.dims.extend(np.asarray(arr).shape)
     return t
 
 
-def _vinfo(pb, name, shape):
+def _vinfo(pb, name, shape, elem_type=1):
     vi = pb.ValueInfoProto()
     vi.name = name
-    vi.type.tensor_type.elem_type = 1
+    vi.type.tensor_type.elem_type = elem_type
     for d in shape:
         dim = vi.type.tensor_type.shape.dim.add()
         if d is None or int(d) < 0:
@@ -86,6 +90,9 @@ class _Emitter:
         self.g.initializer.append(_tensor(self.pb, name, np.asarray(arr)))
         return name
 
+    def init_i64(self, base, values):
+        return self.init(base, np.asarray(values, np.int64))
+
 
 def _pair(v):
     return [int(v), int(v)] if isinstance(v, int) else [int(x) for x in v]
@@ -110,11 +117,24 @@ def _onnx_pads(padding, what):
     raise NotImplementedError(f"paddle.onnx.export: padding {padding!r} on {what}")
 
 
-def _emit_layer(em, layer, x):
+def _emit_layer(em, layer, x, input_shape=None):
     """Emit ONNX nodes for `layer` consuming tensor name `x`; returns the
     output tensor name."""
     from .. import nn
+    from ..models.gpt import GPT
 
+    if isinstance(layer, GPT):
+        seq = None if input_shape is None else input_shape[-1]
+        if seq is None or int(seq) < 0:
+            raise NotImplementedError(
+                "paddle.onnx.export(GPT): the sequence dim must be concrete "
+                "in input_spec — the causal mask and position slice are "
+                "emitted statically (serve variable lengths through the "
+                "predictor's shape buckets)"
+            )
+        from ._gpt import emit_gpt
+
+        return emit_gpt(em, layer, x, int(seq))
     if isinstance(layer, nn.Sequential):
         for sub in layer:
             x = _emit_layer(em, sub, x)
@@ -261,18 +281,25 @@ def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
     g = model.graph
     g.name = type(layer).__name__
     spec0 = [s for s in input_spec if isinstance(s, InputSpec)][0]
-    g.input.append(_vinfo(pb, "input", list(spec0.shape)))
+    in_dtype = np.dtype(spec0.dtype)
+    is_int_input = np.issubdtype(in_dtype, np.integer)
+    g.input.append(
+        _vinfo(pb, "input", list(spec0.shape), elem_type=7 if is_int_input else 1)
+    )
     em = _Emitter(pb, g)
     was_training = layer.training
     layer.eval()
     try:
-        out_name = _emit_layer(em, layer, "input")
+        out_name = _emit_layer(em, layer, "input", input_shape=list(spec0.shape))
         # output shape from a dry run
         params, buffers = state_dict_arrays(layer)
         probe_shape = [1 if (d is None or int(d) < 0) else int(d) for d in spec0.shape]
+        probe = (
+            jnp.zeros(probe_shape, jnp.int64) if is_int_input
+            else jnp.zeros(probe_shape, jnp.float32)
+        )
         out, _ = functional_call(
-            layer, params, buffers, args=(jnp.zeros(probe_shape, jnp.float32),),
-            training=False,
+            layer, params, buffers, args=(probe,), training=False,
         )
         out0 = out[0] if isinstance(out, (tuple, list)) else out
         g.output.append(_vinfo(pb, out_name, [None] + list(out0.shape[1:])))
@@ -289,7 +316,7 @@ def export(layer, path, input_spec=None, opset_version=_OPSET, **configs):
         o, _ = functional_call(layer, params, buffers, args=arrays, training=False)
         return o
 
-    exported = jax.export.export(jax.jit(fn))(jnp.zeros(probe_shape, jnp.float32))
+    exported = jax.export.export(jax.jit(fn))(probe)
     with open(onnx_path + ".stablehlo.mlir", "w") as f:
         f.write(exported.mlir_module())
     return onnx_path
@@ -311,7 +338,8 @@ def load(path):
     g = model.graph
     inits = {}
     for t in g.initializer:
-        arr = np.frombuffer(t.raw_data, np.float32).reshape(tuple(t.dims))
+        np_dt = np.int64 if t.data_type == 7 else np.float32
+        arr = np.frombuffer(t.raw_data, np_dt).reshape(tuple(t.dims))
         inits[t.name] = jnp.asarray(arr)
     nodes = list(g.node)
     in_name = g.input[0].name
@@ -400,6 +428,27 @@ def load(path):
                 m = xin.mean(-1, keepdims=True)
                 v = ((xin - m) ** 2).mean(-1, keepdims=True)
                 y = (xin - m) / jnp.sqrt(v + eps) * scale + bias
+            elif op == "Gather":
+                y = jnp.take(ins[0], ins[1].astype(jnp.int32),
+                             axis=int(attrs.get("axis", 0)))
+            elif op == "Reshape":
+                # ONNX: 0 copies the input dim, -1 infers
+                shp = [
+                    int(ins[0].shape[i]) if int(d) == 0 else int(d)
+                    for i, d in enumerate(np.asarray(ins[1]))
+                ]
+                y = ins[0].reshape(shp)
+            elif op == "Transpose":
+                y = jnp.transpose(ins[0], attrs["perm"])
+            elif op == "Squeeze":
+                axes = [int(a) for a in np.asarray(ins[1])]
+                y = jnp.squeeze(ins[0], axis=tuple(axes))
+            elif op == "Split":
+                n = int(attrs.get("num_outputs", len(nd.output)))
+                parts = jnp.split(ins[0], n, axis=int(attrs.get("axis", 0)))
+                for name_, part in zip(nd.output, parts):
+                    env[name_] = part
+                continue
             else:
                 raise NotImplementedError(f"onnx.load: op {op}")
             env[nd.output[0]] = y

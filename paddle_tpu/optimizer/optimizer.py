@@ -20,6 +20,29 @@ from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
 
 
+class _LiveScalar(Tensor):
+    """A Tensor whose value is computed at READ time from a callable.
+
+    Recorded static-graph ops take it as an input; Executor.run reads
+    `_array` per run (Program._external_values), so the underlying value —
+    e.g. a scheduler-driven learning rate — is re-evaluated every step
+    instead of freezing at capture time."""
+
+    def __init__(self, fn, name="live"):
+        self._fn = fn
+        self.stop_gradient = True
+        self._grad = None
+        self._node = None
+        self._out_index = 0
+        self._retain_grads = False
+        self.name = name
+        self.persistable = False
+
+    @property
+    def _array(self):
+        return jnp.asarray(float(self._fn()), jnp.float32)
+
+
 class L2Decay:
     def __init__(self, coeff=0.0):
         self.coeff = float(coeff)
@@ -47,6 +70,9 @@ class Optimizer:
         self._name = name
         self._jit_cache = {}  # per-instance jitted update fns
         self._apply_decay_param_fun = None
+        # static-graph update-op state: id(param) -> {slot: holder Tensor}
+        # (Executor.run state-writes the holders each run)
+        self._static_state = {}
         # multi_precision (reference optimizer/adam.py:92 master weights):
         # when on, low-precision params get an fp32 "master_weight" state
         # slot; the update applies to the master and the working param is a
@@ -123,26 +149,30 @@ class Optimizer:
             return True
         return bool(fn(param.name))
 
+    def _update_with_wd(self, param, grad, lr, state, hyper, apply_wd=True):
+        """The complete per-param update: weight decay (coupled or AdamW-
+        decoupled) + master-weight handling around the subclass `_update`.
+        Pure; used by the eager jitted path AND the static-graph update op."""
+        wd = self._wd_coeff() if apply_wd else 0.0
+        state, master = Optimizer._split_master(state)
+        work = param if master is None else master
+        if wd and not self._decoupled_wd:
+            grad = grad + wd * work.astype(grad.dtype)
+        new_p, new_s = self._update(work, grad, lr, state, **hyper)
+        if wd and self._decoupled_wd:
+            new_p = new_p - (lr * wd * work.astype(jnp.float32)).astype(new_p.dtype)
+        if master is not None:
+            new_s = dict(new_s)
+            new_s["master_weight"] = new_p.astype(jnp.float32)
+        return new_p.astype(param.dtype), new_s
+
     def _jitted_update(self, apply_wd=True):
         cached = self._jit_cache.get(bool(apply_wd))
         if cached is not None:
             return cached
-        update = self._update
-        wd = self._wd_coeff() if apply_wd else 0.0
-        decoupled = self._decoupled_wd
 
         def f(param, grad, lr, state, hyper):
-            state, master = Optimizer._split_master(state)
-            work = param if master is None else master
-            if wd and not decoupled:
-                grad = grad + wd * work.astype(grad.dtype)
-            new_p, new_s = update(work, grad, lr, state, **hyper)
-            if wd and decoupled:
-                new_p = new_p - (lr * wd * work.astype(jnp.float32)).astype(new_p.dtype)
-            if master is not None:
-                new_s = dict(new_s)
-                new_s["master_weight"] = new_p.astype(jnp.float32)
-            return new_p.astype(param.dtype), new_s
+            return self._update_with_wd(param, grad, lr, state, hyper, apply_wd)
 
         jf = jax.jit(f, donate_argnums=(0, 3))
         self._jit_cache[bool(apply_wd)] = jf
@@ -178,9 +208,71 @@ class Optimizer:
             self._accumulators[id(p)] = new_s
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..core import autograd as ag
+
+        if ag._tls.capture is not None:
+            return self._minimize_static(loss, parameters, no_grad_set)
         loss.backward()
         self.step()
         return None, None
+
+    def _minimize_static(self, loss, parameters=None, no_grad_set=None):
+        """Static-graph minimize (reference Optimizer.minimize on a Program:
+        append_backward then append optimizer-update ops). The update ops are
+        recorded into the active Program with the param and its state slots
+        as inputs and state-write registrations for the outputs, so every
+        Executor.run performs forward + backward + update and persists the
+        new params/slots — the raw static training loop of the reference."""
+        from ..core import autograd as ag
+        from ..core.tensor import Tensor
+        from ..static.autodiff import append_backward
+
+        prog = ag._tls.capture
+        params = parameters if parameters is not None else self._parameter_list
+        pgs = append_backward(loss, parameter_list=params, no_grad_set=no_grad_set)
+        if self._grad_clip is not None:
+            # clip ops go through the same funnel, so they are captured too
+            pgs = self._grad_clip(pgs)
+        # the LR rides as a LIVE op input (read at every Executor.run), so
+        # LRScheduler.step() between runs takes effect — a baked trace-time
+        # constant would freeze the schedule forever
+        lr_t = _LiveScalar(self.get_lr, name="learning_rate")
+        hyper = self._hyper_traced({})
+        for p, g in pgs:
+            st = self._static_state.get(id(p))
+            if st is None:
+                init = self._accumulators.get(id(p)) or self._init_state(p._array)
+                st = {k: Tensor._from_op(jnp.asarray(v)) for k, v in init.items()}
+                self._static_state[id(p)] = st
+            slot_names = list(st.keys())
+            apply_wd = self._should_decay(p)
+            base_lr = 1.0
+            if hasattr(p, "optimize_attr"):
+                base_lr = float(p.optimize_attr.get("learning_rate", 1.0))
+
+            def make(slot_names, apply_wd, base_lr):
+                def optimizer_update(pa, ga, lr_in, *slots):
+                    state = dict(zip(slot_names, slots))
+                    new_p, new_s = self._update_with_wd(
+                        pa, ga.astype(pa.dtype), lr_in * base_lr, state,
+                        hyper, apply_wd,
+                    )
+                    return (new_p,) + tuple(new_s[k] for k in slot_names)
+
+                return optimizer_update
+
+            from ..core.autograd import no_grad
+
+            with no_grad():
+                out, _ = ag.apply(
+                    make(slot_names, apply_wd, base_lr), p, g, lr_t,
+                    *st.values(), name="optimizer_update",
+                )
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            prog._register_state_write(id(outs[0]), p)
+            for nm, o in zip(slot_names, outs[1:]):
+                prog._register_state_write(id(o), st[nm])
+        return None, pgs
 
     def clear_grad(self, set_to_zero=False):
         for p in self._params:
@@ -259,6 +351,9 @@ class Optimizer:
         for i, p in enumerate(self._params):
             order.append(p.name)
             st = self._accumulators.get(id(p))
+            ss = self._static_state.get(id(p))
+            if ss:  # static update ops keep the live slots in holder tensors
+                st = {k: t._array for k, t in ss.items()}
             if st:
                 for slot, arr in st.items():
                     sd[f"{p.name}_{slot}"] = Tensor._from_op(arr)
@@ -302,5 +397,14 @@ class Optimizer:
                 st = self._init_state(p._array)
                 st.update(slots)
                 self._accumulators[id(p)] = st
+            # a static-graph minimize reads its slots from holder tensors —
+            # propagate the loaded state there too, or the recorded update
+            # ops would silently continue from pre-load values
+            ss = self._static_state.get(id(p))
+            if ss:
+                loaded = self._accumulators.get(id(p), {})
+                for slot, holder in ss.items():
+                    if slot in loaded:
+                        holder._array = jnp.asarray(loaded[slot])
 
     load_state_dict = set_state_dict

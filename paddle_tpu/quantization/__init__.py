@@ -88,6 +88,123 @@ class QuantedLinear(Layer):
         return Tensor._from_op(out, node, 0)
 
 
+class QuantedConv2D(Layer):
+    """Conv2D with straight-through fake quant: PER-OUTPUT-CHANNEL weight
+    scales (reference static/quantization/post_training_quantization.py:117
+    quantizes conv weights channel-wise) + running activation absmax in a
+    registered buffer, so QAT/PTQ calibration compiles under jit exactly
+    like QuantedLinear."""
+
+    def __init__(self, conv, a_bits=8, w_bits=8):
+        super().__init__()
+        self.inner = conv
+        self.a_bits = a_bits
+        self.w_bits = w_bits
+        self.register_buffer("act_absmax", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        inner = self.inner
+        a_bits, w_bits = self.a_bits, self.w_bits
+
+        def fq(xa, wa, am):
+            new_am = jnp.maximum(am, jnp.abs(xa).max().astype(jnp.float32))
+            a_scale = jnp.maximum(new_am, 1e-8)
+            # weight is OIHW: per-output-channel absmax over (in, kh, kw)
+            w_scale = jnp.maximum(
+                jnp.abs(wa).max(axis=(1, 2, 3), keepdims=True), 1e-8
+            )
+            xq = xa + jax.lax.stop_gradient(
+                fake_quant_dequant(xa, a_scale, a_bits) - xa
+            )
+            wq = wa + jax.lax.stop_gradient(
+                fake_quant_dequant(wa, w_scale, w_bits) - wa
+            )
+            return xq, wq, jax.lax.stop_gradient(new_am)
+
+        outs, node = autograd.apply(
+            fq, x, inner.weight, self.act_absmax, name="fake_quant_conv"
+        )
+        xq, wq, new_am = outs
+        self.act_absmax._array = new_am
+        return F.conv2d(
+            Tensor._from_op(xq, node, 0),
+            Tensor._from_op(wq, node, 1),
+            inner.bias,
+            inner._stride,
+            inner._padding,
+            inner._dilation,
+            inner._groups,
+            inner._data_format,
+        )
+
+
+class Int8Conv2D(Layer):
+    """The EMITTED quantized conv: int8 weights (per-output-channel scales)
+    + static int8 activation quant, computed as an int8 x int8 -> int32
+    `conv_general_dilated` — true quantized compute, then a per-channel
+    dequant rescale. Reference emission:
+    static/quantization/post_training_quantization.py (conv2d in the
+    quantizable op set)."""
+
+    def __init__(self, q_weight_i8, w_scales, a_scale, bias, stride, padding,
+                 dilation, groups, data_format="NCHW", a_bits=8, w_bits=8):
+        super().__init__()
+        self.register_buffer("q_weight", Tensor(np.asarray(q_weight_i8, np.int8)))
+        self.register_buffer("w_scales", Tensor(np.asarray(w_scales, np.float32)))
+        self.register_buffer("a_scale_t", Tensor(np.float32(a_scale)))
+        self.bias = bias
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self.a_qmax = 2.0 ** (a_bits - 1) - 1
+        self.w_qmax = 2.0 ** (w_bits - 1) - 1
+
+    def forward(self, x):
+        from ..ops.conv_pool import _conv_padding, _dim_numbers, _pair
+
+        qw = self.q_weight._array
+        wsc = self.w_scales._array  # [out_c]
+        # device scalar (a tracer under jit.save/functional_call) — the
+        # scale never round-trips to host in forward
+        asc = self.a_scale_t._array.astype(jnp.float32)
+        a_qmax, w_qmax = self.a_qmax, self.w_qmax
+        channel_last = self._data_format.endswith("C") and len(self._data_format) == 4
+        strides = _pair(self._stride, 2)
+        dil = _pair(self._dilation, 2)
+        pad = _conv_padding(self._padding, 2)
+        dn_spec = _dim_numbers(2, channel_last)
+        groups = self._groups
+
+        def f(xa, *b):
+            xq = jnp.clip(
+                jnp.round(xa.astype(jnp.float32) / asc * a_qmax), -a_qmax, a_qmax
+            ).astype(jnp.int8)
+            dn = jax.lax.conv_dimension_numbers(xa.shape, qw.shape, dn_spec)
+            acc = jax.lax.conv_general_dilated(
+                xq, qw,
+                window_strides=strides, padding=pad, rhs_dilation=dil,
+                dimension_numbers=dn, feature_group_count=groups,
+                preferred_element_type=jnp.int32,
+            )
+            ch_shape = (
+                (1,) * (acc.ndim - 1) + (-1,) if channel_last else (1, -1, 1, 1)
+            )
+            out = acc.astype(jnp.float32) * (asc / a_qmax) * (
+                wsc.reshape(ch_shape) / w_qmax
+            )
+            if b:
+                out = out + b[0].astype(jnp.float32).reshape(ch_shape)
+            return out.astype(xa.dtype)
+
+        args = (x,) + ((self.bias,) if self.bias is not None else ())
+        out, node = autograd.apply(f, *args, name="int8_conv2d")
+        return Tensor._from_op(out, node)
+
+
 class Int8Linear(Layer):
     """The EMITTED quantized layer: int8 weights (per-output-channel scales)
     + static int8 activation quant, computed as an int8xint8->int32
@@ -112,7 +229,7 @@ class Int8Linear(Layer):
     def forward(self, x):
         qw = self.q_weight._array
         wsc = self.w_scales._array
-        asc = self.a_scale
+        asc = self.a_scale_t._array.astype(jnp.float32)  # stays on device
         a_qmax, w_qmax = self.a_qmax, self.w_qmax
 
         def f(xa, *b):
@@ -156,6 +273,23 @@ def _emit_int8(model, a_bits=8, w_bits=8, inplace=True):
                     qw, w_scales, a_scale, sub.inner.bias,
                     a_bits=a_bits, w_bits=w_bits,
                 )
+            elif isinstance(sub, QuantedConv2D):
+                w = np.asarray(sub.inner.weight._array, np.float32)  # OIHW
+                w_qmax = 2.0 ** (w_bits - 1) - 1
+                w_scales = np.maximum(np.abs(w).max(axis=(1, 2, 3)), 1e-8)
+                qw = np.clip(
+                    np.round(w / w_scales[:, None, None, None] * w_qmax),
+                    -w_qmax, w_qmax,
+                ).astype(np.int8)
+                a_scale = float(
+                    np.maximum(np.asarray(sub.act_absmax._array), 1e-8)
+                )
+                inner = sub.inner
+                layer._sub_layers[name] = Int8Conv2D(
+                    qw, w_scales, a_scale, inner.bias, inner._stride,
+                    inner._padding, inner._dilation, inner._groups,
+                    inner._data_format, a_bits=a_bits, w_bits=w_bits,
+                )
             else:
                 convert(sub)
 
@@ -172,15 +306,17 @@ class QAT:
 
     def quantize(self, model, inplace=False):
         from ..nn.common import Linear
+        from ..nn.conv import Conv2D
+
+        a_bits = self.config.activation.get("bits", 8)
+        w_bits = self.config.weight.get("bits", 8)
 
         def convert(layer):
             for name, sub in list(layer._sub_layers.items()):
                 if isinstance(sub, Linear):
-                    layer._sub_layers[name] = QuantedLinear(
-                        sub,
-                        self.config.activation.get("bits", 8),
-                        self.config.weight.get("bits", 8),
-                    )
+                    layer._sub_layers[name] = QuantedLinear(sub, a_bits, w_bits)
+                elif type(sub) is Conv2D:
+                    layer._sub_layers[name] = QuantedConv2D(sub, a_bits, w_bits)
                 else:
                     convert(sub)
 

@@ -54,3 +54,7 @@ from .io import (  # noqa: E402,F401
     load_inference_model,
     save_inference_model,
 )
+from .autodiff import (  # noqa: E402,F401
+    append_backward,
+    gradients,
+)

@@ -1,0 +1,130 @@
+"""Static-graph autodiff: append_backward / gradients over the op-log Program.
+
+Reference parity: /root/reference/python/paddle/fluid/backward.py:1826
+(`append_backward`) and `gradients` — the reference walks the ProgramDesc
+backwards emitting grad ops per op. Here the captured op log replays as a
+pure function, so the whole backward is ONE recorded op: jax.vjp of the
+replay, appended to the same Program (the same move forward_grad makes with
+jax.jvp in incubate/autograd).
+
+Key design point: the replay closure does NOT bake tensor-backed externals
+(parameters, buffers, feed placeholders, RNG-slot keys) as constants — they
+ride as real inputs of the recorded grad op. The OUTER Executor plan then
+resolves them uniformly per run: feeds from the feed dict, params/buffers at
+their current values, RNG slots re-keyed per step — so the backward sees the
+same batch, the same weights, and the SAME dropout masks as the forward ops
+it differentiates.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as ag
+from ..core.tensor import Tensor
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _require_program(what):
+    prog = ag._tls.capture
+    if prog is None:
+        raise RuntimeError(
+            f"static.{what} reads the captured op log: build the ops under "
+            "static.program_guard (or paddle.enable_static()) first"
+        )
+    return prog
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(sum of targets)/d(inputs) as new program outputs (reference
+    static.gradients, fluid/backward.py). Returns one grad Tensor per input;
+    fetch them via Executor.run like any program output."""
+    prog = _require_program("gradients")
+    outs = _to_list(targets)
+    ins = _to_list(inputs)
+    if no_grad_set:
+        drop = {id(t) for t in no_grad_set}
+        ins = [t for t in ins if id(t) not in drop]
+    gs = _to_list(target_gradients)
+    if gs and len(gs) != len(outs):
+        raise ValueError(
+            f"gradients: {len(gs)} target_gradients for {len(outs)} targets"
+        )
+
+    input_aids = [id(t._array) for t in ins]
+    fetch_ids = [id(t._array) for t in outs]
+    externals, run = prog._plan_arrays(input_aids, fetch_ids)
+
+    # tensor-backed externals become op inputs (resolved per-run by the
+    # outer plan); raw captured arrays stay baked constants
+    ext_positions = [i for i, (_, t) in enumerate(externals) if isinstance(t, Tensor)]
+    ext_tensors = [externals[i][1] for i in ext_positions]
+    pos_set = set(ext_positions)
+    baked = {
+        i: v
+        for i, v in enumerate(prog._external_values(externals))
+        if i not in pos_set
+    }
+    n_in, n_ct = len(ins), len(gs)
+
+    def f_grad(*arrs):
+        xs = arrs[:n_in]
+        cts = arrs[n_in : n_in + n_ct]
+        evs = arrs[n_in + n_ct :]
+        ext_vals = [None] * len(externals)
+        for pos, v in zip(ext_positions, evs):
+            ext_vals[pos] = v
+        for pos, v in baked.items():
+            ext_vals[pos] = v
+
+        def f(*vals):
+            return tuple(run(list(vals), ext_vals))
+
+        out_vals, vjp_fn = jax.vjp(f, *xs)
+        ct = tuple(cts) if cts else tuple(jnp.ones_like(o) for o in out_vals)
+        return vjp_fn(ct)
+
+    out, node = ag.apply(f_grad, *ins, *gs, *ext_tensors, name="gradients")
+    grads = [Tensor._from_op(o, node, i) for i, o in enumerate(out)]
+    return grads
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """Append the backward of `loss` w.r.t. the program's trainable
+    parameters (reference fluid/backward.py:1826). Returns the reference's
+    [(param, grad)] pairs; the grads are program outputs fetchable by
+    Executor.run, and optimizer.minimize under capture consumes them to
+    append update ops."""
+    prog = _require_program("append_backward")
+    if loss._array.ndim != 0 and loss._array.size != 1:
+        raise ValueError(
+            f"append_backward: loss must be a scalar, got shape {tuple(loss.shape)}"
+        )
+    if parameter_list is not None:
+        params = [p for p in parameter_list if not p.stop_gradient]
+    else:
+        # every trainable parameter the program actually reads
+        externals, _ = prog._plan_arrays([], [id(loss._array)])
+        params = [
+            t
+            for _, t in externals
+            if isinstance(t, Tensor)
+            and not t.stop_gradient
+            and getattr(t, "trainable", True)
+        ]
+    if no_grad_set:
+        drop = {id(t) for t in no_grad_set}
+        params = [p for p in params if id(p) not in drop]
+    if not params:
+        raise ValueError(
+            "append_backward: no trainable parameters found in the program "
+            "(are all parameters stop_gradient, or created outside the ops "
+            "the loss depends on?)"
+        )
+    grads = gradients([loss], params)
+    return list(zip(params, grads))

@@ -13,3 +13,8 @@ if not os.environ.get("PADDLE_TPU_TEST_ON_TPU"):
     from _cpu_mesh import force_host_cpu_devices
 
     force_host_cpu_devices(8)
+    # inherited by every subprocess tests spawn (launch children, worker
+    # scripts): paddle_tpu._apply_platform_override() flips them to CPU
+    # before any jax backend use, so a dead/absent TPU tunnel can never
+    # hang a spawned child
+    os.environ["PADDLE_TPU_PLATFORM"] = "cpu"

@@ -1,0 +1,168 @@
+"""dy2static fallback (VERDICT r4 item 6 / Missing #2).
+
+Reference: /root/reference/python/paddle/jit/dy2static/ifelse_transformer.py:56
+and loop_transformer.py. The trace-based to_static now (1) raises a NAMED,
+actionable error when Python control flow branches on a traced tensor, and
+(2) auto-converts assignment-style if/while bodies to
+static.nn.cond/while_loop and retries.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.jit.dy2static import Dy2StaticControlFlowError
+
+
+def test_named_actionable_error_for_unconvertible():
+    """return-inside-branch is not convertible: the user gets ONE clear
+    error naming static.nn.cond, not a jax tracer stack."""
+
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:  # data-dependent, returns from the branch
+            return x * 2
+        return x - 1
+
+    with pytest.raises(Dy2StaticControlFlowError) as ei:
+        f(paddle.to_tensor(np.ones(4, np.float32)))
+    assert "static.nn.cond" in str(ei.value) or "could not auto-convert" in str(
+        ei.value
+    )
+
+
+def test_eager_bool_still_works():
+    t = paddle.to_tensor(np.array(1.0, np.float32))
+    assert bool(t > 0)
+
+
+def test_converted_if_end_to_end():
+    """Assignment-style data-dependent `if` converts and matches eager."""
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y + 1.0
+
+    sf = jit.to_static(f)
+    pos = paddle.to_tensor(np.ones(4, np.float32))
+    neg = paddle.to_tensor(-np.ones(4, np.float32))
+    np.testing.assert_allclose(np.asarray(sf(pos)._array), np.ones(4) * 3)
+    np.testing.assert_allclose(np.asarray(sf(neg)._array), -np.ones(4))
+
+
+def test_converted_if_reads_prior_value():
+    """Branch bodies that READ the pre-branch value of a reassigned var."""
+
+    def f(x):
+        y = x + 1.0
+        if x.mean() > 0:
+            y = y * 10.0
+        return y
+
+    sf = jit.to_static(f)
+    pos = paddle.to_tensor(np.ones(3, np.float32))
+    neg = paddle.to_tensor(-np.ones(3, np.float32))
+    np.testing.assert_allclose(np.asarray(sf(pos)._array), np.ones(3) * 20)
+    np.testing.assert_allclose(np.asarray(sf(neg)._array), np.zeros(3))
+
+
+def test_converted_while_end_to_end():
+    """Data-dependent `while` converts to ONE lax.while_loop."""
+
+    def f(x):
+        s = x
+        while s.sum() < 100.0:
+            s = s * 2.0
+        return s
+
+    sf = jit.to_static(f)
+    out = np.asarray(sf(paddle.to_tensor(np.ones(4, np.float32)))._array)
+    # 4 -> 8 -> ... -> 128 >= 100
+    np.testing.assert_allclose(out, np.ones(4) * 32)
+
+
+def test_concrete_condition_keeps_python_semantics():
+    """The converted dispatch runs plain Python when the condition is
+    concrete (outside tracing)."""
+
+    def f(x, flag):
+        if flag:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    sf = jit.to_static(f)
+    # flag is a plain bool (non-tensor arg -> part of the jit cache key)
+    a = np.asarray(sf(paddle.to_tensor(np.zeros(2, np.float32)), True)._array)
+    b = np.asarray(sf(paddle.to_tensor(np.zeros(2, np.float32)), False)._array)
+    np.testing.assert_allclose(a, np.ones(2))
+    np.testing.assert_allclose(b, -np.ones(2))
+
+
+class GatedNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 0:  # data-dependent gate on a Layer forward
+            out = h * 2.0
+        else:
+            out = h * 0.5
+        return out
+
+
+def test_layer_forward_with_data_dependent_if():
+    paddle.seed(0)
+    net = GatedNet()
+    sfnet = jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = sfnet(x)
+    # eager reference (same params, plain python branch)
+    h = net.fc(x)
+    expected = (h * 2.0 if float(h.mean()._array) > 0 else h * 0.5)._array
+    np.testing.assert_allclose(
+        np.asarray(out._array), np.asarray(expected), rtol=1e-6
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+class DecoratedGatedNet(nn.Layer):
+    """forward decorated @jit.to_static at class level (the reference's
+    idiom) — the descriptor must hand back ONE bound wrapper per instance
+    so the dy2static conversion survives re-access."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    @jit.to_static
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 0:
+            out = h * 2.0
+        else:
+            out = h * 0.5
+        return out
+
+
+def test_decorated_layer_method_converts():
+    paddle.seed(0)
+    net = DecoratedGatedNet()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = net(x)
+    h = net.fc(x)
+    expected = (h * 2.0 if float(h.mean()._array) > 0 else h * 0.5)._array
+    np.testing.assert_allclose(
+        np.asarray(out._array), np.asarray(expected), rtol=1e-6
+    )

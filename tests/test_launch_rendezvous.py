@@ -27,6 +27,9 @@ def test_two_nodes_rendezvous_without_preset_env():
         env = {k: v for k, v in os.environ.items()
                if not k.startswith(("PADDLE_", "NODE_RANK"))}
         env["JAX_PLATFORMS"] = "cpu"
+        # sitecustomize ignores JAX_PLATFORMS; the package-level override is
+        # what actually keeps launch children off the (possibly dead) tunnel
+        env["PADDLE_TPU_PLATFORM"] = "cpu"
         procs = [
             subprocess.Popen(
                 [sys.executable, "-m", "paddle_tpu.distributed.launch",

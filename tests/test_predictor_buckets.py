@@ -1,0 +1,145 @@
+"""Predictor shape buckets beyond batch + GSPMD-sharded serving
+(VERDICT r4 item 8 / Missing #6, #7).
+
+Reference capabilities covered: TRT dynamic-shape profiles
+(analysis_predictor.h:95) -> per-axis bucketing with padding + out-slicing;
+DistModel sharded inference (fleet_executor/dist_model.cc) -> the predictor
+compiled over a jax.sharding.Mesh with GSPMD param/input placement.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn
+
+
+class TokenTagger(nn.Layer):
+    """Per-position model: padding positions don't influence real ones, so
+    sliced bucketed outputs must equal direct outputs exactly."""
+
+    def __init__(self, vocab=128, dim=16, classes=4):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, dim)
+        self.fc = nn.Linear(dim, classes)
+
+    def forward(self, ids):
+        return self.fc(self.emb(ids))
+
+
+def _tagger_config():
+    paddle.seed(0)
+    cfg = inference.Config()
+    cfg.set_model_factory(TokenTagger)
+    return cfg
+
+
+def test_seq_bucketing_bounds_compile_count():
+    cfg = _tagger_config()
+    cfg.set_batch_buckets([4])
+    cfg.set_shape_buckets({1: [16, 32, 64]})
+    pred = inference.create_predictor(cfg)
+    rs = np.random.RandomState(0)
+    direct = inference.create_predictor(_tagger_config())
+    # serve 12 different sequence lengths
+    for n, s in [(2, 5), (4, 16), (3, 17), (1, 30), (4, 33), (2, 64),
+                 (3, 7), (4, 40), (1, 12), (2, 22), (3, 50), (4, 64)]:
+        ids = rs.randint(0, 128, (n, s)).astype(np.int32)
+        (out,) = pred.run([ids])
+        assert out.shape == (n, s, 4)
+        (ref,) = direct.run([ids])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # bounded compile count: 1 batch bucket x 3 seq buckets >= what we used
+    assert len(pred._compiled) <= 3, len(pred._compiled)
+
+
+def test_bucket_overflow_is_loud():
+    cfg = _tagger_config()
+    cfg.set_shape_buckets({1: [16]})
+    pred = inference.create_predictor(cfg)
+    with pytest.raises(ValueError, match="exceeds largest bucket"):
+        pred.run([np.zeros((1, 32), np.int32)])
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 64)
+        self.fc2 = nn.Linear(64, 8)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _mlp_config():
+    paddle.seed(1)
+    cfg = inference.Config()
+    cfg.set_model_factory(MLP)
+    return cfg
+
+
+def test_sharded_predictor_dp_matches_single_device():
+    """Batch-sharded (dp) serving over the virtual 8-device mesh equals the
+    unsharded predictor bit-for-bit on the same weights."""
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("dp",))
+    rs = np.random.RandomState(0)
+    x = rs.rand(16, 16).astype(np.float32)
+
+    ref = inference.create_predictor(_mlp_config()).run([x])[0]
+
+    cfg = _mlp_config()
+    cfg.set_device_mesh(mesh, input_spec=P("dp"))
+    pred = inference.create_predictor(cfg)
+    out = pred.run([x])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-7)
+    # params really live on the mesh
+    p = next(iter(pred._params.values()))
+    assert len(p.sharding.device_set) == 8
+
+
+def test_sharded_predictor_tensor_parallel_matches():
+    """Column-parallel fc1 / row-parallel fc2 over an mp axis (Megatron
+    layout) — GSPMD inserts the collectives; outputs equal unsharded."""
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "mp"))
+
+    def param_spec(name, arr):
+        if name == "fc1.weight":  # [in, out] column-split
+            return P(None, "mp")
+        if name == "fc2.weight":  # [in, out] row-split
+            return P("mp", None)
+        return P()
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(8, 16).astype(np.float32)
+    ref = inference.create_predictor(_mlp_config()).run([x])[0]
+
+    cfg = _mlp_config()
+    cfg.set_device_mesh(mesh, input_spec=P("dp"), param_spec_fn=param_spec)
+    pred = inference.create_predictor(cfg)
+    out = pred.run([x])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_mesh_with_artifact_is_refused(tmp_path):
+    from paddle_tpu import jit
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(2)
+    net = MLP()
+    net.eval()
+    path = str(tmp_path / "mlp" / "model")
+    jit.save(net, path, input_spec=[InputSpec([None, 16], "float32")])
+    cfg = inference.Config(model_path=path)
+    cfg.set_device_mesh(Mesh(np.array(jax.devices()[:8]), ("dp",)), input_spec=P("dp"))
+    with pytest.raises(ValueError, match="sharded serving"):
+        inference.create_predictor(cfg)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

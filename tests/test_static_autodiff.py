@@ -1,0 +1,163 @@
+"""static.append_backward / static.gradients (VERDICT r4 item 5).
+
+Reference: /root/reference/python/paddle/fluid/backward.py:1826 — the static
+autodiff API that lets raw static-graph users build training programs
+without hapi. Here the backward is one recorded op (jax.vjp of the program
+replay) and optimizer.minimize under capture appends update ops with
+state-write registrations, so Executor.run IS a train step.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def test_gradients_wrt_feed():
+    """d(mean(x^2 + 3x))/dx = (2x + 3)/n fetched via Executor.run."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 5], "float32")
+        y = (x * x + 3.0 * x).mean()
+        (gx,) = static.gradients(y, x)
+    exe = static.Executor()
+    xv = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    out = exe.run(prog, feed={"x": xv}, fetch_list=[y, gx])
+    np.testing.assert_allclose(out[1], (2 * xv + 3) / xv.size, rtol=1e-5)
+
+
+def test_gradients_with_target_gradients():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [3], "float32")
+        y = x * x
+        ct = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        (gx,) = static.gradients(y, x, target_gradients=[ct])
+    exe = static.Executor()
+    xv = np.array([1.0, 1.0, 1.0], np.float32)
+    out = exe.run(prog, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(out[0], 2 * xv * np.array([1, 2, 3]), rtol=1e-6)
+
+
+def test_append_backward_finds_parameters():
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [16, 8], "float32")
+        loss = net(x).mean()
+        pgs = static.append_backward(loss)
+    names = {id(p) for p, _ in pgs}
+    assert id(net.weight) in names and id(net.bias) in names
+    exe = static.Executor()
+    xv = np.ones((16, 8), np.float32)
+    grads = exe.run(prog, feed={"x": xv}, fetch_list=[g for _, g in pgs])
+    # d mean(xW+b) / d b = 1/4 per output unit
+    bias_grad = grads[[id(p) for p, _ in pgs].index(id(net.bias))]
+    np.testing.assert_allclose(bias_grad, 0.25 * np.ones(4), rtol=1e-5)
+
+
+def _raw_static_train(opt_factory, steps=60):
+    """A raw static training loop — no hapi anywhere: capture forward + loss,
+    minimize() appends backward + update ops, then Executor.run per batch."""
+    paddle.seed(3)
+    rs = np.random.RandomState(0)
+    # learnable 2-layer net on a linearly separable toy problem
+    net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 3))
+    W = rs.rand(10, 3).astype(np.float32)
+    X = rs.rand(512, 10).astype(np.float32)
+    Y = (X @ W).argmax(1)[:, None].astype(np.int64)
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [64, 10], "float32")
+        y = static.data("y", [64, 1], "int64")
+        loss = nn.CrossEntropyLoss()(net(x), paddle.to_tensor(y) if False else y)
+        opt = opt_factory(net.parameters())
+        _, pgs = opt.minimize(loss)
+    exe = static.Executor()
+    losses = []
+    for step in range(steps):
+        i = (step * 64) % 512
+        out = exe.run(
+            prog, feed={"x": X[i : i + 64], "y": Y[i : i + 64]}, fetch_list=[loss]
+        )
+        losses.append(float(out[0]))
+    return losses, net, opt
+
+
+def test_raw_static_training_converges_sgd():
+    losses, net, _ = _raw_static_train(
+        lambda ps: paddle.optimizer.SGD(learning_rate=0.5, parameters=ps)
+    )
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    # params actually moved (state writes persisted into the layer)
+    assert float(np.abs(np.asarray(net[0].weight._array)).max()) > 0
+
+
+def test_raw_static_training_converges_adam_with_slots():
+    losses, net, opt = _raw_static_train(
+        lambda ps: paddle.optimizer.Adam(learning_rate=0.01, parameters=ps)
+    )
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    # Adam moments persisted across runs (non-zero after training) and are
+    # visible through state_dict for checkpointing
+    sd = opt.state_dict()
+    m1 = [v for k, v in sd.items() if k.endswith("_moment1")]
+    assert m1 and any(float(np.abs(np.asarray(t._array)).max()) > 0 for t in m1)
+    # beta1_pow advanced: 0.9^steps, not the fresh 0.9
+    b1p = [v for k, v in sd.items() if k.endswith("_beta1_pow")]
+    assert b1p and float(np.asarray(b1p[0]._array)) < 0.9**10
+
+
+def test_static_training_matches_eager():
+    """The raw static loop and an eager loop with identical data and init
+    produce the same loss trajectory (same math, whole-program compiled)."""
+    rs = np.random.RandomState(7)
+    X = rs.rand(128, 6).astype(np.float32)
+    Y = rs.randint(0, 2, (128, 1)).astype(np.int64)
+
+    def eager():
+        paddle.seed(1)
+        net = nn.Linear(6, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=net.parameters())
+        losses = []
+        for s in range(20):
+            i = (s * 32) % 128
+            loss = nn.CrossEntropyLoss()(
+                net(paddle.to_tensor(X[i : i + 32])), paddle.to_tensor(Y[i : i + 32])
+            )
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._array)))
+        return losses
+
+    def static_run():
+        paddle.seed(1)
+        net = nn.Linear(6, 2)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [32, 6], "float32")
+            y = static.data("y", [32, 1], "int64")
+            loss = nn.CrossEntropyLoss()(net(x), y)
+            opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=net.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        losses = []
+        for s in range(20):
+            i = (s * 32) % 128
+            out = exe.run(
+                prog, feed={"x": X[i : i + 32], "y": Y[i : i + 32]},
+                fetch_list=[loss],
+            )
+            losses.append(float(out[0]))
+        return losses
+
+    np.testing.assert_allclose(static_run(), eager(), rtol=2e-4, atol=1e-6)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

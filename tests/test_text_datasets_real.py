@@ -1,0 +1,137 @@
+"""Real text dataset ingestion (VERDICT r4 item 9 / Missing #5).
+
+Mirrors tests/test_datasets_real.py's codec strategy: build standard-format
+archive fixtures in tmp_path, parse them with the REAL loaders, and check
+the reference's documented semantics (vocab cutoff ordering, <unk> last,
+pos=0/neg=1 labels, n-gram windows, SEQ shifted pairs).
+"""
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import Imdb, Imikolov
+
+
+def _add_text(tf, name, text):
+    data = text.encode("utf-8")
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture()
+def imdb_tar(tmp_path):
+    """aclImdb layout: train/test x pos/neg .txt reviews."""
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    # 'great' appears often enough to clear cutoff; 'terrible' too
+    train_pos = ["great movie great fun!", "great great great acting."]
+    train_neg = ["terrible movie, terrible.", "terrible terrible plot"]
+    test_pos = ["great film"]
+    test_neg = ["terrible film"]
+    with tarfile.open(path, "w:gz") as tf:
+        for i, doc in enumerate(train_pos):
+            _add_text(tf, f"aclImdb/train/pos/{i}_10.txt", doc)
+        for i, doc in enumerate(train_neg):
+            _add_text(tf, f"aclImdb/train/neg/{i}_1.txt", doc)
+        for i, doc in enumerate(test_pos):
+            _add_text(tf, f"aclImdb/test/pos/{i}_9.txt", doc)
+        for i, doc in enumerate(test_neg):
+            _add_text(tf, f"aclImdb/test/neg/{i}_2.txt", doc)
+    return str(path)
+
+
+def test_imdb_real_parse(imdb_tar):
+    ds = Imdb(data_file=imdb_tar, mode="train", cutoff=2)
+    assert ds.real
+    # vocab: freq('great')=8, freq('terrible')=7 -> indices 0, 1; <unk> last
+    assert ds.word_idx["great"] == 0
+    assert ds.word_idx["terrible"] == 1
+    assert ds.word_idx["<unk>"] == len(ds.word_idx) - 1
+    assert len(ds) == 4  # 2 pos + 2 neg train docs
+    # pos docs first with label 0 (reference imdb.py:139), then neg label 1
+    x0, y0 = ds[0]
+    assert y0[0] == 0
+    # "great movie great fun" -> great=0, movie/fun -> <unk>
+    unk = ds.word_idx["<unk>"]
+    np.testing.assert_array_equal(x0, [0, unk, 0, unk])
+    x2, y2 = ds[2]
+    assert y2[0] == 1 and x2[0] == ds.word_idx["terrible"]
+    # punctuation removed, lowercase applied
+    assert all(unk == t or t < len(ds.word_idx) for t in x0)
+
+
+def test_imdb_test_split(imdb_tar):
+    ds = Imdb(data_file=imdb_tar, mode="test", cutoff=2)
+    assert len(ds) == 2
+    (xp, yp), (xn, yn) = ds[0], ds[1]
+    assert yp[0] == 0 and yn[0] == 1
+    assert xp[0] == ds.word_idx["great"]
+    assert xn[0] == ds.word_idx["terrible"]
+
+
+def test_imdb_synthetic_fallback_is_loud():
+    with pytest.warns(UserWarning, match="SYNTHETIC"):
+        ds = Imdb(mode="train")
+    assert not ds.real and len(ds) > 0
+
+
+@pytest.fixture()
+def ptb_tgz(tmp_path):
+    path = tmp_path / "simple-examples.tgz"
+    train = "the cat sat\nthe dog sat\nthe cat ran\n"
+    valid = "the cat sat\n"
+    test = "the dog ran\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_text(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add_text(tf, "./simple-examples/data/ptb.valid.txt", valid)
+        _add_text(tf, "./simple-examples/data/ptb.test.txt", test)
+    return str(path)
+
+
+def test_imikolov_ngram(ptb_tgz):
+    ds = Imikolov(data_file=ptb_tgz, data_type="NGRAM", window_size=3,
+                  mode="train", min_word_freq=1)
+    assert ds.real
+    # freqs over train+valid: the=4, <s>=4, <e>=4, cat=3, sat=3 > 1;
+    # dog=1, ran=1 cut -> <unk>
+    wi = ds.word_idx
+    assert wi["<unk>"] == len(wi) - 1
+    assert "the" in wi and "cat" in wi and "sat" in wi
+    assert "dog" not in wi and "ran" not in wi
+    # line 1: <s> the cat sat <e> -> windows of 3: 3 windows
+    # 3 lines x 3 windows (all lines are 3 words) = 9
+    assert len(ds) == 9
+    first = ds[0]
+    assert len(first) == 3
+    np.testing.assert_array_equal(
+        np.array([first[0], first[1], first[2]]).ravel(),
+        [wi["<s>"], wi["the"], wi["cat"]],
+    )
+
+
+def test_imikolov_seq(ptb_tgz):
+    ds = Imikolov(data_file=ptb_tgz, data_type="SEQ", mode="test",
+                  min_word_freq=1)
+    assert len(ds) == 1
+    src, trg = ds[0]
+    wi = ds.word_idx
+    unk = wi["<unk>"]
+    # "the dog ran": dog/ran below cutoff -> unk; src starts <s>, trg ends <e>
+    np.testing.assert_array_equal(src, [wi["<s>"], wi["the"], unk, unk])
+    np.testing.assert_array_equal(trg, [wi["the"], unk, unk, wi["<e>"]])
+
+
+def test_imikolov_synthetic_fallback_is_loud():
+    with pytest.warns(UserWarning, match="SYNTHETIC"):
+        ds = Imikolov(data_type="NGRAM", window_size=5)
+    assert not ds.real
+    item = ds[0]
+    assert len(item) == 5
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
