@@ -132,7 +132,10 @@ void* PD_PredictorCreate(const char* model_path) {
 int PD_PredictorRun(void* handle, const float* data, const int64_t* shape,
                     int ndim) {
   auto h = acquire(handle);
-  if (!h) return -1;
+  if (!h) {
+    g_last_error = "invalid or destroyed predictor handle";
+    return -1;
+  }
   std::lock_guard<std::mutex> lock(h->mutex);
   if (!h->predictor) {  // destroyed between acquire and lock
     g_last_error = "predictor destroyed";
@@ -230,7 +233,10 @@ int PD_PredictorRun(void* handle, const float* data, const int64_t* shape,
 
 int PD_GetOutputNumDims(void* handle, int idx) {
   auto h = acquire(handle);
-  if (!h) return -1;
+  if (!h) {
+    g_last_error = "invalid or destroyed predictor handle";
+    return -1;
+  }
   std::lock_guard<std::mutex> lock(h->mutex);
   if (!h || idx < 0 || idx >= static_cast<int>(h->output_shapes.size()))
     return -1;
@@ -239,7 +245,10 @@ int PD_GetOutputNumDims(void* handle, int idx) {
 
 int PD_GetOutputShape(void* handle, int idx, int64_t* shape_out) {
   auto h = acquire(handle);
-  if (!h) return -1;
+  if (!h) {
+    g_last_error = "invalid or destroyed predictor handle";
+    return -1;
+  }
   std::lock_guard<std::mutex> lock(h->mutex);
   if (!h || idx < 0 || idx >= static_cast<int>(h->output_shapes.size()))
     return -1;
@@ -250,7 +259,10 @@ int PD_GetOutputShape(void* handle, int idx, int64_t* shape_out) {
 
 int64_t PD_GetOutputNumel(void* handle, int idx) {
   auto h = acquire(handle);
-  if (!h) return -1;
+  if (!h) {
+    g_last_error = "invalid or destroyed predictor handle";
+    return -1;
+  }
   std::lock_guard<std::mutex> lock(h->mutex);
   if (!h || idx < 0 || idx >= static_cast<int>(h->outputs.size())) return -1;
   return static_cast<int64_t>(h->outputs[idx].size());
@@ -258,7 +270,10 @@ int64_t PD_GetOutputNumel(void* handle, int idx) {
 
 int PD_GetOutputData(void* handle, int idx, float* out) {
   auto h = acquire(handle);
-  if (!h) return -1;
+  if (!h) {
+    g_last_error = "invalid or destroyed predictor handle";
+    return -1;
+  }
   std::lock_guard<std::mutex> lock(h->mutex);
   if (!h || idx < 0 || idx >= static_cast<int>(h->outputs.size())) return -1;
   std::memcpy(out, h->outputs[idx].data(),

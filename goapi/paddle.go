@@ -93,10 +93,15 @@ func (p *Predictor) Run(data []float32, shape []int64) ([][]float32, [][]int64, 
 		}
 		shp := make([]int64, int(nd))
 		if nd > 0 {
-			C.PD_GetOutputShape(p.handle, C.int(i),
-				(*C.int64_t)(unsafe.Pointer(&shp[0])))
+			if C.PD_GetOutputShape(p.handle, C.int(i),
+				(*C.int64_t)(unsafe.Pointer(&shp[0]))) < 0 {
+				return nil, nil, lastError()
+			}
 		}
 		numel := C.PD_GetOutputNumel(p.handle, C.int(i))
+		if numel < 0 { // e.g. handle destroyed by a concurrent goroutine
+			return nil, nil, lastError()
+		}
 		buf := make([]float32, int64(numel))
 		if numel > 0 {
 			if C.PD_GetOutputData(p.handle, C.int(i),

@@ -172,19 +172,31 @@ def balanced_partition(costs, k):
 
 
 def segment_layers_by_cost(layers, num_stages, sample_input, training=False):
-    """Measured-cost pipeline segmentation: propagate `sample_input` through
-    `layers` (built nn.Layers / callables), measure each forward with XLA
-    cost analysis, and balance the stages (reference capability: by-size
-    segmentation driven by a cost model rather than uniform counts)."""
+    """Measured-cost pipeline segmentation: thread `sample_input`'s AVAL
+    through `layers` (built nn.Layers / callables) with jax.eval_shape,
+    measure each forward with XLA cost analysis, and balance the stages
+    (reference capability: by-size segmentation driven by a cost model).
+    Fully abstract — no layer executes, nothing touches the device."""
+    from ..core.functional import functional_call, state_dict_arrays
     from ..core.tensor import Tensor
+    from ..nn.layer import Layer as _L
 
-    x = sample_input if isinstance(sample_input, Tensor) else Tensor(sample_input)
+    aval = jax.ShapeDtypeStruct(
+        tuple(sample_input.shape), np.dtype(sample_input.dtype)
+    )
     per_layer = []
     for layer in layers:
-        from ..nn.layer import Layer as _L
-
         if isinstance(layer, _L):
-            cd = layer_cost(layer, x._array, training=training)
+            params, buffers = state_dict_arrays(layer)
+
+            def fwd(params, a, layer=layer, buffers=buffers):
+                out, _ = functional_call(
+                    layer, params, buffers, args=(a,), training=training
+                )
+                return out
+
+            cd = estimate_cost(fwd, params, aval, name=type(layer).__name__)
+            out_aval = jax.eval_shape(fwd, params, aval)
         else:
 
             def _call_once(a, layer=layer):
@@ -192,9 +204,10 @@ def segment_layers_by_cost(layers, num_stages, sample_input, training=False):
                 return getattr(out, "_array", out)
 
             cd = estimate_cost(
-                _call_once, x._array, name=getattr(layer, "__name__", "fn")
+                _call_once, aval, name=getattr(layer, "__name__", "fn")
             )
+            out_aval = jax.eval_shape(_call_once, aval)
         per_layer.append(max(cd.time_us, 1e-9))
-        out = layer(x)
-        x = out if isinstance(out, Tensor) else Tensor(out)
+        out_aval = jax.tree_util.tree_leaves(out_aval)[0]
+        aval = jax.ShapeDtypeStruct(out_aval.shape, out_aval.dtype)
     return balanced_partition(per_layer, num_stages), per_layer

@@ -88,13 +88,21 @@ class StaticFunction:
             cache[instance] = bound
         return bound
 
-    def _key(self, args):
+    def _key(self, args, kwargs=None):
         key = []
         for a in args:
             if isinstance(a, Tensor):
                 key.append((tuple(a.shape), str(np.dtype(a.dtype))))
             else:
                 key.append(repr(a))
+        # kwargs are baked into the compiled entry at trace time, so they
+        # MUST be part of the cache key — a changed kwarg is a new program
+        for k in sorted(kwargs or {}):
+            v = kwargs[k]
+            if isinstance(v, Tensor):
+                key.append((k, tuple(v.shape), str(np.dtype(v.dtype))))
+            else:
+                key.append((k, repr(v)))
         layer = self._layer
         if isinstance(layer, Layer):
             key.append(layer.training)
@@ -111,7 +119,7 @@ class StaticFunction:
         if not isinstance(layer, Layer):
             # plain function: jit over arrays directly
             return self._call_function(*args, **kwargs)
-        key = self._key(args)
+        key = self._key(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
             training = layer.training
@@ -142,7 +150,7 @@ class StaticFunction:
         return tree_to_tensors(out)
 
     def _call_function(self, *args, **kwargs):
-        key = self._key(args)
+        key = self._key(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
             from ..core import autograd

@@ -101,20 +101,24 @@ def _jst_if(cond, true_fn, false_fn, names):
 
 def _jst_while(cond_fn, body_fn, init, names):
     """Runtime dispatch for a converted `while`."""
-    for n, v in zip(names, init):
-        if v is _UNDEF:
-            raise Dy2StaticControlFlowError(
-                f"converted `while`: loop variable '{n}' is read before "
-                "assignment"
-            )
     first = cond_fn(*init)
-    if not _is_traced(first) and not any(_is_traced(v) for v in init):
+    if not _is_traced(first):
+        # CONCRETE condition: plain Python loop — traced values may still
+        # flow through the body (they're ordinary jnp ops), and body-local
+        # temporaries may legitimately start _UNDEF (assigned before read)
         state = tuple(init)
         while _jst_bool(cond_fn(*state)):
             state = body_fn(*state)
             if not isinstance(state, tuple):
                 state = (state,)
         return state
+    for n, v in zip(names, init):
+        if isinstance(v, _Undefined):
+            raise Dy2StaticControlFlowError(
+                f"converted `while` on a traced condition: loop variable "
+                f"'{n}' is read before assignment (XLA while carries need "
+                "defined initial values)"
+            )
     from ..static import nn as snn
 
     out = snn.while_loop(

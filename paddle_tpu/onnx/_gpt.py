@@ -22,7 +22,8 @@ def emit_gpt(em, model, ids_name, seq_len):
     nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
 
     wte = model.wte.weight.numpy()  # [vocab, H]
-    tok = em.node("Gather", [em.init("wte", wte), ids_name], axis=0)
+    wte_name = em.init("wte", wte)
+    tok = em.node("Gather", [wte_name, ids_name], axis=0)
     pos = em.init("wpe_slice", model.wpe.weight.numpy()[:S])  # [S, H]
     x = em.node("Add", [tok, pos])
 
@@ -83,5 +84,6 @@ def emit_gpt(em, model, ids_name, seq_len):
         x = em.node("Add", [x, h])
 
     x = layer_norm(model.ln_f, x)
-    # weight-tied LM head: logits = x @ wte^T
-    return em.node("MatMul", [x, em.init("wte_T", wte.T)])
+    # weight-tied LM head: logits = x @ Transpose(wte) — reuses the
+    # embedding initializer, so the artifact stays tied (and half the size)
+    return em.node("MatMul", [x, em.node("Transpose", [wte_name], perm=[1, 0])])

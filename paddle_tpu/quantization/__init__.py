@@ -14,6 +14,10 @@ from ..core.tensor import Tensor
 from ..nn.layer import Layer
 
 
+def T_arr(x):
+    return x._array if isinstance(x, Tensor) else np.asarray(x)
+
+
 def fake_quant_dequant(x_arr, scale, bits=8):
     qmax = 2.0 ** (bits - 1) - 1
     q = jnp.clip(jnp.round(x_arr / scale * qmax), -qmax, qmax)
@@ -64,6 +68,8 @@ class QuantedLinear(Layer):
         self.register_buffer("act_absmax", Tensor(jnp.zeros((), jnp.float32)))
 
     def forward(self, x):
+        if getattr(self, "_capture_inputs", None) is not None:
+            self._capture_inputs.append(np.asarray(T_arr(x)))
         w = self.inner.weight
         a_bits, w_bits = self.a_bits, self.w_bits
         absmax_buf = self.act_absmax
@@ -105,6 +111,8 @@ class QuantedConv2D(Layer):
     def forward(self, x):
         from ..nn import functional as F
 
+        if getattr(self, "_capture_inputs", None) is not None:
+            self._capture_inputs.append(np.asarray(T_arr(x)))
         inner = self.inner
         a_bits, w_bits = self.a_bits, self.w_bits
 
@@ -250,7 +258,7 @@ class Int8Linear(Layer):
         return Tensor._from_op(out, node)
 
 
-def _emit_int8(model, a_bits=8, w_bits=8, inplace=True):
+def _emit_int8(model, a_bits=8, w_bits=8, inplace=True, use_adaround=False):
     """Replace calibrated QuantedLinear layers with Int8Linear."""
     if not inplace:
         import copy
@@ -263,9 +271,12 @@ def _emit_int8(model, a_bits=8, w_bits=8, inplace=True):
                 w = np.asarray(sub.inner.weight._array, np.float32)  # [in, out]
                 w_qmax = 2.0 ** (w_bits - 1) - 1
                 w_scales = np.maximum(np.abs(w).max(axis=0), 1e-8)  # per out-ch
-                qw = np.clip(
-                    np.round(w / w_scales[None, :] * w_qmax), -w_qmax, w_qmax
-                ).astype(np.int8)
+                if use_adaround and getattr(sub, "_adaround_q", None) is not None:
+                    qw = sub._adaround_q.astype(np.int8)  # learned grid
+                else:
+                    qw = np.clip(
+                        np.round(w / w_scales[None, :] * w_qmax), -w_qmax, w_qmax
+                    ).astype(np.int8)
                 a_scale = float(
                     np.maximum(np.asarray(sub.act_absmax._array), 1e-8)
                 )  # host pull at CONVERSION time only, never per-forward
@@ -277,10 +288,13 @@ def _emit_int8(model, a_bits=8, w_bits=8, inplace=True):
                 w = np.asarray(sub.inner.weight._array, np.float32)  # OIHW
                 w_qmax = 2.0 ** (w_bits - 1) - 1
                 w_scales = np.maximum(np.abs(w).max(axis=(1, 2, 3)), 1e-8)
-                qw = np.clip(
-                    np.round(w / w_scales[:, None, None, None] * w_qmax),
-                    -w_qmax, w_qmax,
-                ).astype(np.int8)
+                if use_adaround and getattr(sub, "_adaround_q", None) is not None:
+                    qw = sub._adaround_q.astype(np.int8)
+                else:
+                    qw = np.clip(
+                        np.round(w / w_scales[:, None, None, None] * w_qmax),
+                        -w_qmax, w_qmax,
+                    ).astype(np.int8)
                 a_scale = float(
                     np.maximum(np.asarray(sub.act_absmax._array), 1e-8)
                 )
@@ -335,7 +349,12 @@ class QAT:
 
 class PTQ:
     """Post-training quantization: run sample data through the quantized
-    model (observers calibrate), then `convert` emits int8 layers."""
+    model (observers calibrate), then `convert` emits int8 layers.
+
+    round_type="adaround" (reference static/quantization/adaround.py:113 via
+    PostTrainingQuantization(round_type=...)): instead of round-to-nearest,
+    each layer's weight rounding is LEARNED against its own calibration
+    activations (quantization/adaround.py) before emission."""
 
     def __init__(self, config: QuantConfig = None):
         self.config = config or QuantConfig()
@@ -344,10 +363,50 @@ class PTQ:
     def quantize(self, model, inplace=False):
         return QAT(self.config).quantize(model, inplace)
 
-    def convert(self, model, inplace=False):
+    def convert(self, model, inplace=False, round_type="round",
+                calib_data=None, adaround_iters=300):
+        if round_type == "adaround":
+            # len() guard: calib_data may be an ndarray (ambiguous truth)
+            if calib_data is None or len(calib_data) == 0:
+                raise ValueError(
+                    "PTQ.convert(round_type='adaround') needs calib_data — "
+                    "a list of input batches to reconstruct layer outputs on"
+                )
+            self._learn_rounding(model, calib_data, adaround_iters)
+        elif round_type != "round":
+            raise ValueError(f"round_type must be round|adaround, got {round_type}")
         return _emit_int8(
             model,
             self.config.activation.get("bits", 8),
             self.config.weight.get("bits", 8),
             inplace=inplace,
+            use_adaround=(round_type == "adaround"),
         )
+
+    def _learn_rounding(self, model, calib_data, iters):
+        from ..core.tensor import to_tensor
+        from .adaround import adaround_conv2d, adaround_linear
+
+        subs = [
+            s for s in model.sublayers()
+            if isinstance(s, (QuantedLinear, QuantedConv2D))
+        ]
+        for s in subs:
+            s._capture_inputs = []
+        try:
+            for batch in calib_data:
+                model(batch if isinstance(batch, Tensor) else to_tensor(batch))
+        finally:
+            captured = {id(s): s._capture_inputs for s in subs}
+            for s in subs:
+                s._capture_inputs = None
+        w_qmax = 2.0 ** (self.config.weight.get("bits", 8) - 1) - 1
+        for s in subs:
+            xs = captured[id(s)]
+            if not xs:
+                continue  # layer never ran on calib data: keep nearest
+            if isinstance(s, QuantedLinear):
+                q, _ = adaround_linear(s, xs, w_qmax, iters=iters)
+            else:
+                q, _ = adaround_conv2d(s, xs, w_qmax, iters=iters)
+            s._adaround_q = q

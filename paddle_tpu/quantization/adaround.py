@@ -1,0 +1,129 @@
+"""AdaRound: learned weight rounding for post-training quantization.
+
+Reference parity: /root/reference/python/paddle/static/quantization/
+adaround.py:113 (round_type='adaround' in PostTrainingQuantization) — instead
+of round-to-nearest, each weight learns whether to round up or down by
+minimizing the layer's output reconstruction error on calibration data, with
+a rectified-sigmoid relaxation annealed toward binary.
+
+TPU-native: the per-layer optimization is ONE jitted Adam loop over the
+rounding logits alpha (lax.scan/fori-free python loop over a jitted step —
+the tensors are small and the loop count modest), using the same math as the
+paper: h(alpha) = clip(1.2*sigmoid(alpha) - 0.1, 0, 1),
+w_soft = (floor(w/s) + h(alpha)) * s, loss = MSE + lam * sum(1 - |2h-1|^beta)
+with beta annealed high->low so h hardens to {0,1}.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _h(alpha):
+    return jnp.clip(jax.nn.sigmoid(alpha) * 1.2 - 0.1, 0.0, 1.0)
+
+
+def learn_rounding(w, scales, apply_fn, calib_inputs, targets, w_qmax,
+                   iters=300, lr=1e-2, lam=0.01, beta_hi=20.0, beta_lo=2.0,
+                   seed=0):
+    """Optimize rounding for one layer's weight.
+
+    w: float weight array; scales: broadcastable per-channel scales;
+    apply_fn(w_q, x) -> layer output (pure); calib_inputs/targets: lists of
+    calibration batches and the float layer's outputs on them.
+    Returns the learned INT weight grid: clip(floor(w/s) + (h>0.5), ...)."""
+    w = jnp.asarray(w, jnp.float32)
+    s = jnp.asarray(scales, jnp.float32)
+    w_floor = jnp.floor(w / s)
+    # init alpha so h(alpha) starts at the round-to-nearest fraction
+    # (paper init): frac in [0,1], alpha = -log(1.2/(frac+0.1) - 1)
+    frac = jnp.clip(w / s - w_floor, 1e-4, 1 - 1e-4)
+    alpha0 = -jnp.log(1.2 / (frac + 0.1) - 1.0)
+
+    xs = [jnp.asarray(x) for x in calib_inputs]
+    ys = [jnp.asarray(y, jnp.float32) for y in targets]
+
+    def soft_weight(alpha):
+        return jnp.clip(w_floor + _h(alpha), -w_qmax, w_qmax) * s
+
+    def loss_fn(alpha, x, y, beta):
+        out = apply_fn(soft_weight(alpha), x).astype(jnp.float32)
+        mse = jnp.mean((out - y) ** 2)
+        h = _h(alpha)
+        round_reg = jnp.sum(1.0 - jnp.abs(2.0 * h - 1.0) ** beta)
+        return mse + lam * round_reg
+
+    @jax.jit
+    def step(alpha, m, v, t, x, y, beta):
+        g = jax.grad(loss_fn)(alpha, x, y, beta)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        return alpha - lr * mh / (jnp.sqrt(vh) + 1e-8), m, v
+
+    alpha = alpha0
+    m = jnp.zeros_like(alpha)
+    v = jnp.zeros_like(alpha)
+    n = len(xs)
+    for i in range(iters):
+        # anneal beta high -> low: free movement early, hard rounding late
+        beta = beta_hi + (beta_lo - beta_hi) * (i / max(iters - 1, 1))
+        x, y = xs[i % n], ys[i % n]
+        alpha, m, v = step(alpha, m, v, jnp.float32(i + 1), x, y,
+                           jnp.float32(beta))
+    hard = (_h(alpha) > 0.5).astype(jnp.float32)
+    q = jnp.clip(w_floor + hard, -w_qmax, w_qmax)
+    return np.asarray(q, np.float32)
+
+
+def adaround_linear(sub, calib_xs, w_qmax, **kw):
+    """Learned rounding grid for a QuantedLinear's weight [in, out]."""
+    w = np.asarray(sub.inner.weight._array, np.float32)
+    scales = np.maximum(np.abs(w).max(axis=0), 1e-8)[None, :] / w_qmax
+    bias = (None if sub.inner.bias is None
+            else jnp.asarray(sub.inner.bias._array, jnp.float32))
+
+    def apply_fn(wq, x):
+        y = x.astype(jnp.float32) @ wq
+        return y if bias is None else y + bias
+
+    targets = [np.asarray(apply_fn(jnp.asarray(w), jnp.asarray(x)))
+               for x in calib_xs]
+    q = learn_rounding(w, scales, apply_fn, calib_xs, targets, w_qmax, **kw)
+    return q, scales[0] * w_qmax  # int grid + absmax-style scales
+
+
+def adaround_conv2d(sub, calib_xs, w_qmax, **kw):
+    """Learned rounding grid for a QuantedConv2D's OIHW weight."""
+    inner = sub.inner
+    w = np.asarray(inner.weight._array, np.float32)
+    scales = np.maximum(np.abs(w).max(axis=(1, 2, 3)), 1e-8) / w_qmax
+    s4 = scales[:, None, None, None]
+    bias = (None if inner.bias is None
+            else jnp.asarray(inner.bias._array, jnp.float32))
+    from ..ops.conv_pool import _conv_padding, _dim_numbers, _pair
+
+    channel_last = inner._data_format.endswith("C") and len(inner._data_format) == 4
+    strides = _pair(inner._stride, 2)
+    dil = _pair(inner._dilation, 2)
+    pad = _conv_padding(inner._padding, 2)
+    dn_spec = _dim_numbers(2, channel_last)
+
+    def apply_fn(wq, x):
+        x = x.astype(jnp.float32)
+        dn = jax.lax.conv_dimension_numbers(x.shape, wq.shape, dn_spec)
+        y = jax.lax.conv_general_dilated(
+            x, wq, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=inner._groups,
+        )
+        if bias is not None:
+            sh = (1,) * (y.ndim - 1) + (-1,) if channel_last else (1, -1, 1, 1)
+            y = y + bias.reshape(sh)
+        return y
+
+    targets = [np.asarray(apply_fn(jnp.asarray(w), jnp.asarray(x)))
+               for x in calib_xs]
+    q = learn_rounding(w, s4, apply_fn, calib_xs, targets, w_qmax, **kw)
+    return q, scales * w_qmax

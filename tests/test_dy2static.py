@@ -166,3 +166,40 @@ def test_decorated_layer_method_converts():
     np.testing.assert_allclose(
         np.asarray(out._array), np.asarray(expected), rtol=1e-6
     )
+
+
+def test_to_static_kwargs_in_cache_key():
+    """Changed kwargs must recompile, not replay the first call's baked
+    kwargs (review finding: the cache key ignored kwargs)."""
+
+    def f(x, scale=1.0):
+        return x * scale
+
+    sf = jit.to_static(f)
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    a = np.asarray(sf(x, scale=2.0)._array)
+    b = np.asarray(sf(x, scale=5.0)._array)
+    np.testing.assert_allclose(a, 2.0 * np.ones(3))
+    np.testing.assert_allclose(b, 5.0 * np.ones(3))
+
+
+def test_converted_function_with_concrete_inner_while():
+    """A traced `if` triggers whole-function conversion; an unrelated
+    concrete while with a body-local temporary must still run (review
+    finding: the _UNDEF guard fired before the Python fallback)."""
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        n = 3
+        while n > 0:
+            t = y + 1.0
+            y = t
+            n = n - 1
+        return y
+
+    sf = jit.to_static(f)
+    out = np.asarray(sf(paddle.to_tensor(np.ones(3, np.float32)))._array)
+    np.testing.assert_allclose(out, np.ones(3) * 5.0)  # 2 + 3
