@@ -53,7 +53,8 @@ def _avals(args):
 
 
 def estimate_cost(fn, *example_args, peak_flops=DEFAULT_PEAK_FLOPS,
-                  hbm_bytes_per_s=DEFAULT_HBM_BYTES_PER_S, name=None):
+                  hbm_bytes_per_s=DEFAULT_HBM_BYTES_PER_S, name=None,
+                  _want_out_avals=False):
     """Cost of `fn(*example_args)` from XLA's compile-time analysis.
 
     `example_args` may be arrays OR ShapeDtypeStructs — nothing executes."""
@@ -61,10 +62,13 @@ def estimate_cost(fn, *example_args, peak_flops=DEFAULT_PEAK_FLOPS,
     analysis = lowered.compile().cost_analysis()
     if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
         analysis = analysis[0] if analysis else {}
-    return CostData.from_cost_analysis(
+    cd = CostData.from_cost_analysis(
         name or getattr(fn, "__name__", "fn"), analysis or {},
         peak_flops, hbm_bytes_per_s,
     )
+    if _want_out_avals:
+        return cd, lowered.out_info  # one trace serves both cost + shapes
+    return cd
 
 
 def layer_cost(layer, *example_inputs, training=False, **kw):
@@ -195,19 +199,21 @@ def segment_layers_by_cost(layers, num_stages, sample_input, training=False):
                 )
                 return out
 
-            cd = estimate_cost(fwd, params, aval, name=type(layer).__name__)
-            out_aval = jax.eval_shape(fwd, params, aval)
+            cd, out_info = estimate_cost(
+                fwd, params, aval, name=type(layer).__name__,
+                _want_out_avals=True,
+            )
         else:
 
             def _call_once(a, layer=layer):
                 out = layer(Tensor._from_op(a))
                 return getattr(out, "_array", out)
 
-            cd = estimate_cost(
-                _call_once, aval, name=getattr(layer, "__name__", "fn")
+            cd, out_info = estimate_cost(
+                _call_once, aval, name=getattr(layer, "__name__", "fn"),
+                _want_out_avals=True,
             )
-            out_aval = jax.eval_shape(_call_once, aval)
         per_layer.append(max(cd.time_us, 1e-9))
-        out_aval = jax.tree_util.tree_leaves(out_aval)[0]
+        out_aval = jax.tree_util.tree_leaves(out_info)[0]
         aval = jax.ShapeDtypeStruct(out_aval.shape, out_aval.dtype)
     return balanced_partition(per_layer, num_stages), per_layer

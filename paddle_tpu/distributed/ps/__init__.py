@@ -247,3 +247,10 @@ class PSClient:
 
     def table_size(self, name):
         return self._call(_svc_table_size, name)
+
+
+from .runtime import (  # noqa: E402,F401
+    PSRoleMaker,
+    PSRuntime,
+    distributed_lookup_table,
+)

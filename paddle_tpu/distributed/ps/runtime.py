@@ -1,0 +1,210 @@
+"""PS deployment runtime + in-graph distributed embedding lookup.
+
+Reference parity: TheOnePSRuntime
+(/root/reference/python/paddle/distributed/ps/the_one_ps.py:1031) — the
+layer that turns a fleet role into running servers and connected trainers —
+and the PS graph-side op `distributed_lookup_table`
+(/root/reference/paddle/fluid/operators/pscore/distributed_lookup_table_op.cc).
+
+TPU-native scope (README scope note): servers host the in-memory
+dense/sparse tables of `distributed.ps` behind the TCP RPC agent; trainers
+connect a PSClient per server and shard tables across servers by name hash.
+`distributed_lookup_table` pulls rows eagerly for the forward and records a
+tape node whose backward PUSHES gradients to the table (async-SGD applied
+server-side) — the reference's pull/push pair around each step. Giant dense
+embeddings stay on-device via GSPMD (VocabParallelEmbedding); this runtime
+serves the sparse/beyond-HBM tail.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ...core import autograd
+from ...core.tensor import Tensor
+from . import PSClient
+
+
+class PSRoleMaker:
+    """Env-driven role detection (reference PaddleCloudRoleMaker surface):
+    TRAINING_ROLE=PSERVER|TRAINER, PADDLE_PSERVERS_IP_PORT_LIST,
+    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID / PADDLE_PSERVER_ID."""
+
+    def __init__(self, role=None, server_num=None, trainer_num=None,
+                 index=None):
+        env_role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self.role = (role or env_role).upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        n_servers = len([e for e in eps.split(",") if e]) if eps else 1
+        self.server_num = server_num if server_num is not None else n_servers
+        self.trainer_num = trainer_num if trainer_num is not None else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1")
+        )
+        if index is not None:
+            self.index = int(index)
+        elif self.is_server():
+            self.index = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+        else:
+            self.index = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def is_server(self):
+        return self.role == "PSERVER"
+
+    def is_worker(self):
+        return self.role == "TRAINER"
+
+    def server_index(self):
+        return self.index if self.is_server() else -1
+
+    def worker_index(self):
+        return self.index if self.is_worker() else -1
+
+    def world_size(self):
+        return self.server_num + self.trainer_num
+
+
+_STOP_EVENT = threading.Event()
+
+
+def _svc_stop_server():
+    _STOP_EVENT.set()
+    return True
+
+
+class PSRuntime:
+    """Deploys PS training from a role: servers serve tables, trainers get
+    sharded PSClients + table auto-creation for a model."""
+
+    def __init__(self, role_maker: PSRoleMaker, master_endpoint: str):
+        self.role = role_maker
+        self.master = master_endpoint
+        self._clients = None
+
+    # rpc world layout: ps0..psS-1 then trainer0..trainerT-1
+    def _rpc_name(self):
+        r = self.role
+        return (f"ps{r.index}" if r.is_server() else f"trainer{r.index}")
+
+    def _rpc_rank(self):
+        r = self.role
+        return r.index if r.is_server() else r.server_num + r.index
+
+    def _init_rpc(self):
+        from .. import rpc
+
+        rpc.init_rpc(
+            self._rpc_name(), rank=self._rpc_rank(),
+            world_size=self.role.world_size(), master_endpoint=self.master,
+        )
+
+    # ---- server side -------------------------------------------------------
+    def run_server(self, block=True):
+        """Host tables until a trainer calls stop (reference
+        fleet.run_server blocking loop)."""
+        if not self.role.is_server():
+            raise RuntimeError("run_server on a non-PSERVER role")
+        self._init_rpc()
+        if block:
+            _STOP_EVENT.wait()
+            from .. import rpc
+
+            rpc.shutdown()
+
+    # ---- trainer side ------------------------------------------------------
+    def init_worker(self, model=None, lr=0.01):
+        """Connect clients; auto-create tables for `model`: one sparse table
+        per Embedding-like layer flagged `.remote=True`, one dense table per
+        other parameter (initialized from the live values)."""
+        if not self.role.is_worker():
+            raise RuntimeError("init_worker on a non-TRAINER role")
+        self._init_rpc()
+        self._clients = [
+            PSClient(server=f"ps{i}") for i in range(self.role.server_num)
+        ]
+        if model is not None:
+            self._create_tables(model, lr)
+
+    def client_for(self, table_name) -> PSClient:
+        # stable content hash: builtin hash() is per-process randomized
+        # (PYTHONHASHSEED), which would route the same table to DIFFERENT
+        # servers in different trainer processes
+        import zlib
+
+        i = zlib.crc32(table_name.encode()) % len(self._clients)
+        return self._clients[i]
+
+    def _create_tables(self, model, lr):
+        from ...nn.common import Embedding
+
+        # EVERY worker creates (server-side creation is idempotent): a
+        # create-only-on-worker-0 scheme would let other trainers pull
+        # before the table exists
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, Embedding) and getattr(sub, "remote", False):
+                tname = f"emb.{name}"
+                self.client_for(tname).create_sparse_table(
+                    tname, dim=sub._embedding_dim, lr=lr
+                )
+                sub._ps_table = tname
+                sub._ps_runtime = self
+        for name, p in model.named_parameters():
+            if getattr(p, "_ps_remote", False):
+                tname = f"dense.{name}"
+                self.client_for(tname).create_dense_table(
+                    tname, shape=list(p.shape), lr=lr,
+                    init=np.asarray(p._array, np.float32),
+                )
+
+    def pull_dense(self, model):
+        import jax.numpy as jnp
+
+        for name, p in model.named_parameters():
+            if getattr(p, "_ps_remote", False):
+                vals = self.client_for(f"dense.{name}").pull_dense(f"dense.{name}")
+                p._array = jnp.asarray(np.asarray(vals, np.float32))
+
+    def push_dense_grads(self, model):
+        for name, p in model.named_parameters():
+            if getattr(p, "_ps_remote", False) and p._grad is not None:
+                self.client_for(f"dense.{name}").push_dense(
+                    f"dense.{name}", np.asarray(p._grad._array, np.float32)
+                )
+
+    def stop_worker(self):
+        from .. import rpc
+
+        if self.role.worker_index() == 0:
+            for i in range(self.role.server_num):
+                rpc.rpc_sync(f"ps{i}", _svc_stop_server, args=())
+        rpc.shutdown()
+
+
+def distributed_lookup_table(runtime: PSRuntime, table: str, ids):
+    """In-graph PS embedding (reference distributed_lookup_table_op.cc):
+    forward PULLS rows for `ids`; backward PUSHES the row gradients, which
+    the server-side rule (sgd/adagrad) applies — the table itself is the
+    trainable state, living on the parameter server."""
+    ids_np = np.asarray(ids._array if isinstance(ids, Tensor) else ids)
+    shape = ids_np.shape
+    flat = ids_np.reshape(-1).astype(np.int64)
+    client = runtime.client_for(table)
+    rows = np.asarray(client.pull_sparse(table, flat), np.float32)
+    out = rows.reshape(shape + (rows.shape[-1],))
+
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(out)
+    if not autograd.is_grad_enabled():
+        return Tensor._from_op(arr)
+
+    def vjp_fn(ct):
+        g = np.asarray(ct, np.float32).reshape(len(flat), -1)
+        client.push_sparse(table, flat, g)
+        return ()  # no local inputs receive gradient
+
+    node = autograd.GradNode(
+        vjp_fn, (), [(arr.shape, arr.dtype)], False, "distributed_lookup_table"
+    )
+    return Tensor._from_op(arr, node, 0)

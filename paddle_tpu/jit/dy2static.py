@@ -71,6 +71,11 @@ def _jst_peek(frame_locals, name):
 
 def _jst_bool(cond):
     """Concrete truthiness for the Python fallback path."""
+    if isinstance(cond, _Undefined):
+        raise Dy2StaticControlFlowError(
+            "converted control flow: a condition reads a variable before "
+            "assignment (eager Python would raise UnboundLocalError here)"
+        )
     if isinstance(cond, Tensor):
         return bool(cond._array)
     return bool(cond)
@@ -105,7 +110,8 @@ def _jst_while(cond_fn, body_fn, init, names):
     if not _is_traced(first):
         # CONCRETE condition: plain Python loop — traced values may still
         # flow through the body (they're ordinary jnp ops), and body-local
-        # temporaries may legitimately start _UNDEF (assigned before read)
+        # temporaries may legitimately start _UNDEF (assigned before read);
+        # _jst_bool rejects an _UNDEF condition with a clear error
         state = tuple(init)
         while _jst_bool(cond_fn(*state)):
             state = body_fn(*state)

@@ -94,6 +94,91 @@ def summarize(logdir_or_file, device_only=True, top=30):
     return out
 
 
+def schedule_analysis(logdir_or_file, top_gaps=10):
+    """Executor-schedule statistics (reference
+    paddle/fluid/framework/new_executor/executor_statistics.cc: per-run
+    timeline analysis — device busy vs idle, the gaps where the executor
+    starved the device, and the op stream's utilization ratio).
+
+    For each device plane: wall span (first event start -> last event end),
+    busy time (union of op intervals, overlaps merged), idle = span - busy,
+    utilization = busy/span, and the largest idle gaps with the ops that
+    bracket them — the direct answer to "where is the schedule losing
+    time" that the reference derives from interpreter run records."""
+    paths = (
+        [logdir_or_file]
+        if logdir_or_file.endswith(".pb")
+        else find_xplane_files(logdir_or_file)
+    )
+    out = {}
+    planes = []
+    for path in paths:
+        xs = _load_space(path)
+        planes.extend(xs.planes)
+    device_planes = [p for p in planes if p.name.startswith("/device:")]
+    host_fallback = not device_planes
+    if host_fallback:
+        # CPU-only captures carry no device plane; analyze the host
+        # compute threads instead (still a real schedule view)
+        device_planes = [p for p in planes if p.name == "/host:CPU"]
+    for plane in device_planes:
+        em = plane.event_metadata
+        intervals = []  # (start_ps, end_ps, name)
+        for line in plane.lines:
+            if not host_fallback and line.name not in ("XLA Ops",):
+                continue
+            base = line.timestamp_ns * 1000
+            for ev in line.events:
+                s = base + ev.offset_ps
+                intervals.append((s, s + ev.duration_ps, em[ev.metadata_id].name))
+        if not intervals:
+            continue
+        intervals.sort()
+        span_start = intervals[0][0]
+        span_end = max(e for _, e, _ in intervals)
+        # merge overlaps -> busy union + gaps between merged runs
+        busy = 0
+        gaps = []
+        cur_s, cur_e, last_name = intervals[0]
+        for s, e, name in intervals[1:]:
+            if s <= cur_e:
+                cur_e = max(cur_e, e)
+                last_name = name if e >= cur_e else last_name
+            else:
+                busy += cur_e - cur_s
+                gaps.append((s - cur_e, cur_e, last_name, name))
+                cur_s, cur_e, last_name = s, e, name
+        busy += cur_e - cur_s
+        span = max(span_end - span_start, 1)
+        gaps.sort(key=lambda g: -g[0])
+        out[plane.name] = {
+            "span_ms": span / 1e9,
+            "busy_ms": busy / 1e9,
+            "idle_ms": (span - busy) / 1e9,
+            "utilization": busy / span,
+            "n_ops": len(intervals),
+            "top_gaps": [
+                {"gap_ms": g / 1e9, "after_op": a[:80], "before_op": b[:80]}
+                for g, _, a, b in gaps[:top_gaps]
+            ],
+        }
+    return out
+
+
+def print_schedule_analysis(logdir_or_file, top_gaps=10, file=None):
+    import sys
+
+    f = file or sys.stdout
+    for plane, st in schedule_analysis(logdir_or_file, top_gaps).items():
+        print(
+            f"== {plane}: span {st['span_ms']:.2f} ms, busy {st['busy_ms']:.2f} ms "
+            f"({st['utilization']*100:.1f}% util, {st['n_ops']} ops)", file=f
+        )
+        for g in st["top_gaps"]:
+            print(f"  idle {g['gap_ms']:8.3f} ms  after {g['after_op']}"
+                  f"  before {g['before_op']}", file=f)
+
+
 def print_summary(logdir_or_file, device_only=True, top=20, file=None):
     """Human-readable rendering of summarize() (the reference tool's
     console table)."""

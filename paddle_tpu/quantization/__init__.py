@@ -14,8 +14,7 @@ from ..core.tensor import Tensor
 from ..nn.layer import Layer
 
 
-def T_arr(x):
-    return x._array if isinstance(x, Tensor) else np.asarray(x)
+from ..core.tensor import as_array as T_arr  # Tensor|array -> jax array
 
 
 def fake_quant_dequant(x_arr, scale, bits=8):

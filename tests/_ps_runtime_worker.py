@@ -1,0 +1,76 @@
+"""Worker for the 2-process PS runtime test (reference TheOnePSRuntime
+deployment shape: one PSERVER process hosting tables, one TRAINER process
+training an embedding model whose rows live on the server).
+
+Usage: python _ps_runtime_worker.py <role> <port>
+"""
+import os
+import sys
+
+ROLE = sys.argv[1]
+PORT = sys.argv[2]
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from paddle_tpu.distributed.ps import PSRoleMaker, PSRuntime, distributed_lookup_table
+
+role = PSRoleMaker(role=ROLE, server_num=1, trainer_num=1, index=0)
+rt = PSRuntime(role, master_endpoint=f"127.0.0.1:{PORT}")
+
+if ROLE == "PSERVER":
+    rt.run_server(block=True)  # returns after the trainer's stop_worker
+    print("SERVER DONE", flush=True)
+    sys.exit(0)
+
+# ---- trainer --------------------------------------------------------------
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class RecModel(nn.Layer):
+    """Dense tower over a REMOTE embedding (lives on the PS)."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(64, 8)
+        self.emb.remote = True  # rows served by the parameter server
+        self.fc = nn.Linear(8, 1)
+
+    def forward(self, ids):
+        x = distributed_lookup_table(rt, self.emb._ps_table, ids)
+        return self.fc(x.mean(axis=1))
+
+
+paddle.seed(0)
+model = RecModel()
+rt.init_worker(model, lr=0.5)
+assert model.emb._ps_table == "emb.emb"
+
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.fc.parameters())
+rs = np.random.RandomState(0)
+ids = paddle.to_tensor(rs.randint(0, 64, (16, 5)).astype(np.int64))
+target = paddle.to_tensor(np.ones((16, 1), np.float32))
+
+client = rt.client_for("emb.emb")
+rows_before = np.asarray(client.pull_sparse("emb.emb", np.arange(64)))
+
+losses = []
+for _ in range(15):
+    pred = model(ids)
+    loss = ((pred - target) ** 2).mean()
+    loss.backward()   # backward PUSHES row grads to the server table
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(np.asarray(loss._array)))
+
+rows_after = np.asarray(client.pull_sparse("emb.emb", np.arange(64)))
+assert losses[-1] < 0.5 * losses[0], losses
+# the server-side table actually trained (rows moved for the touched ids)
+touched = np.unique(np.asarray(ids._array))
+delta = np.abs(rows_after[touched] - rows_before[touched]).max()
+assert delta > 1e-4, delta
+print("TRAINER LOSSES", losses[0], losses[-1], "DELTA", float(delta), flush=True)
+rt.stop_worker()
+print("TRAINER DONE", flush=True)

@@ -117,3 +117,31 @@ def test_sparse_table_capacity_and_shrink():
     assert dropped == 2 and t2.size() == 1 and 10 in t2.rows
     # access counters reset after shrink
     assert t2.shrink(threshold=1) == 1  # 10 now cold again
+
+
+def test_ps_runtime_deployment():
+    """TheOnePSRuntime shape (reference the_one_ps.py:1031): a PSERVER
+    process hosts tables, a TRAINER process auto-creates them from a model,
+    trains through distributed_lookup_table (backward pushes row grads),
+    and stop_worker shuts the server down."""
+    import subprocess
+    import sys
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["PADDLE_TPU_PLATFORM"] = "cpu"
+    script = os.path.join(os.path.dirname(__file__), "_ps_runtime_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, role, str(port)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for role in ("PSERVER", "TRAINER")
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    assert "SERVER DONE" in outs[0], outs[0][-500:]
+    assert "TRAINER DONE" in outs[1], outs[1][-500:]
