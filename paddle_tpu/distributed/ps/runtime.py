@@ -105,6 +105,7 @@ class PSRuntime:
         fleet.run_server blocking loop)."""
         if not self.role.is_server():
             raise RuntimeError("run_server on a non-PSERVER role")
+        _STOP_EVENT.clear()  # a prior stop in this process must not leak
         self._init_rpc()
         if block:
             _STOP_EVENT.wait()
@@ -127,6 +128,11 @@ class PSRuntime:
             self._create_tables(model, lr)
 
     def client_for(self, table_name) -> PSClient:
+        if self._clients is None:
+            raise RuntimeError(
+                "PSRuntime: no clients — call init_worker first (and only "
+                "on a TRAINER role)"
+            )
         # stable content hash: builtin hash() is per-process randomized
         # (PYTHONHASHSEED), which would route the same table to DIFFERENT
         # servers in different trainer processes

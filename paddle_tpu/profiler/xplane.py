@@ -36,6 +36,13 @@ def find_xplane_files(logdir):
                             recursive=True))
 
 
+def _capture_paths(logdir_or_file):
+    """One capture file, or every capture under a logdir."""
+    if logdir_or_file.endswith(".pb"):
+        return [logdir_or_file]
+    return find_xplane_files(logdir_or_file)
+
+
 def _category(op_name):
     base = re.sub(r"[.\d]+ =.*", "", op_name).strip("%")
     return re.sub(r"\.\d+$", "", base)
@@ -47,11 +54,7 @@ def summarize(logdir_or_file, device_only=True, top=30):
     Returns {plane_name: {"total_ms", "lines", "by_category": [(name, ms)],
     "by_op": [(name, ms)]}} — the op-profile table the reference's
     cross-stack tool renders, as plain data."""
-    paths = (
-        [logdir_or_file]
-        if logdir_or_file.endswith(".pb")
-        else find_xplane_files(logdir_or_file)
-    )
+    paths = _capture_paths(logdir_or_file)
     out = {}
     for path in paths:
         xs = _load_space(path)
@@ -105,14 +108,9 @@ def schedule_analysis(logdir_or_file, top_gaps=10):
     utilization = busy/span, and the largest idle gaps with the ops that
     bracket them — the direct answer to "where is the schedule losing
     time" that the reference derives from interpreter run records."""
-    paths = (
-        [logdir_or_file]
-        if logdir_or_file.endswith(".pb")
-        else find_xplane_files(logdir_or_file)
-    )
     out = {}
     planes = []
-    for path in paths:
+    for path in _capture_paths(logdir_or_file):
         xs = _load_space(path)
         planes.extend(xs.planes)
     device_planes = [p for p in planes if p.name.startswith("/device:")]
@@ -121,16 +119,21 @@ def schedule_analysis(logdir_or_file, top_gaps=10):
         # CPU-only captures carry no device plane; analyze the host
         # compute threads instead (still a real schedule view)
         device_planes = [p for p in planes if p.name == "/host:CPU"]
+    # same-named planes from multiple captures (repeated traces, multi-host)
+    # MERGE their intervals rather than overwriting each other
+    by_name = defaultdict(list)
     for plane in device_planes:
         em = plane.event_metadata
-        intervals = []  # (start_ps, end_ps, name)
         for line in plane.lines:
             if not host_fallback and line.name not in ("XLA Ops",):
                 continue
             base = line.timestamp_ns * 1000
             for ev in line.events:
                 s = base + ev.offset_ps
-                intervals.append((s, s + ev.duration_ps, em[ev.metadata_id].name))
+                by_name[plane.name].append(
+                    (s, s + ev.duration_ps, em[ev.metadata_id].name)
+                )
+    for plane_name, intervals in by_name.items():
         if not intervals:
             continue
         intervals.sort()
@@ -151,7 +154,7 @@ def schedule_analysis(logdir_or_file, top_gaps=10):
         busy += cur_e - cur_s
         span = max(span_end - span_start, 1)
         gaps.sort(key=lambda g: -g[0])
-        out[plane.name] = {
+        out[plane_name] = {
             "span_ms": span / 1e9,
             "busy_ms": busy / 1e9,
             "idle_ms": (span - busy) / 1e9,

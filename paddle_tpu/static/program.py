@@ -271,6 +271,45 @@ class Executor:
             return [np.asarray(o) for o in outs]
         return outs
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Reference fluid/executor.py train_from_dataset — the
+        MultiTrainer/DeviceWorker dataset loop: iterate the fleet dataset's
+        slot batches through the program. With optimizer.minimize-appended
+        update ops, every Executor.run IS a train step (state writes
+        persist params/slots), so this single loop replaces the reference's
+        trainer/worker thread hierarchy on TPU."""
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        prog = program if program is not None else _default_main
+        names = list(getattr(dataset, "_var_names", []))
+        if not names:
+            raise ValueError(
+                "dataset has no declared slots — call set_use_var first"
+            )
+        labels = list(fetch_info or [])
+        for step, batch in enumerate(dataset):
+            feed = dict(zip(names, batch))
+            outs = self.run(prog, feed=feed, fetch_list=fetch_list)
+            if fetch_list and (debug or (step % max(print_period, 1) == 0)):
+                shown = ", ".join(
+                    f"{labels[i] if i < len(labels) else f'fetch{i}'}="
+                    f"{np.asarray(o).ravel()[:1][0]:.6f}"
+                    for i, o in enumerate(outs)
+                )
+                print(f"step {step}: {shown}")
+        return None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Same dataset loop for inference programs (no update ops)."""
+        return self.train_from_dataset(
+            program, dataset, scope, thread, debug, fetch_list, fetch_info,
+            print_period,
+        )
+
     def close(self):
         self._cache.clear()
 

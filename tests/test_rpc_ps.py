@@ -138,10 +138,16 @@ def test_ps_runtime_deployment():
         )
         for role in ("PSERVER", "TRAINER")
     ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out)
-        assert p.returncode == 0, out[-2000:]
-    assert "SERVER DONE" in outs[0], outs[0][-500:]
-    assert "TRAINER DONE" in outs[1], outs[1][-500:]
+    try:
+        # TRAINER first: if it dies before stop_worker, the server would
+        # block forever — failing fast here surfaces the real error
+        trainer_out, _ = procs[1].communicate(timeout=240)
+        assert procs[1].returncode == 0, trainer_out[-2000:]
+        server_out, _ = procs[0].communicate(timeout=60)
+        assert procs[0].returncode == 0, server_out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert "SERVER DONE" in server_out, server_out[-500:]
+    assert "TRAINER DONE" in trainer_out, trainer_out[-500:]

@@ -161,3 +161,52 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+def test_executor_train_from_dataset(tmp_path):
+    """The reference's dataset-trainer entry (fluid Executor.train_from_dataset
+    over an InMemoryDataset): slot files -> fleet dataset -> per-batch
+    Executor.run with minimize-appended update ops -> loss drops."""
+    from paddle_tpu.io.fleet_dataset import InMemoryDataset
+
+    rs = np.random.RandomState(0)
+    w_true = np.array([1.5, -2.0, 0.5], np.float32)
+    # slot-text file: x (3 floats) then label (1 float) per line
+    lines, xs_raw, ys_raw = [], [], []
+    for _ in range(256):
+        x = rs.rand(3).astype(np.float32)
+        yv = float(x @ w_true)
+        xs_raw.append(x)
+        ys_raw.append([yv])
+        # paddle slot-text: "<count> <values...>" per declared slot
+        lines.append("3 " + " ".join(f"{v:.6f}" for v in x) + f" 1 {yv:.6f}")
+    f = tmp_path / "part-000"
+    f.write_text("\n".join(lines) + "\n")
+
+    paddle.seed(0)
+    net = nn.Linear(3, 1)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [32, 3], "float32")
+        y = static.data("y", [32, 1], "float32")
+        loss = ((net(x) - y) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=net.parameters())
+        opt.minimize(loss)
+
+    ds = InMemoryDataset()
+    ds.init(batch_size=32, use_var=[x, y])
+    ds.set_filelist([str(f)])
+    ds.set_drop_last(True)
+    ds.load_into_memory()
+
+    exe = static.Executor()
+    xb = np.stack(xs_raw[:32]).astype(np.float32)
+    yb = np.asarray(ys_raw[:32], np.float32)
+    first = float(exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])[0])
+    for _ in range(15):  # epochs over the dataset
+        ds.local_shuffle()
+        exe.train_from_dataset(prog, ds, fetch_list=[loss], print_period=10**9)
+    final = float(exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])[0])
+    assert final < 0.05 * first, (first, final)
+    w = np.asarray(net.weight._array).ravel()
+    np.testing.assert_allclose(w, w_true, atol=0.4)

@@ -135,3 +135,54 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+@pytest.fixture()
+def ml1m_zip(tmp_path):
+    import zipfile
+
+    path = tmp_path / "ml-1m.zip"
+    movies = (
+        "1::Toy Story (1995)::Animation|Children's|Comedy\n"
+        "2::Jumanji (1995)::Adventure|Children's|Fantasy\n"
+    )
+    users = (
+        "1::F::1::10::48067\n"
+        "2::M::56::16::70072\n"
+    )
+    # many ratings so both splits are non-empty under the seeded split
+    ratings = "".join(
+        f"{(i % 2) + 1}::{(i % 2) + 1}::{(i % 5) + 1}::97830{i:04d}\n"
+        for i in range(80)
+    )
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    return str(path)
+
+
+def test_movielens_real_parse(ml1m_zip):
+    from paddle_tpu.text import Movielens
+
+    tr = Movielens(data_file=ml1m_zip, mode="train")
+    te = Movielens(data_file=ml1m_zip, mode="test")
+    assert tr.real and te.real
+    assert len(tr) + len(te) == 80
+    assert len(te) > 0  # seeded 10% split captured some rows
+    item = tr[0]
+    # reference item tuple: uid, gender, age_idx, job, mid, cats, title, rating
+    assert len(item) == 8
+    uid, gender, age_idx, job, mid, cats, words, rating = item
+    assert uid[0] in (1, 2) and gender[0] in (0, 1)
+    assert age_idx[0] in (0, 6)  # ages 1 and 56 -> table indices 0 and 6
+    assert len(cats) == 3  # both fixture movies carry 3 categories
+    assert rating[0] in {2 * r - 5.0 for r in (1, 2, 3, 4, 5)}
+
+
+def test_movielens_synthetic_fallback_is_loud():
+    from paddle_tpu.text import Movielens
+
+    with pytest.warns(UserWarning, match="SYNTHETIC"):
+        ds = Movielens()
+    assert not ds.real and len(ds[0]) == 8
