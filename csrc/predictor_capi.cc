@@ -238,8 +238,10 @@ int PD_GetOutputNumDims(void* handle, int idx) {
     return -1;
   }
   std::lock_guard<std::mutex> lock(h->mutex);
-  if (!h || idx < 0 || idx >= static_cast<int>(h->output_shapes.size()))
+  if (idx < 0 || idx >= static_cast<int>(h->output_shapes.size())) {
+    g_last_error = "output index out of range";
     return -1;
+  }
   return static_cast<int>(h->output_shapes[idx].size());
 }
 
@@ -250,8 +252,10 @@ int PD_GetOutputShape(void* handle, int idx, int64_t* shape_out) {
     return -1;
   }
   std::lock_guard<std::mutex> lock(h->mutex);
-  if (!h || idx < 0 || idx >= static_cast<int>(h->output_shapes.size()))
+  if (idx < 0 || idx >= static_cast<int>(h->output_shapes.size())) {
+    g_last_error = "output index out of range";
     return -1;
+  }
   const auto& s = h->output_shapes[idx];
   for (size_t i = 0; i < s.size(); ++i) shape_out[i] = s[i];
   return static_cast<int>(s.size());
@@ -264,7 +268,10 @@ int64_t PD_GetOutputNumel(void* handle, int idx) {
     return -1;
   }
   std::lock_guard<std::mutex> lock(h->mutex);
-  if (!h || idx < 0 || idx >= static_cast<int>(h->outputs.size())) return -1;
+  if (idx < 0 || idx >= static_cast<int>(h->outputs.size())) {
+    g_last_error = "output index out of range";
+    return -1;
+  }
   return static_cast<int64_t>(h->outputs[idx].size());
 }
 
@@ -275,7 +282,10 @@ int PD_GetOutputData(void* handle, int idx, float* out) {
     return -1;
   }
   std::lock_guard<std::mutex> lock(h->mutex);
-  if (!h || idx < 0 || idx >= static_cast<int>(h->outputs.size())) return -1;
+  if (idx < 0 || idx >= static_cast<int>(h->outputs.size())) {
+    g_last_error = "output index out of range";
+    return -1;
+  }
   std::memcpy(out, h->outputs[idx].data(),
               h->outputs[idx].size() * sizeof(float));
   return 0;

@@ -34,6 +34,7 @@ import "C"
 
 import (
 	"errors"
+	"fmt"
 	"runtime"
 	"unsafe"
 )
@@ -73,6 +74,21 @@ func (p *Predictor) Run(data []float32, shape []int64) ([][]float32, [][]int64, 
 	if p.handle == nil {
 		return nil, nil, errors.New("predictor destroyed")
 	}
+	if len(data) == 0 || len(shape) == 0 {
+		return nil, nil, errors.New("empty input data or shape")
+	}
+	numel := int64(1)
+	for _, d := range shape {
+		numel *= d
+	}
+	// the C side reads shape-product floats from &data[0]
+	if numel != int64(len(data)) {
+		return nil, nil, fmt.Errorf(
+			"data length %d does not match shape product %d", len(data), numel)
+	}
+	// the finalizer set in NewPredictor may otherwise destroy the handle
+	// mid-call once p's last Go reference (the p.handle read above) is gone
+	defer runtime.KeepAlive(p)
 	runtime.LockOSThread()
 	defer runtime.UnlockOSThread()
 	nOut := C.PD_PredictorRun(

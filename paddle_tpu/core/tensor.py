@@ -50,7 +50,11 @@ class Tensor:
             npdata = np.asarray(data)
             if dtype is None and npdata.dtype == np.float64:
                 npdata = npdata.astype(np.float32)  # paddle default dtype
-            arr = jnp.asarray(npdata, dtype=dtypes.convert_dtype(dtype))
+            # jnp.array copies: asarray can alias the caller's numpy buffer
+            # (zero-copy CPU path), which breaks jax's immutability contract
+            # if the caller mutates it and corrupts the heap if the array is
+            # ever donated (see set_value)
+            arr = jnp.array(npdata, dtype=dtypes.convert_dtype(dtype))
             arr = jax.device_put(arr, place or device.current_device())
         if dtype is not None:
             want = dtypes.convert_dtype(dtype)
@@ -217,7 +221,11 @@ class Tensor:
 
     # ---- mutation (eager only) --------------------------------------------
     def set_value(self, value):
-        arr = value._array if isinstance(value, Tensor) else jnp.asarray(np.asarray(value))
+        # jnp.array (not asarray): asarray of an aligned numpy array is
+        # ZERO-COPY on the CPU backend, so a donating jitted step (hapi
+        # train: donate_argnums over params/opt state) would free a buffer
+        # numpy owns — heap corruption after Model.load + train_batch
+        arr = value._array if isinstance(value, Tensor) else jnp.array(np.asarray(value))
         if tuple(arr.shape) != tuple(self._array.shape):
             raise ValueError(
                 f"set_value shape mismatch: {arr.shape} vs {self._array.shape}"

@@ -17,6 +17,7 @@ import os
 import pickle
 
 import jax
+import jax.export  # registers the `jax.export` attribute on older jax
 import numpy as np
 
 from ..core import rng
@@ -88,6 +89,14 @@ class StaticFunction:
             cache[instance] = bound
         return bound
 
+    @staticmethod
+    def _contains_tensor(v):
+        if isinstance(v, (list, tuple, set)):
+            return any(StaticFunction._contains_tensor(x) for x in v)
+        if isinstance(v, dict):
+            return any(StaticFunction._contains_tensor(x) for x in v.values())
+        return isinstance(v, (Tensor, np.ndarray))
+
     def _key(self, args, kwargs=None):
         key = []
         for a in args:
@@ -96,12 +105,27 @@ class StaticFunction:
             else:
                 key.append(repr(a))
         # kwargs are baked into the compiled entry at trace time, so they
-        # MUST be part of the cache key — a changed kwarg is a new program
+        # MUST be part of the cache key — a changed kwarg is a new program.
+        # Direct Tensor kwargs are keyed by (shape, dtype) and enter the
+        # program as runtime arrays; a Tensor buried in a container would be
+        # baked as a constant AND repr-truncation would collide the cache
+        # key for large arrays, so it is rejected loudly.
         for k in sorted(kwargs or {}):
             v = kwargs[k]
             if isinstance(v, Tensor):
                 key.append((k, tuple(v.shape), str(np.dtype(v.dtype))))
+            elif isinstance(v, np.ndarray):
+                # keyed like a Tensor: repr() truncates large arrays, so two
+                # different arrays could collide on one cache key
+                key.append((k, v.shape, str(v.dtype)))
             else:
+                if self._contains_tensor(v):
+                    raise TypeError(
+                        f"to_static: kwarg '{k}' holds Tensors inside a "
+                        "container; container values are baked into the "
+                        "compiled program as constants. Pass each Tensor as "
+                        "its own keyword or positional argument."
+                    )
                 key.append((k, repr(v)))
         layer = self._layer
         if isinstance(layer, Layer):
@@ -121,14 +145,27 @@ class StaticFunction:
             return self._call_function(*args, **kwargs)
         key = self._key(args, kwargs)
         entry = self._cache.get(key)
+        # Tensor/ndarray kwargs are keyed by (shape, dtype) like positional
+        # args, so they MUST enter the compiled entry as runtime arrays —
+        # baking them into the traced closure would silently replay the
+        # first call's values for every later same-shape kwarg
+        kw_names = tuple(sorted(
+            k for k, v in (kwargs or {}).items()
+            if isinstance(v, (Tensor, np.ndarray))
+        ))
         if entry is None:
             training = layer.training
+            static_kwargs = {
+                k: v for k, v in kwargs.items() if k not in kw_names
+            }
 
             @jax.jit
-            def compiled(params, buffers, key_, *arrays):
+            def compiled(params, buffers, key_, kw_arrays, *arrays):
+                kw = dict(static_kwargs)
+                kw.update(zip(kw_names, kw_arrays))
                 out, new_buf = functional_call(
                     layer, params, buffers,
-                    args=tuple(arrays), kwargs=kwargs,
+                    args=tuple(arrays), kwargs=kw,
                     rng_key=key_, training=training,
                 )
                 return out, new_buf
@@ -137,10 +174,15 @@ class StaticFunction:
             self._cache[key] = entry
         params, buffers = state_dict_arrays(layer)
         arrays = tuple(a._array if isinstance(a, Tensor) else a for a in args)
+        kw_arrays = tuple(
+            kwargs[k]._array if isinstance(kwargs[k], Tensor) else kwargs[k]
+            for k in kw_names
+        )
         from .dy2static import Dy2StaticControlFlowError
 
         try:
-            out, new_buf = entry(params, buffers, rng.next_key(), *arrays)
+            out, new_buf = entry(params, buffers, rng.next_key(), kw_arrays,
+                                 *arrays)
         except Dy2StaticControlFlowError as e:
             self._convert_control_flow(e)  # swaps self._function, clears cache
             return self.__call__(*args, **kwargs)
@@ -152,18 +194,30 @@ class StaticFunction:
     def _call_function(self, *args, **kwargs):
         key = self._key(args, kwargs)
         entry = self._cache.get(key)
+        # Tensor/ndarray kwargs become runtime arrays (see __call__):
+        # shape/dtype keyed, value passed per call
+        kw_names = tuple(sorted(
+            k for k, v in kwargs.items()
+            if isinstance(v, (Tensor, np.ndarray))
+        ))
         if entry is None:
             from ..core import autograd
 
+            static_kwargs = {
+                k: v for k, v in kwargs.items() if k not in kw_names
+            }
+
             @jax.jit
-            def compiled(key_, *arrays):
+            def compiled(key_, kw_arrays, *arrays):
                 tensors = tuple(
                     Tensor._from_op(a) if isinstance(a, jax.Array) else a for a in arrays
                 )
+                kw = dict(static_kwargs)
+                kw.update(zip(kw_names, (Tensor._from_op(a) for a in kw_arrays)))
                 with autograd.trace_mode(), rng.key_scope(key_):
                     # read self._function at trace time: the dy2static
                     # fallback may have swapped in a converted body
-                    out = self._function(*tensors, **kwargs)
+                    out = self._function(*tensors, **kw)
                 return jax.tree_util.tree_map(
                     lambda x: x._array if isinstance(x, Tensor) else x,
                     out,
@@ -173,10 +227,14 @@ class StaticFunction:
             entry = compiled
             self._cache[key] = entry
         arrays = tuple(a._array if isinstance(a, Tensor) else a for a in args)
+        kw_arrays = tuple(
+            kwargs[k]._array if isinstance(kwargs[k], Tensor) else kwargs[k]
+            for k in kw_names
+        )
         from .dy2static import Dy2StaticControlFlowError
 
         try:
-            out = entry(rng.next_key(), *arrays)
+            out = entry(rng.next_key(), kw_arrays, *arrays)
         except Dy2StaticControlFlowError as e:
             self._convert_control_flow(e)
             return self._call_function(*args, **kwargs)

@@ -104,8 +104,22 @@ def _jst_if(cond, true_fn, false_fn, names):
     )
 
 
-def _jst_while(cond_fn, body_fn, init, names):
-    """Runtime dispatch for a converted `while`."""
+def _zero_seed(p):
+    """Zeros with `p`'s shape/dtype, depending on p only abstractly."""
+    import jax.numpy as jnp
+
+    if isinstance(p, Tensor):
+        return Tensor._from_op(jnp.zeros(p._array.shape, p._array.dtype))
+    arr = jnp.asarray(p)
+    return jnp.zeros(arr.shape, arr.dtype)
+
+
+def _jst_while(cond_fn, body_fn, init, names, temps=()):
+    """Runtime dispatch for a converted `while`. `temps` is the subset of
+    `names` the body always assigns before reading, the condition never
+    reads, and nothing outside the loop ever references — their value is
+    unobservable outside one iteration, so an _UNDEF init is legal even on
+    the XLA path (a zero-trip loop can then never leak the seed)."""
     first = cond_fn(*init)
     if not _is_traced(first):
         # CONCRETE condition: plain Python loop — traced values may still
@@ -119,12 +133,23 @@ def _jst_while(cond_fn, body_fn, init, names):
                 state = (state,)
         return state
     for n, v in zip(names, init):
-        if isinstance(v, _Undefined):
+        if isinstance(v, _Undefined) and n not in temps:
             raise Dy2StaticControlFlowError(
                 f"converted `while` on a traced condition: loop variable "
                 f"'{n}' is read before assignment (XLA while carries need "
                 "defined initial values)"
             )
+    if any(isinstance(v, _Undefined) for v in init):
+        # assigned-before-read temporaries still need a concrete carry slot:
+        # one abstract body evaluation yields the shape/dtype every later
+        # iteration produces. Seed ZEROS of that aval — not the probe value
+        # itself — so the probe computation is value-dead and XLA DCEs it
+        # (seeding the probe value would execute the body one extra time)
+        probe = body_fn(*init)
+        init = tuple(
+            _zero_seed(p) if isinstance(v, _Undefined) else v
+            for v, p in zip(init, probe)
+        )
     from ..static import nn as snn
 
     out = snn.while_loop(
@@ -165,6 +190,42 @@ def _assigned_names(stmts):
             seen.add(n)
             out.append(n)
     return out
+
+
+def _assigned_before_read(test, stmts, names):
+    """Subset of `names` the loop body ALWAYS assigns before reading and the
+    condition `test` never reads: body-local temporaries whose pre-loop value
+    is unobservable. Conservative sequential scan of the top-level statement
+    list — only a plain `ast.Assign` whose RHS doesn't read the name counts
+    as 'assigned first'; a name mentioned anywhere inside any other statement
+    kind (if/for/aug-assign/expression...) before that point is disqualified.
+    """
+    cond_reads = {n.id for n in ast.walk(test) if isinstance(n, ast.Name)}
+    temps, disqualified = set(), set(cond_reads)
+    for s in stmts:
+        if isinstance(s, ast.Assign):
+            reads = {
+                n.id
+                for n in ast.walk(s.value)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            }
+            for t in s.targets:
+                reads |= {
+                    n.id
+                    for n in ast.walk(t)
+                    if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                }
+            disqualified |= reads - temps
+            for t in s.targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    if isinstance(e, ast.Name) and e.id not in disqualified:
+                        temps.add(e.id)
+        else:
+            disqualified |= {
+                n.id for n in ast.walk(s) if isinstance(n, ast.Name)
+            } - temps
+    return tuple(n for n in names if n in temps)
 
 
 def _has_flow_escape(stmts):
@@ -240,6 +301,32 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.count = 0
         self.changed = False
+        self._outside_reads = None
+
+    def visit(self, node):
+        # first visit sees the whole tree: record, per original While node,
+        # the names mentioned anywhere OUTSIDE its subtree. A body-local
+        # temporary may only take the zero-seeded XLA carry path if the name
+        # never escapes the loop — a post-loop read of a zero-trip loop's
+        # temporary must keep raising (Python raises NameError there, and a
+        # silently-zero value would be wrong, not just non-strict)
+        if self._outside_reads is None:
+            from collections import Counter
+
+            total = Counter(
+                n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+            )
+            self._outside_reads = {}
+            for w in ast.walk(node):
+                if isinstance(w, ast.While):
+                    inside = Counter(
+                        n.id for n in ast.walk(w) if isinstance(n, ast.Name)
+                    )
+                    self._outside_reads[id(w)] = {
+                        name for name, c in total.items()
+                        if c > inside.get(name, 0)
+                    }
+        return super().visit(node)
 
     def _ret_tuple(self, names):
         return ast.Return(
@@ -313,6 +400,15 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         names_const = ast.Tuple(
             elts=[ast.Constant(n) for n in names], ctx=ast.Load()
         )
+        # conservative default if this While wasn't in the prepassed tree
+        outside = self._outside_reads.get(id(node), set(names))
+        temps = tuple(
+            n for n in _assigned_before_read(node.test, node.body, names)
+            if n not in outside
+        )
+        temps_const = ast.Tuple(
+            elts=[ast.Constant(n) for n in temps], ctx=ast.Load()
+        )
         cond_def = ast.FunctionDef(
             name=cname,
             args=ast.arguments(
@@ -334,7 +430,7 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                     [
                         _name(cname), _name(bname),
                         ast.Tuple(elts=[_name(n) for n in names], ctx=ast.Load()),
-                        names_const,
+                        names_const, temps_const,
                     ],
                 ),
             )

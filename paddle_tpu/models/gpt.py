@@ -76,6 +76,18 @@ class CausalSelfAttention(nn.Layer):
         q = M.squeeze(M.slice(qkv, [2], [0], [1]), 2)
         k = M.squeeze(M.slice(qkv, [2], [1], [2]), 2)
         v = M.squeeze(M.slice(qkv, [2], [2], [3]), 2)
+        if cache is not None and getattr(cache, "is_paged", False):
+            # serving path: K/V live in the global block arena and are
+            # gathered through this sequence's block table (vLLM-style
+            # paged attention; serving/block_pool.py owns the math — and
+            # is the seam a Pallas ragged-attention kernel replaces)
+            from ..serving.block_pool import paged_attention
+
+            o = paged_attention(q._array, k._array, v._array, cache)
+            out = M.reshape(
+                Tensor._from_op(o), [b, s, self.num_heads * self.head_dim]
+            )
+            return self.proj(out), cache
         if cache is not None:
             # incremental decode: fixed-size KV cache so every step compiles
             # once (reference fused_multi_transformer's cache_kv role).
@@ -180,9 +192,14 @@ class GPT(nn.Layer):
         x = self.drop(x)
         if caches is None:
             x = _constraint(x, "dp", "sp", None)
-        new_caches = [] if caches is not None else None
+        paged = caches is not None and getattr(caches, "is_paged", False)
+        new_caches = [] if caches is not None and not paged else None
         for i, blk in enumerate(self.blocks):
-            if caches is not None:
+            if paged:
+                # the shared paged arena threads through every layer; each
+                # block's scatter feeds the next layer's trace
+                x, _ = blk(x, cache=caches.layer(i))
+            elif caches is not None:
                 x, c = blk(x, cache=caches[i])
                 new_caches.append(c)
             else:
@@ -233,7 +250,7 @@ class GPT(nn.Layer):
         if caches is None:
             logits = _constraint(logits, "dp", "sp", "mp")
             return logits
-        return logits, new_caches
+        return logits, (caches if paged else new_caches)
 
     def init_caches(self, batch_size, max_len, dtype=None):
         """Fixed-size per-layer KV caches for incremental decode. dtype

@@ -97,6 +97,45 @@ def summarize(logdir_or_file, device_only=True, top=30):
     return out
 
 
+def interval_union_stats(intervals, to_ms=1.0, top_gaps=10, min_span=1e-12,
+                         name_limit=None):
+    """Merge (start, end, name) intervals into the per-plane schedule-stats
+    dict `schedule_analysis` emits: overlaps union into busy time, the gaps
+    between merged runs become top_gaps. Units are whatever the caller uses
+    (ps for xplane captures, seconds for serving.ServingMetrics); `to_ms`
+    converts them to milliseconds and `min_span` floors the utilization
+    denominator in native units."""
+    iv = sorted(intervals)
+    span_start = iv[0][0]
+    span_end = max(e for _, e, _ in iv)
+    busy = 0
+    gaps = []
+    cur_s, cur_e, last_name = iv[0]
+    for s, e, name in iv[1:]:
+        if s <= cur_e:
+            if e >= cur_e:
+                cur_e, last_name = e, name
+        else:
+            busy += cur_e - cur_s
+            gaps.append((s - cur_e, last_name, name))
+            cur_s, cur_e, last_name = s, e, name
+    busy += cur_e - cur_s
+    span = max(span_end - span_start, min_span)
+    gaps.sort(key=lambda g: -g[0])
+    trim = (lambda n: n[:name_limit]) if name_limit else (lambda n: n)
+    return {
+        "span_ms": span * to_ms,
+        "busy_ms": busy * to_ms,
+        "idle_ms": (span - busy) * to_ms,
+        "utilization": busy / span,
+        "n_ops": len(iv),
+        "top_gaps": [
+            {"gap_ms": g * to_ms, "after_op": trim(a), "before_op": trim(b)}
+            for g, a, b in gaps[:top_gaps]
+        ],
+    }
+
+
 def schedule_analysis(logdir_or_file, top_gaps=10):
     """Executor-schedule statistics (reference
     paddle/fluid/framework/new_executor/executor_statistics.cc: per-run
@@ -112,17 +151,20 @@ def schedule_analysis(logdir_or_file, top_gaps=10):
     planes = []
     for path in _capture_paths(logdir_or_file):
         xs = _load_space(path)
-        planes.extend(xs.planes)
-    device_planes = [p for p in planes if p.name.startswith("/device:")]
+        planes.extend((path, p) for p in xs.planes)
+    device_planes = [(f, p) for f, p in planes if p.name.startswith("/device:")]
     host_fallback = not device_planes
     if host_fallback:
         # CPU-only captures carry no device plane; analyze the host
         # compute threads instead (still a real schedule view)
-        device_planes = [p for p in planes if p.name == "/host:CPU"]
-    # same-named planes from multiple captures (repeated traces, multi-host)
-    # MERGE their intervals rather than overwriting each other
-    by_name = defaultdict(list)
-    for plane in device_planes:
+        device_planes = [(f, p) for f, p in planes if p.name == "/host:CPU"]
+    # same-named planes WITHIN one capture (multi-line traces) merge their
+    # intervals; the same plane across DIFFERENT capture files has an
+    # unrelated clock base, so unioning would report the dead time between
+    # captures as one giant idle gap — key by (path, plane_name) and report
+    # per-capture instead
+    by_key = defaultdict(list)
+    for path, plane in device_planes:
         em = plane.event_metadata
         for line in plane.lines:
             if not host_fallback and line.name not in ("XLA Ops",):
@@ -130,49 +172,41 @@ def schedule_analysis(logdir_or_file, top_gaps=10):
             base = line.timestamp_ns * 1000
             for ev in line.events:
                 s = base + ev.offset_ps
-                by_name[plane.name].append(
+                by_key[(path, plane.name)].append(
                     (s, s + ev.duration_ps, em[ev.metadata_id].name)
                 )
-    for plane_name, intervals in by_name.items():
+    name_counts = defaultdict(int)
+    for _, plane_name in by_key:
+        name_counts[plane_name] += 1
+    for (path, plane_name), intervals in sorted(by_key.items()):
+        if name_counts[plane_name] > 1:  # disambiguate multi-capture runs
+            base = f"{plane_name} [{os.path.basename(path)}]"
+            plane_name, i = base, 2
+            while plane_name in out:
+                plane_name = f"{base}#{i}"
+                i += 1
         if not intervals:
             continue
-        intervals.sort()
-        span_start = intervals[0][0]
-        span_end = max(e for _, e, _ in intervals)
-        # merge overlaps -> busy union + gaps between merged runs
-        busy = 0
-        gaps = []
-        cur_s, cur_e, last_name = intervals[0]
-        for s, e, name in intervals[1:]:
-            if s <= cur_e:
-                cur_e = max(cur_e, e)
-                last_name = name if e >= cur_e else last_name
-            else:
-                busy += cur_e - cur_s
-                gaps.append((s - cur_e, cur_e, last_name, name))
-                cur_s, cur_e, last_name = s, e, name
-        busy += cur_e - cur_s
-        span = max(span_end - span_start, 1)
-        gaps.sort(key=lambda g: -g[0])
-        out[plane_name] = {
-            "span_ms": span / 1e9,
-            "busy_ms": busy / 1e9,
-            "idle_ms": (span - busy) / 1e9,
-            "utilization": busy / span,
-            "n_ops": len(intervals),
-            "top_gaps": [
-                {"gap_ms": g / 1e9, "after_op": a[:80], "before_op": b[:80]}
-                for g, _, a, b in gaps[:top_gaps]
-            ],
-        }
+        out[plane_name] = interval_union_stats(
+            intervals, to_ms=1e-9, top_gaps=top_gaps, min_span=1,
+            name_limit=80,
+        )
     return out
 
 
 def print_schedule_analysis(logdir_or_file, top_gaps=10, file=None):
+    """Also accepts pre-computed per-plane stats (a dict in
+    schedule_analysis's output shape, e.g. serving.ServingMetrics
+    .schedule_view()) and renders them identically."""
     import sys
 
     f = file or sys.stdout
-    for plane, st in schedule_analysis(logdir_or_file, top_gaps).items():
+    stats = (
+        logdir_or_file
+        if isinstance(logdir_or_file, dict)
+        else schedule_analysis(logdir_or_file, top_gaps)
+    )
+    for plane, st in stats.items():
         print(
             f"== {plane}: span {st['span_ms']:.2f} ms, busy {st['busy_ms']:.2f} ms "
             f"({st['utilization']*100:.1f}% util, {st['n_ops']} ops)", file=f

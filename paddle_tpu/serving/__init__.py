@@ -1,0 +1,25 @@
+"""paddle_tpu.serving — continuous-batching LLM engine with a paged KV cache.
+
+The production decode path the ROADMAP north-star asks for: `LLMEngine`
+admits requests mid-flight (FCFS, token-budget batching, decode priority,
+preemption-by-recompute), stores K/V in a block-paged arena with fixed-shape
+scatter/gather (PAPERS.md "Ragged Paged Attention", the TPU-idiomatic paged
+KV design), and compiles exactly one XLA program per (prefill bucket,
+decode) shape regardless of traffic.
+
+Quickstart::
+
+    from paddle_tpu.models.gpt import gpt_tiny
+    from paddle_tpu.serving import LLMEngine
+
+    engine = LLMEngine(gpt_tiny(attn_impl="xla"), block_size=16, max_batch=4)
+    rid = engine.add_request([1, 2, 3], max_new_tokens=8)   # non-blocking
+    for out in engine.stream([4, 5, 6, 7], max_new_tokens=8):
+        print(out.token, out.finished)                       # overlaps rid
+    print(engine.get_request(rid).output_ids)
+    print(engine.metrics.snapshot())
+"""
+from .block_pool import BlockPool, PagedState, paged_attention  # noqa: F401
+from .engine import LLMEngine, StepOutput  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401

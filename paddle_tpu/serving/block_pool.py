@@ -1,0 +1,171 @@
+"""Paged KV cache: a global block arena + per-sequence block tables.
+
+The TPU-native answer to vLLM's PagedAttention (PAPERS.md "Ragged Paged
+Attention"): K/V live in ONE fixed-shape arena
+``[num_blocks, layers, block_size, heads, head_dim]`` and every sequence owns
+a list of block ids. Appending a token is a fixed-shape ``.at[...].set``
+scatter; attention gathers K/V through a padded ``[B, max_blocks]`` block
+table. Because every device op has a static shape, prefill and decode each
+compile exactly once per bucket — no shape ever depends on how many requests
+are in flight or how long they are.
+
+Block 0 is the NULL block: the allocator never hands it out, and every
+padded/inactive scatter is routed there, so out-of-range writes can never
+corrupt a live sequence. Reads through padding gather garbage from block 0,
+which the causal ``kpos <= qpos`` mask then discards.
+
+Host-side bookkeeping (the free list) is plain Python — allocation decisions
+are scheduling, not device work. This module is also the seam a future
+Pallas ragged-attention kernel slots into: `paged_attention` is the only
+function that touches the gathered K/V.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class PagedLayerView:
+    """One layer's window onto a threaded-through paged forward.
+
+    `CausalSelfAttention.forward` receives this as its `cache` argument and
+    calls `paged_attention`, which scatters the new K/V into the arena and
+    attends through the block table. The arena arrays live on the shared
+    `state` so each layer's update feeds the next layer's trace.
+    """
+
+    is_paged = True
+
+    def __init__(self, state, layer):
+        self.state = state
+        self.layer = layer
+
+
+class PagedState:
+    """Traced arena + step metadata threaded through GPT.forward.
+
+    Arrays (all fixed-shape, jnp):
+      k, v          [num_blocks, layers, block_size, heads, head_dim]
+      block_tables  [B, max_blocks] int32 (padded with 0 = null block)
+      slots         [B, S] int32 — destination block id of each new token
+      offs          [B, S] int32 — destination offset inside that block
+      qpos          [B, S] int32 — absolute position of each query token
+    """
+
+    is_paged = True
+
+    def __init__(self, k, v, block_tables, slots, offs, qpos):
+        self.k = k
+        self.v = v
+        self.block_tables = block_tables
+        self.slots = slots
+        self.offs = offs
+        self.qpos = qpos
+
+    def layer(self, i):
+        return PagedLayerView(self, i)
+
+
+def paged_attention(q, k_new, v_new, view, scale=None):
+    """Append `k_new`/`v_new` into the arena and attend `q` through the
+    block table. All shapes static; returns [B, S, heads, head_dim].
+
+    q, k_new, v_new: [B, S, heads, head_dim] jnp arrays.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    st, layer = view.state, view.layer
+    B, S, H, D = q.shape
+    # scatter the step's K/V rows into their (block, offset) homes; padded
+    # and inactive rows carry slot 0 (the null block)
+    st.k = st.k.at[st.slots, layer, st.offs].set(k_new.astype(st.k.dtype))
+    st.v = st.v.at[st.slots, layer, st.offs].set(v_new.astype(st.v.dtype))
+    # gather this layer's K/V for every sequence: [B, nb, bs, H, D]
+    k_seq = st.k[st.block_tables, layer]
+    v_seq = st.v[st.block_tables, layer]
+    nb, bs = k_seq.shape[1], k_seq.shape[2]
+    L = nb * bs
+    k_seq = k_seq.reshape(B, L, H, D)
+    v_seq = v_seq.reshape(B, L, H, D)
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    s_l = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_seq, preferred_element_type=jnp.float32
+    ) * scale
+    kpos = jnp.arange(L)[None, None, None, :]
+    qpos = st.qpos[:, None, :, None]  # [B, 1, S, 1]
+    s_l = jnp.where(kpos <= qpos, s_l, -1e30)
+    p = jax.nn.softmax(s_l, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_seq.dtype), v_seq)
+
+
+class BlockPool:
+    """Host-side allocator over the device arena.
+
+    Owns the K/V arena arrays plus the free list. `allocate`/`free` are pure
+    bookkeeping; `positions_to_slots` maps token positions to (block, offset)
+    scatter targets for a sequence's block list.
+    """
+
+    def __init__(self, num_blocks, num_layers, block_size, num_heads,
+                 head_dim, dtype=None):
+        import jax.numpy as jnp
+
+        if num_blocks < 2:
+            raise ValueError("BlockPool needs >= 2 blocks (block 0 is null)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        shape = (self.num_blocks, num_layers, self.block_size, num_heads,
+                 head_dim)
+        dt = dtype or jnp.float32
+        self.k = jnp.zeros(shape, dt)
+        self.v = jnp.zeros(shape, dt)
+        # block 0 reserved as the null/scratch block
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    def blocks_for(self, num_tokens):
+        """How many blocks a sequence of `num_tokens` tokens needs."""
+        return max(1, -(-int(num_tokens) // self.block_size))
+
+    def allocate(self, n):
+        """Pop `n` blocks off the free list, or None if not enough."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks):
+        for b in blocks:
+            if b == 0:
+                raise ValueError("cannot free the null block")
+            self._free.append(b)
+
+    def copy_blocks(self, src, dst):
+        """Device-side block copy (copy-on-preempt / future forked decode):
+        arena rows `src` are duplicated into rows `dst` in one scatter."""
+        src = np.asarray(src, np.int32)
+        dst = np.asarray(dst, np.int32)
+        self.k = self.k.at[dst].set(self.k[src])
+        self.v = self.v.at[dst].set(self.v[src])
+
+    def table_for(self, blocks, max_blocks):
+        """Padded [max_blocks] int32 block table (0-padded) for a sequence."""
+        t = np.zeros(max_blocks, np.int32)
+        t[: len(blocks)] = blocks
+        return t
+
+    def positions_to_slots(self, blocks, start, count, width):
+        """(slots[width], offs[width]) scatter targets for token positions
+        [start, start+count); positions beyond `count` go to the null
+        block. `width` is the padded (bucketed) length."""
+        pos = np.arange(width)
+        idx = (start + pos) // self.block_size
+        offs = ((start + pos) % self.block_size).astype(np.int32)
+        btab = np.asarray(blocks, np.int64)
+        valid = (pos < count) & (idx < len(btab))
+        slots = np.where(valid, btab[np.minimum(idx, len(btab) - 1)], 0)
+        return slots.astype(np.int32), np.where(valid, offs, 0).astype(np.int32)

@@ -1,0 +1,307 @@
+"""LLMEngine: continuous-batching generation over the paged KV cache.
+
+`add_request` enqueues, `step` runs ONE device step (a prefill or a decode
+picked by the scheduler), `stream` yields a request's tokens as they land.
+Both device paths go through a single jitted step function compiled per
+(batch, seq) shape: prefill runs at ``(1, prompt_bucket)`` — prompt lengths
+pad up to `inference.Predictor._pick_bucket` buckets — and decode at
+``(max_batch, 1)``, so a serving process compiles exactly
+``len(used buckets) + 1`` programs no matter how requests arrive. The
+`jit_traces` counter in `metrics` increments inside the traced body (trace
+time only) and is the test's recompile alarm.
+
+Decode outputs are bit-identical to `GPT.generate`'s greedy path: the same
+attention math runs through the block-table gather instead of a contiguous
+buffer (models/gpt.py `CausalSelfAttention` + serving/block_pool.py).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+
+from ..core.functional import functional_call, state_dict_arrays
+from ..inference import Predictor
+from .block_pool import BlockPool, PagedState
+from .metrics import ServingMetrics
+from .scheduler import Request, Scheduler
+
+StepOutput = namedtuple("StepOutput", ["request_id", "token", "finished"])
+
+
+def _default_buckets(max_seq_len):
+    out = []
+    b = 16
+    while b < max_seq_len:
+        out.append(b)
+        b *= 2
+    out.append(max_seq_len)
+    return tuple(sorted(set(out)))
+
+
+class LLMEngine:
+    def __init__(self, model, block_size=16, num_blocks=None, max_batch=4,
+                 prefill_buckets=None, max_seq_len=None, token_budget=None,
+                 prefill_interval=1, seed=0):
+        import jax
+
+        model.eval()
+        self.model = model
+        cfg = model.cfg
+        self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
+        if self.max_seq_len > cfg.max_seq_len:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
+        self.block_size = int(block_size)
+        self.max_blocks = -(-self.max_seq_len // self.block_size)
+        self.max_batch = int(max_batch)
+        if num_blocks is None:
+            # enough for a full decode batch of max-length sequences (+null)
+            num_blocks = self.max_batch * self.max_blocks + 1
+        # sorted is load-bearing: _pick_bucket bisects the bucket list
+        self.prefill_buckets = tuple(sorted(set(
+            b for b in (prefill_buckets or _default_buckets(self.max_seq_len))
+            if b <= self.max_seq_len
+        )))
+        if not self.prefill_buckets or max(self.prefill_buckets) < self.max_seq_len:
+            self.prefill_buckets = tuple(
+                sorted(set(self.prefill_buckets) | {self.max_seq_len})
+            )
+        self.metrics = ServingMetrics()
+        self._params, self._buffers = state_dict_arrays(model)
+        dt = model.wte.weight._array.dtype
+        self.pool = BlockPool(
+            num_blocks, cfg.num_layers, self.block_size, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads, dtype=dt,
+        )
+        self.scheduler = Scheduler(
+            self.pool, max_batch=self.max_batch,
+            token_budget=int(token_budget or max(self.prefill_buckets)),
+            prefill_interval=prefill_interval, metrics=self.metrics,
+        )
+        self._requests = {}
+        self._step_fns = {}
+        self._key = jax.random.PRNGKey(seed)
+
+    # -- request lifecycle -------------------------------------------------
+
+    def add_request(self, prompt_ids, max_new_tokens=16, temperature=0.0,
+                    eos_token_id=None, request_id=None):
+        """Enqueue one generation request; returns its id. Admission happens
+        inside a later `step()` (continuous batching: requests join the
+        running batch between decode steps, never blocking them)."""
+        prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        req = Request(prompt_ids, max_new_tokens=max_new_tokens,
+                      temperature=temperature, eos_token_id=eos_token_id,
+                      request_id=request_id)
+        if req.num_tokens + req.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"request {req.request_id}: prompt {req.num_tokens} + "
+                f"{req.max_new_tokens} new tokens exceeds max_seq_len "
+                f"{self.max_seq_len}"
+            )
+        # a preempted request re-prefills prompt + generated-so-far (up to
+        # max_new-1 tokens), so the WORST-CASE recompute bucket must fit the
+        # token budget or a preemption could wedge the FCFS queue mid-serve
+        worst = self._bucket(req.num_tokens + req.max_new_tokens - 1)
+        if worst > self.scheduler.token_budget:
+            raise ValueError(
+                f"request {req.request_id}: worst-case recompute prefill "
+                f"bucket {worst} exceeds token budget "
+                f"{self.scheduler.token_budget}; raise token_budget or "
+                "shorten the request"
+            )
+        if req.request_id in self._requests:
+            raise ValueError(f"duplicate request id {req.request_id}")
+        self._requests[req.request_id] = req
+        self.scheduler.add(req)
+        self.metrics.inc("requests_added")
+        return req.request_id
+
+    def has_unfinished(self):
+        return self.scheduler.has_unfinished()
+
+    def get_request(self, request_id):
+        return self._requests[request_id]
+
+    def release(self, request_id):
+        """Drop a finished request's host-side record (prompt + outputs).
+        A long-running engine must release requests after reading their
+        outputs or `_requests` grows without bound; `generate`/`stream`
+        release automatically."""
+        req = self._requests.pop(request_id)
+        if not req.finished:
+            self._requests[request_id] = req
+            raise ValueError(
+                f"request {request_id} is still {req.state}; release only "
+                "finished requests"
+            )
+
+    # -- compiled step -----------------------------------------------------
+
+    def _bucket(self, n):
+        return Predictor._pick_bucket(n, list(self.prefill_buckets),
+                                      "prompt length")
+
+    def _get_step_fn(self, B, S):
+        """One jitted step program per (batch, seq) shape: prefill at
+        (1, bucket), decode at (max_batch, 1)."""
+        if (B, S) in self._step_fns:
+            return self._step_fns[(B, S)]
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        metrics = self.metrics
+
+        def step(params, buffers, k_arena, v_arena, ids, block_tables,
+                 slots, offs, qpos, last_idx, temps, key):
+            # runs at TRACE time only — the test's recompile alarm
+            metrics.inc("jit_traces")
+            state = PagedState(k_arena, v_arena, block_tables, slots, offs,
+                               qpos)
+            (logits, _), _ = functional_call(
+                model, params, buffers, args=(ids,),
+                kwargs={"caches": state, "pos_offset": qpos[:, :1]},
+                training=False,
+            )
+            lg = logits[jnp.arange(ids.shape[0]), last_idx].astype(jnp.float32)
+            greedy = jnp.argmax(lg, axis=-1)
+            scaled = lg / jnp.maximum(temps[:, None], 1e-6)
+            sampled = jax.random.categorical(key, scaled, axis=-1)
+            tok = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+            return tok, state.k, state.v
+
+        fn = jax.jit(step, donate_argnums=(2, 3))
+        self._step_fns[(B, S)] = fn
+        return fn
+
+    def _run_step(self, fn, ids, tables, slots, offs, qpos, last_idx, temps):
+        import jax
+        import jax.numpy as jnp
+
+        self._key, sub = jax.random.split(self._key)
+        tok, self.pool.k, self.pool.v = fn(
+            self._params, self._buffers, self.pool.k, self.pool.v,
+            jnp.asarray(ids), jnp.asarray(tables), jnp.asarray(slots),
+            jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(last_idx),
+            jnp.asarray(temps), sub,
+        )
+        return np.asarray(tok)  # host sync: the step is done when this lands
+
+    # -- one engine step ---------------------------------------------------
+
+    def step(self):
+        """Run one prefill or decode step; returns [StepOutput] for every
+        request that produced a token this step."""
+        kind, reqs = self.scheduler.schedule(self._bucket)
+        if kind == "idle":
+            return []
+        with self.metrics.timed(f"{kind}_step"):
+            if kind == "prefill":
+                outs = self._step_prefill(reqs[0])
+            else:
+                outs = self._step_decode(reqs)
+        self.metrics.inc(f"{kind}_steps")
+        self.metrics.set_gauge(
+            "tokens_in_flight",
+            sum(r.num_tokens for r in self.scheduler.running),
+        )
+        usable = self.pool.num_blocks - 1
+        self.metrics.set_gauge(
+            "block_utilization", (usable - self.pool.num_free) / usable
+        )
+        self.metrics.set_gauge("num_running", len(self.scheduler.running))
+        self.metrics.set_gauge("num_waiting", len(self.scheduler.waiting))
+        return outs
+
+    def _step_prefill(self, req):
+        total = req.num_tokens
+        S = self._bucket(total)
+        ids = np.zeros((1, S), np.int32)
+        ids[0, :total] = req.all_ids
+        slots, offs = self.pool.positions_to_slots(req.blocks, 0, total, S)
+        qpos = np.arange(S, dtype=np.int32)[None]
+        tables = self.pool.table_for(req.blocks, self.max_blocks)[None]
+        fn = self._get_step_fn(1, S)
+        tok = self._run_step(
+            fn, ids, tables, slots[None], offs[None], qpos,
+            np.asarray([total - 1], np.int32),
+            np.asarray([req.temperature], np.float32),
+        )
+        req.num_cached = total
+        return [self._emit(req, int(tok[0]))]
+
+    def _step_decode(self, reqs):
+        B = self.max_batch
+        ids = np.zeros((B, 1), np.int32)
+        qpos = np.zeros((B, 1), np.int32)
+        slots = np.zeros((B, 1), np.int32)
+        offs = np.zeros((B, 1), np.int32)
+        tables = np.zeros((B, self.max_blocks), np.int32)
+        temps = np.zeros(B, np.float32)
+        for i, req in enumerate(reqs):
+            ids[i, 0] = req.last_token
+            qpos[i, 0] = req.num_cached
+            slots[i, 0] = req.blocks[req.num_cached // self.block_size]
+            offs[i, 0] = req.num_cached % self.block_size
+            tables[i] = self.pool.table_for(req.blocks, self.max_blocks)
+            temps[i] = req.temperature
+        fn = self._get_step_fn(B, 1)
+        tok = self._run_step(
+            fn, ids, tables, slots, offs, qpos,
+            np.zeros(B, np.int32), temps,
+        )
+        outs = []
+        for i, req in enumerate(reqs):
+            req.num_cached += 1
+            outs.append(self._emit(req, int(tok[i])))
+        return outs
+
+    def _emit(self, req, token):
+        req.output_ids.append(token)
+        self.metrics.inc("generated_tokens")
+        done = (
+            len(req.output_ids) >= req.max_new_tokens
+            or (req.eos_token_id is not None and token == req.eos_token_id)
+        )
+        if done:
+            self.scheduler.finish(req)
+            self.metrics.inc("requests_finished")
+        return StepOutput(req.request_id, token, done)
+
+    # -- conveniences ------------------------------------------------------
+
+    def stream(self, prompt_ids, **kwargs):
+        """Add one request and yield its StepOutputs as tokens land; other
+        in-flight requests keep decoding in the same steps."""
+        rid = self.add_request(prompt_ids, **kwargs)
+        req = self._requests[rid]
+        emitted = 0
+        while True:
+            if emitted < len(req.output_ids):
+                tok = req.output_ids[emitted]
+                emitted += 1
+                last = req.finished and emitted == len(req.output_ids)
+                yield StepOutput(rid, tok, last)
+                if last:
+                    self.release(rid)
+                    return
+                continue
+            if req.finished:
+                self.release(rid)
+                return
+            self.step()
+
+    def generate(self, prompts, **kwargs):
+        """Batch convenience: add every prompt, run to completion, return
+        each request's generated token list (in input order)."""
+        rids = [self.add_request(p, **kwargs) for p in prompts]
+        while self.has_unfinished():
+            self.step()
+        outs = [list(self._requests[r].output_ids) for r in rids]
+        for r in rids:
+            self.release(r)
+        return outs

@@ -1,0 +1,111 @@
+"""Serving metrics: counters, gauges, and step-latency intervals.
+
+Kept deliberately framework-free (plain dicts/floats) so three consumers can
+read them without adapters:
+
+- `snapshot()`  — flat JSON-able dict for `bench.py` and log shipping;
+- `schedule_view()` — the SAME dict shape `profiler.xplane.schedule_analysis`
+  emits per plane (span/busy/idle/utilization/top_gaps), so
+  `xplane.print_schedule_analysis` renders engine schedules exactly like
+  device captures;
+- direct attribute access for tests (`metrics.counters["preemptions"]`).
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+
+class ServingMetrics:
+    def __init__(self, max_intervals=4096):
+        self.counters = defaultdict(float)
+        self.gauges = {}
+        # name -> running stats + a bounded recent window for percentiles
+        # (a long-running engine must not grow per-step history without
+        # bound — same reason _intervals is capped)
+        self._durations = defaultdict(
+            lambda: {"count": 0, "total": 0.0, "max": 0.0, "recent": []}
+        )
+        self._intervals = []                  # (start_s, end_s, name)
+        self._max_intervals = int(max_intervals)
+
+    def inc(self, name, value=1.0):
+        self.counters[name] += value
+
+    def set_gauge(self, name, value):
+        self.gauges[name] = value
+
+    def observe(self, name, seconds, start=None):
+        """Record one timed operation (a prefill or decode step)."""
+        d = self._durations[name]
+        s = float(seconds)
+        d["count"] += 1
+        d["total"] += s
+        d["max"] = max(d["max"], s)
+        d["recent"].append(s)
+        if len(d["recent"]) > self._max_intervals:
+            del d["recent"][: -self._max_intervals]
+        end = time.monotonic() if start is None else start + seconds
+        self._intervals.append((end - seconds, end, name))
+        if len(self._intervals) > self._max_intervals:
+            del self._intervals[: -self._max_intervals]
+
+    def reset_schedule(self):
+        """Drop recorded step timings (e.g. after a warmup phase that
+        included jit traces) so schedule_view/latency_summary describe only
+        the steps that follow. Counters and gauges are kept."""
+        self._durations.clear()
+        self._intervals.clear()
+
+    def timed(self, name):
+        """Context manager: `with metrics.timed("decode_step"): ...`"""
+        return _Timer(self, name)
+
+    def latency_summary(self):
+        out = {}
+        for name, d in self._durations.items():
+            recent = sorted(d["recent"])
+            out[name] = {
+                "count": d["count"],
+                "total_ms": d["total"] * 1e3,
+                "mean_ms": d["total"] / d["count"] * 1e3,
+                "p50_ms": recent[len(recent) // 2] * 1e3,
+                "max_ms": d["max"] * 1e3,
+            }
+        return out
+
+    def snapshot(self):
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "latency": self.latency_summary(),
+        }
+
+    def schedule_view(self, top_gaps=10, plane_name="serving-engine"):
+        """Engine-schedule statistics in schedule_analysis's per-plane shape:
+        {plane: {span_ms, busy_ms, idle_ms, utilization, n_ops, top_gaps}}.
+        Busy = union of recorded step intervals; gaps = host time between
+        device steps (scheduling + sampling sync overhead)."""
+        from ..profiler.xplane import interval_union_stats
+
+        if not self._intervals:
+            return {}
+        return {
+            plane_name: interval_union_stats(
+                self._intervals, to_ms=1e3, top_gaps=top_gaps
+            )
+        }
+
+
+class _Timer:
+    def __init__(self, metrics, name):
+        self._m = metrics
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._m.observe(self._name, time.monotonic() - self._t0)
+        return False

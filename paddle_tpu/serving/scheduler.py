@@ -1,0 +1,193 @@
+"""Continuous-batching scheduler: FCFS admission, decode-priority,
+preemption-by-recompute.
+
+The policy half of the serving engine (the paged arena in block_pool.py is
+the memory half). Each `schedule()` call picks ONE kind of device step:
+
+- ``("decode", running)``  — one token for every running sequence. Decode has
+  priority: as long as sequences are running, their latency is protected and
+  prefill admission only happens every `prefill_interval` decode steps.
+- ``("prefill", [req])``   — admit the FCFS head of the waiting queue when
+  the decode batch has a free lane, the bucketed prompt fits the token
+  budget, and the pool can hold its KV.
+- ``("idle", [])``         — nothing to do.
+
+When the pool runs dry mid-decode the LAST-admitted running sequence is
+preempted by recompute (vLLM's recompute policy): its blocks are freed, its
+prompt+generated tokens re-queue at the FRONT of the waiting queue, and a
+later prefill rebuilds the KV in one pass. FCFS order is preserved and no
+sequence is ever lost.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+
+_rid_counter = itertools.count()
+
+WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+
+class Request:
+    """One generation request and its host-side serving state."""
+
+    def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
+                 eos_token_id=None, request_id=None):
+        self.request_id = (
+            request_id if request_id is not None else next(_rid_counter)
+        )
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        if not self.prompt_ids:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.output_ids = []
+        self.state = WAITING
+        self.blocks = []      # arena block ids owned by this sequence
+        self.num_cached = 0   # tokens whose K/V currently live in the arena
+        self.preemptions = 0
+
+    @property
+    def all_ids(self):
+        """Prompt + generated tokens — what a recompute prefill replays."""
+        return self.prompt_ids + self.output_ids
+
+    @property
+    def num_tokens(self):
+        return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def finished(self):
+        return self.state == FINISHED
+
+    @property
+    def last_token(self):
+        return self.output_ids[-1] if self.output_ids else self.prompt_ids[-1]
+
+    def remaining_new_tokens(self):
+        return self.max_new_tokens - len(self.output_ids)
+
+
+class Scheduler:
+    def __init__(self, pool, max_batch=8, token_budget=2048,
+                 prefill_interval=4, metrics=None):
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        self.token_budget = int(token_budget)
+        self.prefill_interval = max(1, int(prefill_interval))
+        self.metrics = metrics
+        self.waiting = deque()
+        self.running = []
+        self._decodes_since_prefill = 0
+
+    # -- queue ops ---------------------------------------------------------
+
+    def add(self, req):
+        self.waiting.append(req)
+
+    def has_unfinished(self):
+        return bool(self.waiting or self.running)
+
+    def finish(self, req):
+        req.state = FINISHED
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        req.num_cached = 0
+        if req in self.running:
+            self.running.remove(req)
+
+    def _preempt(self, req):
+        """Preempt-by-recompute: drop the KV, re-queue at the front."""
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        req.num_cached = 0
+        req.state = WAITING
+        req.preemptions += 1
+        if req in self.running:
+            self.running.remove(req)
+        self.waiting.appendleft(req)
+        if self.metrics is not None:
+            self.metrics.inc("preemptions")
+
+    # -- policy ------------------------------------------------------------
+
+    def _try_admit(self, prefill_bucket):
+        """Admit the FCFS head if a decode lane, the token budget, and the
+        pool all have room. Returns the admitted request or None."""
+        if not self.waiting or len(self.running) >= self.max_batch:
+            return None
+        req = self.waiting[0]
+        bucket = prefill_bucket(req.num_tokens)
+        if bucket > self.token_budget:
+            if not self.running:
+                raise ValueError(
+                    f"request {req.request_id}: prefill bucket {bucket} "
+                    f"exceeds token budget {self.token_budget}"
+                )
+            return None
+        need = self.pool.blocks_for(req.num_tokens)
+        blocks = self.pool.allocate(need)
+        if blocks is None:
+            # admission never preempts (that would churn): wait for decode
+            # to free blocks — unless nothing is running, in which case the
+            # request can never fit
+            if not self.running:
+                raise ValueError(
+                    f"request {req.request_id}: needs {need} KV blocks but "
+                    f"the pool only has {self.pool.num_free} free with no "
+                    "sequences running — raise num_blocks or shorten the "
+                    "request"
+                )
+            return None
+        self.waiting.popleft()
+        req.blocks = blocks
+        req.state = RUNNING
+        self.running.append(req)
+        return req
+
+    def _grow_for_decode(self):
+        """Every running sequence is about to append one token at position
+        `num_cached`; allocate the next block where that crosses a block
+        boundary, preempting from the back of `running` when the pool is
+        dry. Returns the sequences that still hold their blocks."""
+        for req in list(self.running):
+            if req not in self.running:
+                continue  # preempted by an earlier victim search
+            need = self.pool.blocks_for(req.num_cached + 1)
+            while len(req.blocks) < need:
+                got = self.pool.allocate(1)
+                if got is not None:
+                    req.blocks.extend(got)
+                    continue
+                victim = self.running[-1]
+                self._preempt(victim)
+                if victim is req:
+                    break
+        return list(self.running)
+
+    def schedule(self, prefill_bucket):
+        """One scheduling decision: ("prefill", [req]) | ("decode", reqs) |
+        ("idle", []). `prefill_bucket(n)` maps a prompt length to its padded
+        bucket (the engine passes inference's _pick_bucket)."""
+        want_prefill = self.waiting and (
+            not self.running
+            or self._decodes_since_prefill >= self.prefill_interval
+        )
+        if want_prefill:
+            req = self._try_admit(prefill_bucket)
+            if req is not None:
+                self._decodes_since_prefill = 0
+                return "prefill", [req]
+        if self.running:
+            batch = self._grow_for_decode()
+            if batch:
+                self._decodes_since_prefill += 1
+                return "decode", batch
+            # everything got preempted back to waiting; prefill next turn
+            return self.schedule(prefill_bucket)
+        return "idle", []

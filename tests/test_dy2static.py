@@ -84,6 +84,57 @@ def test_converted_while_end_to_end():
     np.testing.assert_allclose(out, np.ones(4) * 32)
 
 
+def test_converted_while_with_body_local_temporary():
+    """A traced-cond `while` whose body uses a temporary assigned before
+    read must still convert: the temp's _UNDEF init is unobservable, so it
+    can't be rejected by the XLA carry check (dy2static review fix)."""
+
+    def f(x):
+        s = x
+        while s.sum() < 100.0:
+            doubled = s * 2.0  # body-local: assigned before read
+            s = doubled
+        return s
+
+    sf = jit.to_static(f)
+    out = np.asarray(sf(paddle.to_tensor(np.ones(4, np.float32)))._array)
+    np.testing.assert_allclose(out, np.ones(4) * 32)
+
+
+def test_converted_while_temporary_read_after_loop_stays_loud():
+    """A body 'temporary' that is read AFTER the loop is not a temporary:
+    a zero-trip loop would leak the zero-seeded carry where plain Python
+    raises NameError, so the traced path must keep the loud conversion
+    error instead of silently returning zeros."""
+
+    def f(x):
+        s = x
+        while s.sum() < 1.0:  # False on entry for ones(4): zero trips
+            d = s * 2.0
+            s = d
+        return d  # noqa: F821 — undefined when the loop never ran
+
+    sf = jit.to_static(f)
+    with pytest.raises(TypeError, match="read before assignment|undefined"):
+        sf(paddle.to_tensor(np.ones(4, np.float32)))
+
+
+def test_converted_while_still_rejects_read_before_assignment():
+    """A loop variable genuinely read before assignment keeps the
+    actionable error on the traced path."""
+
+    def f(x):
+        s = x
+        while s.sum() < 100.0:
+            s = s + acc  # noqa: F821 — read before ANY assignment
+            acc = s * 0.0
+        return s
+
+    sf = jit.to_static(f)
+    with pytest.raises(TypeError, match="read before assignment|undefined"):
+        sf(paddle.to_tensor(np.ones(4, np.float32)))
+
+
 def test_concrete_condition_keeps_python_semantics():
     """The converted dispatch runs plain Python when the condition is
     concrete (outside tracing)."""
@@ -181,6 +232,80 @@ def test_to_static_kwargs_in_cache_key():
     b = np.asarray(sf(x, scale=5.0)._array)
     np.testing.assert_allclose(a, 2.0 * np.ones(3))
     np.testing.assert_allclose(b, 5.0 * np.ones(3))
+
+
+def test_to_static_tensor_kwargs_are_runtime_values():
+    """Two same-shape Tensor kwargs hit the same compiled entry but must
+    use their OWN values (ADVICE medium: the kwarg's concrete array was
+    baked into the traced closure, silently replaying the first mask)."""
+
+    def f(x, mask=None):
+        return x * mask
+
+    sf = jit.to_static(f)
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    m1 = paddle.to_tensor(np.array([1, 0, 1, 0], np.float32))
+    m2 = paddle.to_tensor(np.array([0, 1, 0, 1], np.float32))  # same shape
+    np.testing.assert_allclose(np.asarray(sf(x, mask=m1)._array), m1.numpy())
+    np.testing.assert_allclose(np.asarray(sf(x, mask=m2)._array), m2.numpy())
+    assert len(sf._cache) == 1  # same program, different runtime kwarg
+
+
+def test_to_static_layer_tensor_kwargs_are_runtime_values():
+    """Same regression through the Layer path (functional_call kwargs)."""
+
+    class Masked(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x, mask=None):
+            return self.fc(x) * mask
+
+    paddle.seed(0)
+    net = Masked()
+    sfnet = jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    m1 = paddle.to_tensor(np.ones((2, 4), np.float32))
+    m2 = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    out1 = np.asarray(sfnet(x, mask=m1)._array)
+    out2 = np.asarray(sfnet(x, mask=m2)._array)
+    ref = np.asarray(net.fc(x)._array)
+    np.testing.assert_allclose(out1, ref, rtol=1e-6)
+    np.testing.assert_allclose(out2, np.zeros((2, 4)), rtol=1e-6)
+
+
+def test_to_static_ndarray_kwargs_are_runtime_values():
+    """Raw np.ndarray kwargs take the Tensor-kwarg path: keyed by
+    (shape, dtype), value passed at runtime — repr() truncates large arrays,
+    so keying by repr collided different arrays onto one baked constant."""
+
+    def f(x, mask=None):
+        return x * mask
+
+    sf = jit.to_static(f)
+    x = paddle.to_tensor(np.ones(2000, np.float32))
+    m1 = np.ones(2000, np.float32)
+    m2 = np.ones(2000, np.float32)
+    m2[1000] = 5.0  # identical truncated repr, different value
+    np.testing.assert_allclose(np.asarray(sf(x, mask=m1)._array), m1)
+    np.testing.assert_allclose(np.asarray(sf(x, mask=m2)._array), m2)
+    assert len(sf._cache) == 1
+
+
+def test_to_static_rejects_tensor_in_container_kwarg():
+    """A Tensor inside a container kwarg would be baked as a constant (and
+    numpy's truncated repr would collide cache keys for large arrays) —
+    rejected loudly instead."""
+
+    def f(x, masks=None):
+        return x * masks[0]
+
+    sf = jit.to_static(f)
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    m = paddle.to_tensor(np.ones(4, np.float32))
+    with pytest.raises(TypeError, match="container"):
+        sf(x, masks=[m])
 
 
 def test_converted_function_with_concrete_inner_while():

@@ -79,6 +79,53 @@ def test_schedule_analysis_math():
     assert s["top_gaps"][0]["before_op"] == "allreduce.3"
 
 
+def _device_capture(offset_events, clock_base_ns=0):
+    """Minimal one-plane capture with [offset_ms, duration_ms] events."""
+    from paddle_tpu.profiler._xplane import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+    plane.event_metadata[1].id = 1
+    plane.event_metadata[1].name = "op.1"
+    line = plane.lines.add()
+    line.name = "XLA Ops"
+    line.timestamp_ns = clock_base_ns
+    for off_ms, dur_ms in offset_events:
+        ev = line.events.add()
+        ev.metadata_id = 1
+        ev.offset_ps = int(off_ms * 1e9)
+        ev.duration_ps = int(dur_ms * 1e9)
+    return xs
+
+
+def test_schedule_analysis_reports_per_capture():
+    """Two capture files with the SAME plane name but unrelated clock bases
+    must be reported per-capture, NOT unioned into one timeline whose
+    inter-capture dead time shows up as a giant idle gap."""
+    from paddle_tpu.profiler import xplane
+
+    with tempfile.TemporaryDirectory() as td:
+        # capture A: 10ms busy starting at t=0; capture B: 10ms busy whose
+        # clock base is 100 SECONDS later (a separate trace session)
+        for name, xs in (
+            ("a.xplane.pb", _device_capture([(0, 10)], clock_base_ns=0)),
+            ("b.xplane.pb", _device_capture([(0, 10)],
+                                            clock_base_ns=int(100e9))),
+        ):
+            with open(os.path.join(td, name), "wb") as f:
+                f.write(xs.SerializeToString())
+        st = xplane.schedule_analysis(td)
+        assert len(st) == 2, st.keys()  # one entry per capture
+        for s in st.values():
+            # each capture is 100% busy over its own 10ms span — the old
+            # union view reported ~100s span with a ~100s idle gap
+            assert s["span_ms"] == 10.0
+            assert s["busy_ms"] == 10.0
+            assert s["idle_ms"] == 0.0
+            assert not s["top_gaps"]
+
+
 def test_schedule_analysis_on_real_cpu_capture():
     """CPU captures have no device plane: the host fallback still yields a
     utilization view."""
