@@ -194,9 +194,11 @@ def bench_gpt(on_tpu, errors, deadline_s):
 
 def bench_gpt_serve(on_tpu, errors, deadline_s):
     """Continuous-batching decode throughput: overlapping requests with
-    mixed prompt lengths through LLMEngine's paged KV cache. Reports
-    generated tokens/sec across the whole serve (prefill + decode), plus
-    the engine's own schedule utilization."""
+    mixed prompt lengths through LLMEngine's paged KV cache and chunked
+    prefill. Reports generated tokens/sec across the whole serve, TTFT
+    percentiles, the mixed/decode step split, and the jit trace count —
+    the whole serve compiles exactly two programs (mixed + decode), which
+    `jit_traces_measured == 0` makes checkable from the BENCH json."""
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPT, GPTConfig
     from paddle_tpu.serving import LLMEngine
@@ -216,18 +218,18 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     engine = LLMEngine(model, block_size=16, max_batch=max_batch)
     rs = np.random.RandomState(0)
 
-    # warmup: compiles the decode program + the prefill buckets the measured
-    # wave uses, so the measured number is steady-state serving throughput
-    # (max_new_tokens=2 forces at least one decode step per warmup request —
-    # a 1-token request finishes at prefill and never compiles decode)
+    # warmup: one multi-chunk request compiles BOTH programs — the mixed
+    # prefill+decode step and the pure-decode step (max_new_tokens=2 forces
+    # at least one decode step; a 1-token request finishes at its last
+    # prefill chunk and never compiles decode)
     lens = (24, 60, 100, 40, 80, 30, 120, 50)[: 2 * max_batch]
-    for ln in sorted({engine._bucket(n) for n in lens}):
-        list(engine.generate(
-            [rs.randint(0, cfg.vocab_size, (ln - 1,))], max_new_tokens=2
-        ))
+    list(engine.generate(
+        [rs.randint(0, cfg.vocab_size, (max(lens),))], max_new_tokens=2
+    ))
     warm_tokens = engine.metrics.counters["generated_tokens"]
+    warm_traces = engine.metrics.counters["jit_traces"]
     # drop warmup step timings (they include the jit traces/compiles) so the
-    # reported engine_utilization describes the measured wave only
+    # reported engine_utilization/TTFT describe the measured wave only
     engine.metrics.reset_schedule()
 
     max_new = 64 if on_tpu else 16
@@ -247,13 +249,26 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
         return None
     view = engine.metrics.schedule_view()
     sched = view.get("serving-engine", {})
+    lat = engine.metrics.latency_summary()
+    ttft = lat.get("ttft", {})
+    counters = engine.metrics.counters
     return {
         "value": round(generated / dt, 1),
         "requests": len(lens),
         "max_batch": max_batch,
         "max_new_tokens": max_new,
-        "preemptions": int(engine.metrics.counters["preemptions"]),
-        "jit_traces": int(engine.metrics.counters["jit_traces"]),
+        "prefill_chunk": engine.prefill_chunk,
+        "ttft_p50_ms": round(ttft.get("p50_ms", 0.0), 2),
+        "ttft_p95_ms": round(ttft.get("p95_ms", 0.0), 2),
+        "mixed_steps": int(counters["mixed_steps"]),
+        "decode_steps": int(counters["decode_steps"]),
+        "mixed_step_mean_ms": round(
+            lat.get("mixed_step", {}).get("mean_ms", 0.0), 3),
+        "decode_step_mean_ms": round(
+            lat.get("decode_step", {}).get("mean_ms", 0.0), 3),
+        "preemptions": int(counters["preemptions"]),
+        "jit_traces": int(counters["jit_traces"]),
+        "jit_traces_measured": int(counters["jit_traces"] - warm_traces),
         "engine_utilization": round(sched.get("utilization", 0.0), 4),
     }
 

@@ -78,9 +78,10 @@ class CausalSelfAttention(nn.Layer):
         v = M.squeeze(M.slice(qkv, [2], [2], [3]), 2)
         if cache is not None and getattr(cache, "is_paged", False):
             # serving path: K/V live in the global block arena and are
-            # gathered through this sequence's block table (vLLM-style
-            # paged attention; serving/block_pool.py owns the math — and
-            # is the seam a Pallas ragged-attention kernel replaces)
+            # attended through this sequence's block table (vLLM-style
+            # paged attention; serving/block_pool.py scatters, then
+            # ops/pallas/paged_attention.py dispatches the ragged Pallas
+            # kernel on TPU or the XLA gather fallback elsewhere)
             from ..serving.block_pool import paged_attention
 
             o = paged_attention(q._array, k._array, v._array, cache)
@@ -181,7 +182,12 @@ class GPT(nn.Layer):
 
     def forward(self, input_ids, caches=None, pos_offset=0, labels=None):
         b, s = input_ids.shape
-        if caches is not None:
+        if caches is not None and getattr(caches, "is_paged", False):
+            # serving path: the paged state's qpos IS each token's absolute
+            # position (ragged mixed batches — decode rows and prefill
+            # chunks start at different offsets per row)
+            pos = Tensor._from_op(caches.qpos)
+        elif caches is not None:
             import jax.numpy as jnp
 
             po = pos_offset._array if isinstance(pos_offset, Tensor) else pos_offset

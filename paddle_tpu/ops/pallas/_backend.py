@@ -1,0 +1,42 @@
+"""Shared backend gate for the Pallas kernels (flash + ragged paged attn).
+
+One policy, three env knobs, checked in this order:
+
+- ``PADDLE_TPU_DISABLE_PALLAS``          — always use the XLA fallbacks.
+- ``PADDLE_TPU_FORCE_PALLAS_INTERPRET``  — run the Pallas kernels through the
+  interpreter on ANY backend (CI's way to exercise the kernel code paths on
+  CPU runners, including inside jitted serving steps).
+- ``PADDLE_TPU_PALLAS_INTERPRET``        — opt into the kernels off-TPU,
+  interpreted (the original per-kernel knob, kept for compatibility).
+
+On a real TPU (or axon) backend the kernels are on and compiled; elsewhere
+they are off unless one of the interpret knobs opts in.
+"""
+from __future__ import annotations
+
+import os
+
+
+def use_pallas():
+    """Whether attention dispatch should take the Pallas kernel path."""
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    if os.environ.get("PADDLE_TPU_FORCE_PALLAS_INTERPRET"):
+        return True
+    import jax
+
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        return False
+    if platform in ("tpu", "axon"):
+        return True
+    return bool(os.environ.get("PADDLE_TPU_PALLAS_INTERPRET"))
+
+
+def interpret_mode():
+    """Whether Pallas kernels must run interpreted (non-TPU backends)."""
+    return bool(
+        os.environ.get("PADDLE_TPU_FORCE_PALLAS_INTERPRET")
+        or os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
+    )

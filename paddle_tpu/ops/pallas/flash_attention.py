@@ -34,11 +34,12 @@ Kernel design (TPU):
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ._backend import interpret_mode, use_pallas
 
 _NEG_INF = -1e30
 
@@ -69,16 +70,10 @@ def _attention_xla(q, k, v, mask=None, causal=False, dropout_p=0.0, dropout_key=
     return out
 
 
-def _use_pallas():
-    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
-        return False
-    try:
-        platform = jax.default_backend()
-    except Exception:
-        return False
-    if platform in ("tpu", "axon"):
-        return True
-    return bool(os.environ.get("PADDLE_TPU_PALLAS_INTERPRET"))
+# kept as a module-level alias so older call sites keep working; the policy
+# (including the PADDLE_TPU_FORCE_PALLAS_INTERPRET CI override) lives in
+# _backend.py, shared with the ragged paged-attention kernel
+_use_pallas = use_pallas
 
 
 # ---------------------------------------------------------------------------
@@ -575,7 +570,7 @@ def flash_attention_array(
         and sq % bq == 0 and sk % bk == 0
         and _use_pallas()
     ):
-        interpret = bool(os.environ.get("PADDLE_TPU_PALLAS_INTERPRET"))
+        interpret = interpret_mode()
         if dropout_p > 0.0 and interpret:
             # TPU PRNG primitives are unavailable in interpreter mode
             return _attention_xla(q, k, v, mask, causal, dropout_p, dropout_key)

@@ -1,0 +1,225 @@
+"""Ragged paged attention: Pallas TPU kernel + XLA gather fallback.
+
+The serving engine's attention (PAPERS.md "Ragged Paged Attention"): K/V live
+in a head-major block arena ``[layers, heads, num_blocks, block_size,
+head_dim]`` and every batch row attends through its own block table. One
+launch serves a MIXED batch — decode rows (1 live query token) next to
+prefill-chunk rows (up to `prefill_chunk` tokens) — which is what lets the
+engine run chunked prefill and decode in a single XLA program.
+
+Kernel design (TPU):
+- Grid ``(rows, heads, max_blocks)`` with the KV-block dimension innermost.
+  The block index map reads the row's block table through scalar prefetch
+  (SMEM), so each grid step DMAs exactly ONE live KV block ``[block_size,
+  head_dim]`` from the arena in HBM — the padded tail of the block table is
+  never fetched: dead iterations clamp the index map to the last live block
+  (Mosaic elides the re-fetch of an unchanged block) and `pl.when` skips
+  their compute. This is the whole point vs. the XLA fallback below, which
+  gathers the full padded ``[rows, max_blocks]`` table every layer.
+- Online-softmax state (m, l, acc) lives in VMEM scratch across the KV
+  iterations, exactly like flash_attention.py; fp32 accumulation on the MXU.
+- Causal masking is positional: query positions are ``q_start[row] + iota``
+  (chunk tokens are consecutive), key positions ``block * block_size +
+  iota``; ``qpos >= kpos`` also discards the garbage tail of a partially
+  filled last block.
+- Head-major arena so each (head, block) tile is a 2-D ``(block_size,
+  head_dim)`` VMEM block: Mosaic requires the minor two dims of a block to
+  be (8, 128)-divisible or equal to the array dims, which a head axis in
+  second-to-minor position would violate (same constraint that shapes
+  flash_attention.py's [B*H, S, D] layout).
+
+The dispatch (`paged_attention_arrays`) is the seam `serving/block_pool.py`
+calls after scattering the step's new K/V into the arena: Pallas on TPU (or
+interpreted when PADDLE_TPU_FORCE_PALLAS_INTERPRET / _PALLAS_INTERPRET is
+set), XLA gather everywhere else. The fallback gathers into the SAME
+``[rows, seq, heads, head_dim]`` layout and einsum as `models/gpt.py`'s
+contiguous-cache decode, keeping greedy serving outputs token-for-token
+identical to `GPT.generate`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._backend import interpret_mode, use_pallas
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback (also the correctness reference in tests)
+# ---------------------------------------------------------------------------
+
+def paged_attention_xla(q, k_arena, v_arena, layer, block_tables, qpos,
+                        scale=None):
+    """Reference paged attention: gather the full padded block table.
+
+    q: [B, S, H, D]; arenas: [layers, H, num_blocks, block_size, D];
+    block_tables: [B, max_blocks] int32 (0 = null block); qpos: [B, S]
+    absolute query positions (padding rows/cols carry 0 and are discarded
+    by the caller). Returns [B, S, H, D].
+    """
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    k_seq = k_arena[layer][:, block_tables]  # [H, B, nb, bs, D]
+    v_seq = v_arena[layer][:, block_tables]
+    nb, bs = k_seq.shape[2], k_seq.shape[3]
+    L = nb * bs
+    # back to the [B, L, H, D] layout of models/gpt.py's contiguous-cache
+    # path so the einsum below is the exact same contraction (bit-parity
+    # with GPT.generate is a serving acceptance criterion)
+    k_seq = jnp.transpose(k_seq, (1, 2, 3, 0, 4)).reshape(B, L, H, D)
+    v_seq = jnp.transpose(v_seq, (1, 2, 3, 0, 4)).reshape(B, L, H, D)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_seq, preferred_element_type=jnp.float32
+    ) * scale
+    kpos = jnp.arange(L)[None, None, None, :]
+    qp = qpos[:, None, :, None]  # [B, 1, S, 1]
+    s = jnp.where(kpos <= qp, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_seq.dtype), v_seq)
+
+
+# ---------------------------------------------------------------------------
+# Pallas ragged kernel
+# ---------------------------------------------------------------------------
+
+def _ragged_kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, bs, sq, scale):
+    """One (row, head) pair's online-softmax walk over its live KV blocks.
+
+    bt_ref/qs_ref/kl_ref are the scalar-prefetched block tables, per-row
+    query start positions, and per-row live KV block counts (SMEM)."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)   # batch row
+    j = pl.program_id(2)   # kv block step (innermost)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j < kl_ref[i])
+    def _():
+        q = q_ref[0, 0]        # [sq, D]
+        kt = k_ref[0, 0, 0]    # [bs, D]
+        s = jax.lax.dot_general(
+            q, kt, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        # chunk query positions are consecutive from q_start; key positions
+        # follow from the block index. qpos >= kpos is both the causal mask
+        # and the guard over a partially filled last block's stale tail.
+        qp = qs_ref[i] + jax.lax.broadcasted_iota(jnp.int32, (sq, bs), 0)
+        kp = j * bs + jax.lax.broadcasted_iota(jnp.int32, (sq, bs), 1)
+        s = jnp.where(qp >= kp, s, _NEG_INF)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        vt = v_ref[0, 0, 0]    # [bs, D]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(vt.dtype), vt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(j == kl_ref[i] - 1)
+    def _():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ragged(B, H, sq, d, bs, nk, layer, dtype_name, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    scale = 1.0 / np.sqrt(d)
+
+    def q_index(i, h, j, bt, qs, kl):
+        return (i, h, 0, 0)
+
+    def kv_index(i, h, j, bt, qs, kl):
+        # dead iterations (j >= live count) re-address the last live block:
+        # Mosaic skips the DMA for an unchanged index and pl.when skips the
+        # compute, so the padded tail of the table costs nothing
+        jc = jnp.minimum(j, kl[i] - 1)
+        return (layer, h, bt[i, jc], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, H, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d), q_index),
+            pl.BlockSpec((1, 1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, sq, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((sq, 1), jnp.float32),   # running max m
+            pltpu.VMEM((sq, 1), jnp.float32),   # running normalizer l
+            pltpu.VMEM((sq, d), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ragged_kernel, bs=bs, sq=sq, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, sq, d), jnp.dtype(dtype_name)),
+        interpret=interpret,
+    )
+
+
+def ragged_paged_attention(q, k_arena, v_arena, layer, block_tables,
+                           q_start, kv_live, interpret=False):
+    """Pallas ragged paged attention over live KV blocks only.
+
+    q: [B, S, H, D]; arenas: [layers, H, num_blocks, bs, D];
+    block_tables: [B, max_blocks]; q_start: [B] first query position per
+    row; kv_live: [B] number of live KV blocks per row (>= 1).
+    Returns [B, S, H, D]. Rows/columns beyond each row's live tokens hold
+    garbage — the engine discards them.
+    """
+    B, S, H, D = q.shape
+    bs = k_arena.shape[3]
+    nk = block_tables.shape[1]
+    fn = _build_ragged(B, H, S, D, bs, nk, int(layer), str(q.dtype),
+                       bool(interpret))
+    qh = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, D]
+    o = fn(
+        block_tables.astype(jnp.int32),
+        q_start.astype(jnp.int32),
+        jnp.maximum(kv_live.astype(jnp.int32), 1),
+        qh, k_arena, v_arena,
+    )
+    return jnp.transpose(o, (0, 2, 1, 3))
+
+
+# ---------------------------------------------------------------------------
+# dispatch — the seam serving/block_pool.py calls
+# ---------------------------------------------------------------------------
+
+def paged_attention_arrays(q, k_arena, v_arena, layer, block_tables, qpos,
+                           q_start=None, kv_live=None, scale=None):
+    """Attend q through the block table: Pallas ragged kernel when the
+    backend gate and the ragged metadata allow it, XLA gather otherwise."""
+    if (
+        q_start is not None and kv_live is not None
+        and scale is None  # kernel bakes 1/sqrt(D); custom scales fall back
+        and use_pallas()
+    ):
+        return ragged_paged_attention(
+            q, k_arena, v_arena, layer, block_tables, q_start, kv_live,
+            interpret=interpret_mode(),
+        )
+    return paged_attention_xla(q, k_arena, v_arena, layer, block_tables,
+                               qpos, scale)

@@ -1,11 +1,13 @@
 """paddle_tpu.serving — continuous-batching LLM engine with a paged KV cache.
 
 The production decode path the ROADMAP north-star asks for: `LLMEngine`
-admits requests mid-flight (FCFS, token-budget batching, decode priority,
-preemption-by-recompute), stores K/V in a block-paged arena with fixed-shape
-scatter/gather (PAPERS.md "Ragged Paged Attention", the TPU-idiomatic paged
-KV design), and compiles exactly one XLA program per (prefill bucket,
-decode) shape regardless of traffic.
+admits requests mid-flight (FCFS, chunked prefill under a per-step token
+budget, preemption-by-recompute), stores K/V in a head-major block-paged
+arena (PAPERS.md "Ragged Paged Attention"), attends through a ragged
+Pallas kernel on TPU (XLA gather fallback elsewhere,
+ops/pallas/paged_attention.py), and compiles exactly TWO XLA programs —
+one mixed prefill+decode step and one pure-decode step — regardless of
+traffic or prompt lengths.
 
 Quickstart::
 

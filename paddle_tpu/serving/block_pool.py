@@ -1,13 +1,17 @@
 """Paged KV cache: a global block arena + per-sequence block tables.
 
 The TPU-native answer to vLLM's PagedAttention (PAPERS.md "Ragged Paged
-Attention"): K/V live in ONE fixed-shape arena
-``[num_blocks, layers, block_size, heads, head_dim]`` and every sequence owns
-a list of block ids. Appending a token is a fixed-shape ``.at[...].set``
-scatter; attention gathers K/V through a padded ``[B, max_blocks]`` block
-table. Because every device op has a static shape, prefill and decode each
-compile exactly once per bucket — no shape ever depends on how many requests
-are in flight or how long they are.
+Attention"): K/V live in ONE fixed-shape, head-major arena
+``[layers, heads, num_blocks, block_size, head_dim]`` and every sequence
+owns a list of block ids. Head-major is the Pallas-friendly layout: each
+(layer, head, block) slice is a contiguous ``[block_size, head_dim]`` tile
+the ragged kernel DMAs straight from HBM (ops/pallas/paged_attention.py).
+Appending tokens is a fixed-shape ``.at[...].set`` scatter; attention runs
+through `paged_attention`, which dispatches to the ragged Pallas kernel on
+TPU and to an XLA gather of the padded ``[rows, max_blocks]`` block table
+everywhere else. Because every device op has a static shape, the whole
+mixed prefill+decode serve compiles to two programs — no shape ever depends
+on how many requests are in flight or how long they are.
 
 Block 0 is the NULL block: the allocator never hands it out, and every
 padded/inactive scatter is routed there, so out-of-range writes can never
@@ -15,9 +19,7 @@ corrupt a live sequence. Reads through padding gather garbage from block 0,
 which the causal ``kpos <= qpos`` mask then discards.
 
 Host-side bookkeeping (the free list) is plain Python — allocation decisions
-are scheduling, not device work. This module is also the seam a future
-Pallas ragged-attention kernel slots into: `paged_attention` is the only
-function that touches the gathered K/V.
+are scheduling, not device work.
 """
 from __future__ import annotations
 
@@ -44,22 +46,30 @@ class PagedState:
     """Traced arena + step metadata threaded through GPT.forward.
 
     Arrays (all fixed-shape, jnp):
-      k, v          [num_blocks, layers, block_size, heads, head_dim]
+      k, v          [layers, heads, num_blocks, block_size, head_dim]
       block_tables  [B, max_blocks] int32 (padded with 0 = null block)
       slots         [B, S] int32 — destination block id of each new token
       offs          [B, S] int32 — destination offset inside that block
       qpos          [B, S] int32 — absolute position of each query token
+                    (also the model's position-embedding indices)
+      q_start       [B] int32 — first live query position per row (ragged
+                    kernel metadata; chunk tokens are consecutive)
+      kv_live       [B] int32 — live KV blocks per row (>= 1); the ragged
+                    kernel walks exactly this many blocks
     """
 
     is_paged = True
 
-    def __init__(self, k, v, block_tables, slots, offs, qpos):
+    def __init__(self, k, v, block_tables, slots, offs, qpos,
+                 q_start=None, kv_live=None):
         self.k = k
         self.v = v
         self.block_tables = block_tables
         self.slots = slots
         self.offs = offs
         self.qpos = qpos
+        self.q_start = q_start
+        self.kv_live = kv_live
 
     def layer(self, i):
         return PagedLayerView(self, i)
@@ -69,34 +79,23 @@ def paged_attention(q, k_new, v_new, view, scale=None):
     """Append `k_new`/`v_new` into the arena and attend `q` through the
     block table. All shapes static; returns [B, S, heads, head_dim].
 
-    q, k_new, v_new: [B, S, heads, head_dim] jnp arrays.
+    q, k_new, v_new: [B, S, heads, head_dim] jnp arrays. The attention
+    itself is ops/pallas/paged_attention.py's dispatch: ragged Pallas
+    kernel over live blocks on TPU, padded XLA gather elsewhere.
     """
-    import jax
-    import jax.numpy as jnp
+    from ..ops.pallas.paged_attention import paged_attention_arrays
 
     st, layer = view.state, view.layer
-    B, S, H, D = q.shape
     # scatter the step's K/V rows into their (block, offset) homes; padded
-    # and inactive rows carry slot 0 (the null block)
-    st.k = st.k.at[st.slots, layer, st.offs].set(k_new.astype(st.k.dtype))
-    st.v = st.v.at[st.slots, layer, st.offs].set(v_new.astype(st.v.dtype))
-    # gather this layer's K/V for every sequence: [B, nb, bs, H, D]
-    k_seq = st.k[st.block_tables, layer]
-    v_seq = st.v[st.block_tables, layer]
-    nb, bs = k_seq.shape[1], k_seq.shape[2]
-    L = nb * bs
-    k_seq = k_seq.reshape(B, L, H, D)
-    v_seq = v_seq.reshape(B, L, H, D)
-    if scale is None:
-        scale = 1.0 / np.sqrt(D)
-    s_l = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k_seq, preferred_element_type=jnp.float32
-    ) * scale
-    kpos = jnp.arange(L)[None, None, None, :]
-    qpos = st.qpos[:, None, :, None]  # [B, 1, S, 1]
-    s_l = jnp.where(kpos <= qpos, s_l, -1e30)
-    p = jax.nn.softmax(s_l, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_seq.dtype), v_seq)
+    # and inactive rows carry slot 0 (the null block). The advanced indices
+    # (layer, slots, offs) are separated by the head-axis slice, so the
+    # indexed view is [B, S, heads, head_dim] — k_new's own layout.
+    st.k = st.k.at[layer, :, st.slots, st.offs].set(k_new.astype(st.k.dtype))
+    st.v = st.v.at[layer, :, st.slots, st.offs].set(v_new.astype(st.v.dtype))
+    return paged_attention_arrays(
+        q, st.k, st.v, layer, st.block_tables, st.qpos,
+        q_start=st.q_start, kv_live=st.kv_live, scale=scale,
+    )
 
 
 class BlockPool:
@@ -115,7 +114,7 @@ class BlockPool:
             raise ValueError("BlockPool needs >= 2 blocks (block 0 is null)")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
-        shape = (self.num_blocks, num_layers, self.block_size, num_heads,
+        shape = (num_layers, num_heads, self.num_blocks, self.block_size,
                  head_dim)
         dt = dtype or jnp.float32
         self.k = jnp.zeros(shape, dt)
@@ -146,11 +145,11 @@ class BlockPool:
 
     def copy_blocks(self, src, dst):
         """Device-side block copy (copy-on-preempt / future forked decode):
-        arena rows `src` are duplicated into rows `dst` in one scatter."""
+        arena blocks `src` are duplicated into blocks `dst` in one scatter."""
         src = np.asarray(src, np.int32)
         dst = np.asarray(dst, np.int32)
-        self.k = self.k.at[dst].set(self.k[src])
-        self.v = self.v.at[dst].set(self.v[src])
+        self.k = self.k.at[:, :, dst].set(self.k[:, :, src])
+        self.v = self.v.at[:, :, dst].set(self.v[:, :, src])
 
     def table_for(self, blocks, max_blocks):
         """Padded [max_blocks] int32 block table (0-padded) for a sequence."""
@@ -161,7 +160,7 @@ class BlockPool:
     def positions_to_slots(self, blocks, start, count, width):
         """(slots[width], offs[width]) scatter targets for token positions
         [start, start+count); positions beyond `count` go to the null
-        block. `width` is the padded (bucketed) length."""
+        block. `width` is the padded step width."""
         pos = np.arange(width)
         idx = (start + pos) // self.block_size
         offs = ((start + pos) % self.block_size).astype(np.int32)

@@ -1,27 +1,38 @@
 """LLMEngine: continuous-batching generation over the paged KV cache.
 
-`add_request` enqueues, `step` runs ONE device step (a prefill or a decode
-picked by the scheduler), `stream` yields a request's tokens as they land.
-Both device paths go through a single jitted step function compiled per
-(batch, seq) shape: prefill runs at ``(1, prompt_bucket)`` — prompt lengths
-pad up to `inference.Predictor._pick_bucket` buckets — and decode at
-``(max_batch, 1)``, so a serving process compiles exactly
-``len(used buckets) + 1`` programs no matter how requests arrive. The
-`jit_traces` counter in `metrics` increments inside the traced body (trace
-time only) and is the test's recompile alarm.
+`add_request` enqueues, `step` runs ONE mixed device step (decode rows plus
+chunked-prefill rows, planned by the scheduler), `stream` yields a request's
+tokens as they land. The whole serve compiles to exactly TWO programs no
+matter how requests arrive:
 
-Decode outputs are bit-identical to `GPT.generate`'s greedy path: the same
-attention math runs through the block-table gather instead of a contiguous
-buffer (models/gpt.py `CausalSelfAttention` + serving/block_pool.py).
+- the **mixed step** at ``(max_batch, prefill_chunk)`` — every running
+  sequence is one row; decode rows carry 1 live token, prefill rows carry
+  their next chunk, padding goes to the null block;
+- the **decode step** at ``(max_batch, 1)`` — the same program specialized
+  to the (dominant) all-decode case so steady-state decoding never pays the
+  chunk-width compute.
+
+Prefill buckets are gone: a prompt of ANY length streams into the arena
+`prefill_chunk` tokens at a time while the running batch keeps decoding in
+the same steps, so time-to-first-token of in-flight requests no longer
+spikes when a long prompt arrives. The `jit_traces` counter in `metrics`
+increments inside the traced body (trace time only) and is the test's
+recompile alarm.
+
+Decode outputs are token-for-token identical to `GPT.generate`'s greedy
+path: the same attention math runs through the block-table gather instead
+of a contiguous buffer (models/gpt.py `CausalSelfAttention` +
+ops/pallas/paged_attention.py's XLA fallback; the Pallas ragged kernel on
+TPU matches to kernel-accumulation tolerance).
 """
 from __future__ import annotations
 
+import time
 from collections import namedtuple
 
 import numpy as np
 
 from ..core.functional import functional_call, state_dict_arrays
-from ..inference import Predictor
 from .block_pool import BlockPool, PagedState
 from .metrics import ServingMetrics
 from .scheduler import Request, Scheduler
@@ -29,20 +40,10 @@ from .scheduler import Request, Scheduler
 StepOutput = namedtuple("StepOutput", ["request_id", "token", "finished"])
 
 
-def _default_buckets(max_seq_len):
-    out = []
-    b = 16
-    while b < max_seq_len:
-        out.append(b)
-        b *= 2
-    out.append(max_seq_len)
-    return tuple(sorted(set(out)))
-
-
 class LLMEngine:
     def __init__(self, model, block_size=16, num_blocks=None, max_batch=4,
-                 prefill_buckets=None, max_seq_len=None, token_budget=None,
-                 prefill_interval=1, seed=0):
+                 prefill_chunk=None, token_budget=None, max_seq_len=None,
+                 prefill_buckets=None, prefill_interval=None, seed=0):
         import jax
 
         model.eval()
@@ -60,15 +61,19 @@ class LLMEngine:
         if num_blocks is None:
             # enough for a full decode batch of max-length sequences (+null)
             num_blocks = self.max_batch * self.max_blocks + 1
-        # sorted is load-bearing: _pick_bucket bisects the bucket list
-        self.prefill_buckets = tuple(sorted(set(
-            b for b in (prefill_buckets or _default_buckets(self.max_seq_len))
-            if b <= self.max_seq_len
-        )))
-        if not self.prefill_buckets or max(self.prefill_buckets) < self.max_seq_len:
-            self.prefill_buckets = tuple(
-                sorted(set(self.prefill_buckets) | {self.max_seq_len})
-            )
+        # prefill_buckets/prefill_interval are accepted for API compatibility
+        # with the bucketed engine and ignored: chunked prefill replaced the
+        # per-bucket programs with one mixed program
+        del prefill_buckets
+        if prefill_chunk is None:
+            prefill_chunk = min(128, self.max_seq_len)
+        self.prefill_chunk = max(1, min(int(prefill_chunk), self.max_seq_len))
+        if token_budget is None:
+            # default: every lane may carry a full chunk, so the mixed
+            # step's fixed (max_batch, chunk) width is fully usable; set a
+            # smaller budget to bound per-step prefill work instead
+            token_budget = self.max_batch * self.prefill_chunk
+        self.prefill_chunk = min(self.prefill_chunk, int(token_budget))
         self.metrics = ServingMetrics()
         self._params, self._buffers = state_dict_arrays(model)
         dt = model.wte.weight._array.dtype
@@ -78,7 +83,8 @@ class LLMEngine:
         )
         self.scheduler = Scheduler(
             self.pool, max_batch=self.max_batch,
-            token_budget=int(token_budget or max(self.prefill_buckets)),
+            token_budget=int(token_budget),
+            prefill_chunk=self.prefill_chunk,
             prefill_interval=prefill_interval, metrics=self.metrics,
         )
         self._requests = {}
@@ -91,7 +97,9 @@ class LLMEngine:
                     eos_token_id=None, request_id=None):
         """Enqueue one generation request; returns its id. Admission happens
         inside a later `step()` (continuous batching: requests join the
-        running batch between decode steps, never blocking them)."""
+        running batch between decode steps, never blocking them). Prompts of
+        any length are accepted — prefill is chunked under the scheduler's
+        token budget, so no prompt can monopolize a step."""
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
@@ -101,17 +109,6 @@ class LLMEngine:
                 f"request {req.request_id}: prompt {req.num_tokens} + "
                 f"{req.max_new_tokens} new tokens exceeds max_seq_len "
                 f"{self.max_seq_len}"
-            )
-        # a preempted request re-prefills prompt + generated-so-far (up to
-        # max_new-1 tokens), so the WORST-CASE recompute bucket must fit the
-        # token budget or a preemption could wedge the FCFS queue mid-serve
-        worst = self._bucket(req.num_tokens + req.max_new_tokens - 1)
-        if worst > self.scheduler.token_budget:
-            raise ValueError(
-                f"request {req.request_id}: worst-case recompute prefill "
-                f"bucket {worst} exceeds token budget "
-                f"{self.scheduler.token_budget}; raise token_budget or "
-                "shorten the request"
             )
         if req.request_id in self._requests:
             raise ValueError(f"duplicate request id {req.request_id}")
@@ -141,13 +138,10 @@ class LLMEngine:
 
     # -- compiled step -----------------------------------------------------
 
-    def _bucket(self, n):
-        return Predictor._pick_bucket(n, list(self.prefill_buckets),
-                                      "prompt length")
-
     def _get_step_fn(self, B, S):
-        """One jitted step program per (batch, seq) shape: prefill at
-        (1, bucket), decode at (max_batch, 1)."""
+        """One jitted step program per (batch, width) shape — exactly two
+        exist: the mixed step (max_batch, prefill_chunk) and the decode
+        step (max_batch, 1)."""
         if (B, S) in self._step_fns:
             return self._step_fns[(B, S)]
         import jax
@@ -157,15 +151,14 @@ class LLMEngine:
         metrics = self.metrics
 
         def step(params, buffers, k_arena, v_arena, ids, block_tables,
-                 slots, offs, qpos, last_idx, temps, key):
+                 slots, offs, qpos, q_start, kv_live, last_idx, temps, key):
             # runs at TRACE time only — the test's recompile alarm
             metrics.inc("jit_traces")
             state = PagedState(k_arena, v_arena, block_tables, slots, offs,
-                               qpos)
+                               qpos, q_start=q_start, kv_live=kv_live)
             (logits, _), _ = functional_call(
                 model, params, buffers, args=(ids,),
-                kwargs={"caches": state, "pos_offset": qpos[:, :1]},
-                training=False,
+                kwargs={"caches": state}, training=False,
             )
             lg = logits[jnp.arange(ids.shape[0]), last_idx].astype(jnp.float32)
             greedy = jnp.argmax(lg, axis=-1)
@@ -178,7 +171,8 @@ class LLMEngine:
         self._step_fns[(B, S)] = fn
         return fn
 
-    def _run_step(self, fn, ids, tables, slots, offs, qpos, last_idx, temps):
+    def _run_step(self, fn, ids, tables, slots, offs, qpos, q_start, kv_live,
+                  last_idx, temps):
         import jax
         import jax.numpy as jnp
 
@@ -186,24 +180,26 @@ class LLMEngine:
         tok, self.pool.k, self.pool.v = fn(
             self._params, self._buffers, self.pool.k, self.pool.v,
             jnp.asarray(ids), jnp.asarray(tables), jnp.asarray(slots),
-            jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(last_idx),
-            jnp.asarray(temps), sub,
+            jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(q_start),
+            jnp.asarray(kv_live), jnp.asarray(last_idx), jnp.asarray(temps),
+            sub,
         )
         return np.asarray(tok)  # host sync: the step is done when this lands
 
     # -- one engine step ---------------------------------------------------
 
     def step(self):
-        """Run one prefill or decode step; returns [StepOutput] for every
-        request that produced a token this step."""
-        kind, reqs = self.scheduler.schedule(self._bucket)
-        if kind == "idle":
+        """Run one mixed (or pure-decode) step; returns [StepOutput] for
+        every request that produced a token this step."""
+        rows = self.scheduler.schedule()
+        if not rows:
             return []
+        # the dominant all-decode steps run at width 1; any step carrying a
+        # prefill chunk runs at the fixed chunk width — two shapes total
+        S = 1 if all(r.count == 1 for r in rows) else self.prefill_chunk
+        kind = "decode" if S == 1 else "mixed"
         with self.metrics.timed(f"{kind}_step"):
-            if kind == "prefill":
-                outs = self._step_prefill(reqs[0])
-            else:
-                outs = self._step_decode(reqs)
+            outs = self._step_rows(rows, S)
         self.metrics.inc(f"{kind}_steps")
         self.metrics.set_gauge(
             "tokens_in_flight",
@@ -217,50 +213,52 @@ class LLMEngine:
         self.metrics.set_gauge("num_waiting", len(self.scheduler.waiting))
         return outs
 
-    def _step_prefill(self, req):
-        total = req.num_tokens
-        S = self._bucket(total)
-        ids = np.zeros((1, S), np.int32)
-        ids[0, :total] = req.all_ids
-        slots, offs = self.pool.positions_to_slots(req.blocks, 0, total, S)
-        qpos = np.arange(S, dtype=np.int32)[None]
-        tables = self.pool.table_for(req.blocks, self.max_blocks)[None]
-        fn = self._get_step_fn(1, S)
-        tok = self._run_step(
-            fn, ids, tables, slots[None], offs[None], qpos,
-            np.asarray([total - 1], np.int32),
-            np.asarray([req.temperature], np.float32),
-        )
-        req.num_cached = total
-        return [self._emit(req, int(tok[0]))]
-
-    def _step_decode(self, reqs):
+    def _step_rows(self, rows, S):
+        """Run one ragged step: every scheduled row feeds `count` tokens at
+        positions [start, start+count); rows whose chunk reaches the
+        sequence's last pending token sample its next one."""
         B = self.max_batch
-        ids = np.zeros((B, 1), np.int32)
-        qpos = np.zeros((B, 1), np.int32)
-        slots = np.zeros((B, 1), np.int32)
-        offs = np.zeros((B, 1), np.int32)
+        ids = np.zeros((B, S), np.int32)
+        qpos = np.zeros((B, S), np.int32)
+        slots = np.zeros((B, S), np.int32)
+        offs = np.zeros((B, S), np.int32)
         tables = np.zeros((B, self.max_blocks), np.int32)
         temps = np.zeros(B, np.float32)
-        for i, req in enumerate(reqs):
-            ids[i, 0] = req.last_token
-            qpos[i, 0] = req.num_cached
-            slots[i, 0] = req.blocks[req.num_cached // self.block_size]
-            offs[i, 0] = req.num_cached % self.block_size
+        last_idx = np.zeros(B, np.int32)
+        q_start = np.zeros(B, np.int32)
+        kv_live = np.ones(B, np.int32)  # idle lanes walk just the null block
+        for i, row in enumerate(rows):
+            req, start, count = row.req, row.start, row.count
+            if start == req.num_tokens - 1:
+                # decode fast path: the single pending token is always the
+                # last one — skip rebuilding prompt+outputs every step
+                ids[i, 0] = req.last_token
+            else:
+                ids[i, :count] = req.all_ids[start:start + count]
+            qpos[i, :count] = np.arange(start, start + count)
+            slots[i], offs[i] = self.pool.positions_to_slots(
+                req.blocks, start, count, S
+            )
             tables[i] = self.pool.table_for(req.blocks, self.max_blocks)
             temps[i] = req.temperature
-        fn = self._get_step_fn(B, 1)
-        tok = self._run_step(
-            fn, ids, tables, slots, offs, qpos,
-            np.zeros(B, np.int32), temps,
-        )
+            last_idx[i] = count - 1
+            q_start[i] = start
+            kv_live[i] = (start + count - 1) // self.block_size + 1
+        fn = self._get_step_fn(B, S)
+        tok = self._run_step(fn, ids, tables, slots, offs, qpos, q_start,
+                             kv_live, last_idx, temps)
         outs = []
-        for i, req in enumerate(reqs):
-            req.num_cached += 1
-            outs.append(self._emit(req, int(tok[i])))
+        for i, row in enumerate(rows):
+            row.req.num_cached += row.count
+            if row.emit:
+                outs.append(self._emit(row.req, int(tok[i])))
         return outs
 
     def _emit(self, req, token):
+        if not req.output_ids:
+            self.metrics.observe(
+                "ttft", time.monotonic() - req.arrival_time, interval=False
+            )
         req.output_ids.append(token)
         self.metrics.inc("generated_tokens")
         done = (

@@ -35,8 +35,11 @@ class ServingMetrics:
     def set_gauge(self, name, value):
         self.gauges[name] = value
 
-    def observe(self, name, seconds, start=None):
-        """Record one timed operation (a prefill or decode step)."""
+    def observe(self, name, seconds, start=None, interval=True):
+        """Record one timed operation (a mixed or decode step). Pass
+        ``interval=False`` for request-level durations (e.g. TTFT) that are
+        latency observations, not engine busy time — they feed the
+        percentile summary but stay out of the schedule view."""
         d = self._durations[name]
         s = float(seconds)
         d["count"] += 1
@@ -45,6 +48,8 @@ class ServingMetrics:
         d["recent"].append(s)
         if len(d["recent"]) > self._max_intervals:
             del d["recent"][: -self._max_intervals]
+        if not interval:
+            return
         end = time.monotonic() if start is None else start + seconds
         self._intervals.append((end - seconds, end, name))
         if len(self._intervals) > self._max_intervals:
@@ -70,6 +75,10 @@ class ServingMetrics:
                 "total_ms": d["total"] * 1e3,
                 "mean_ms": d["total"] / d["count"] * 1e3,
                 "p50_ms": recent[len(recent) // 2] * 1e3,
+                # nearest-rank p95: ceil(0.95 n) - 1 (int(0.95 n) is one
+                # rank high and reads as the max for windows up to 20)
+                "p95_ms": recent[max(0, -(-95 * len(recent) // 100) - 1)]
+                * 1e3,
                 "max_ms": d["max"] * 1e3,
             }
         return out

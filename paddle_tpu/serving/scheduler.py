@@ -1,31 +1,48 @@
-"""Continuous-batching scheduler: FCFS admission, decode-priority,
-preemption-by-recompute.
+"""Continuous-batching scheduler: chunked-prefill mixed batching, FCFS
+admission, preemption-by-recompute.
 
 The policy half of the serving engine (the paged arena in block_pool.py is
-the memory half). Each `schedule()` call picks ONE kind of device step:
+the memory half). Each `schedule()` call plans ONE mixed device step: every
+running sequence gets a row, and a row is either
 
-- ``("decode", running)``  — one token for every running sequence. Decode has
-  priority: as long as sequences are running, their latency is protected and
-  prefill admission only happens every `prefill_interval` decode steps.
-- ``("prefill", [req])``   — admit the FCFS head of the waiting queue when
-  the decode batch has a free lane, the bucketed prompt fits the token
-  budget, and the pool can hold its KV.
-- ``("idle", [])``         — nothing to do.
+- a **decode row** — the sequence's single pending token (its last sampled
+  token, fed at position ``num_cached``), always scheduled, never gated; or
+- a **prefill-chunk row** — the next ``<= prefill_chunk`` tokens of a
+  sequence whose prompt (or post-preemption replay) is not yet in the KV
+  arena, admitted FCFS under a per-step ``token_budget`` of prefill tokens.
 
-When the pool runs dry mid-decode the LAST-admitted running sequence is
-preempted by recompute (vLLM's recompute policy): its blocks are freed, its
-prompt+generated tokens re-queue at the FRONT of the waiting queue, and a
-later prefill rebuilds the KV in one pass. FCFS order is preserved and no
-sequence is ever lost.
+Decode therefore never stalls behind prefill: a long prompt streams into
+the arena a chunk at a time WHILE the running batch keeps decoding in the
+same steps (the Ragged Paged Attention mixed-batch design). A row emits a
+token only when it reaches the sequence's last pending position — replayed
+chunks after a preemption emit nothing until the replay catches up, so
+recompute never re-emits tokens.
+
+Admission is FCFS into free lanes (``max_batch`` rows). KV blocks are
+allocated chunk-by-chunk as rows are planned, oldest sequence first; when
+the pool runs dry a row preempts the youngest running sequence that holds
+blocks (vLLM's recompute policy, FCFS priority: older may reclaim from
+younger, never the reverse): the victim's blocks are freed, its
+prompt+generated tokens re-queue at the FRONT of the waiting queue, and
+later chunks rebuild the KV. A row with no younger victim defers a step;
+the OLDEST sequence failing to grow means the pool cannot hold even one
+sequence, which fails loudly as a config error.
 """
 from __future__ import annotations
 
 import itertools
-from collections import deque
+import time
+from collections import deque, namedtuple
 
 _rid_counter = itertools.count()
+_arrival_counter = itertools.count()
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+# One planned row of the next mixed step: feed `req.all_ids[start:start+count]`
+# at positions [start, start+count); `emit` marks rows whose last fed position
+# is the sequence's final pending token — the engine samples their next token.
+ScheduledRow = namedtuple("ScheduledRow", ["req", "start", "count", "emit"])
 
 
 class Request:
@@ -49,6 +66,11 @@ class Request:
         self.blocks = []      # arena block ids owned by this sequence
         self.num_cached = 0   # tokens whose K/V currently live in the arena
         self.preemptions = 0
+        self.arrival_time = time.monotonic()   # TTFT anchor for metrics
+        # total arrival order, stable across preemption/re-admission —
+        # the scheduler's FCFS priority key (request_id may be user-supplied
+        # and unorderable; list position forgets age after a re-admit)
+        self.arrival_seq = next(_arrival_counter)
 
     @property
     def all_ids(self):
@@ -58,6 +80,12 @@ class Request:
     @property
     def num_tokens(self):
         return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def num_pending(self):
+        """Tokens not yet fed through the model (>= 1 while running: during
+        decode the freshly sampled token is always pending)."""
+        return self.num_tokens - self.num_cached
 
     @property
     def finished(self):
@@ -73,15 +101,27 @@ class Request:
 
 class Scheduler:
     def __init__(self, pool, max_batch=8, token_budget=2048,
-                 prefill_interval=4, metrics=None):
+                 prefill_chunk=None, prefill_interval=None, metrics=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.token_budget = int(token_budget)
-        self.prefill_interval = max(1, int(prefill_interval))
+        if self.token_budget < 1:
+            raise ValueError("token_budget must be >= 1")
+        # chunk width defaults to the budget; never wider than the budget
+        # (a wider chunk could never be scheduled)
+        self.prefill_chunk = min(
+            int(prefill_chunk) if prefill_chunk is not None
+            else self.token_budget,
+            self.token_budget,
+        )
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        # prefill_interval is accepted for API compatibility with the
+        # bucketed engine; mixed batching made it moot (decode rows ride in
+        # every step, so prefill never needs rationing to protect latency)
         self.metrics = metrics
         self.waiting = deque()
         self.running = []
-        self._decodes_since_prefill = 0
 
     # -- queue ops ---------------------------------------------------------
 
@@ -116,78 +156,72 @@ class Scheduler:
 
     # -- policy ------------------------------------------------------------
 
-    def _try_admit(self, prefill_bucket):
-        """Admit the FCFS head if a decode lane, the token budget, and the
-        pool all have room. Returns the admitted request or None."""
-        if not self.waiting or len(self.running) >= self.max_batch:
-            return None
-        req = self.waiting[0]
-        bucket = prefill_bucket(req.num_tokens)
-        if bucket > self.token_budget:
-            if not self.running:
-                raise ValueError(
-                    f"request {req.request_id}: prefill bucket {bucket} "
-                    f"exceeds token budget {self.token_budget}"
-                )
-            return None
-        need = self.pool.blocks_for(req.num_tokens)
-        blocks = self.pool.allocate(need)
-        if blocks is None:
-            # admission never preempts (that would churn): wait for decode
-            # to free blocks — unless nothing is running, in which case the
-            # request can never fit
-            if not self.running:
+    def _grow(self, req, need):
+        """Grow `req.blocks` to `need`, preempting arrival-YOUNGER sequences
+        (FCFS priority: an older request may reclaim a younger one's blocks,
+        never the reverse — age survives preemption/re-admission via
+        `arrival_seq`) when the pool is dry. Returns False if the row must
+        be deferred a step instead."""
+        while len(req.blocks) < need:
+            got = self.pool.allocate(1)
+            if got is not None:
+                req.blocks.extend(got)
+                continue
+            victim = max(
+                (r for r in self.running
+                 if r.arrival_seq > req.arrival_seq and r.blocks),
+                key=lambda r: r.arrival_seq, default=None,
+            )
+            if victim is not None:
+                self._preempt(victim)
+                continue
+            if not any(r.arrival_seq < req.arrival_seq
+                       for r in self.running):
+                # the oldest sequence holds every allocated block and still
+                # cannot grow: the pool cannot hold even one sequence — a
+                # config error, not a scheduling state
                 raise ValueError(
                     f"request {req.request_id}: needs {need} KV blocks but "
                     f"the pool only has {self.pool.num_free} free with no "
-                    "sequences running — raise num_blocks or shorten the "
-                    "request"
+                    "younger sequences to preempt — raise num_blocks or "
+                    "shorten the request"
                 )
-            return None
-        self.waiting.popleft()
-        req.blocks = blocks
-        req.state = RUNNING
-        self.running.append(req)
-        return req
+            return False
+        return True
 
-    def _grow_for_decode(self):
-        """Every running sequence is about to append one token at position
-        `num_cached`; allocate the next block where that crosses a block
-        boundary, preempting from the back of `running` when the pool is
-        dry. Returns the sequences that still hold their blocks."""
-        for req in list(self.running):
+    def schedule(self):
+        """Plan one mixed step. Returns the list of ScheduledRows (empty =
+        idle). Every running sequence gets its decode token or its next
+        prefill chunk (budget and pool permitting); waiting requests are
+        admitted FCFS into free lanes first."""
+        while self.waiting and len(self.running) < self.max_batch:
+            req = self.waiting.popleft()
+            req.state = RUNNING
+            self.running.append(req)
+
+        budget = self.token_budget
+        rows = []
+        # plan in arrival order: the oldest request gets first claim on the
+        # budget and on pool blocks (it can preempt any younger holder, so
+        # it always schedules or fails loudly — the no-livelock guarantee)
+        for req in sorted(self.running, key=lambda r: r.arrival_seq):
             if req not in self.running:
-                continue  # preempted by an earlier victim search
-            need = self.pool.blocks_for(req.num_cached + 1)
-            while len(req.blocks) < need:
-                got = self.pool.allocate(1)
-                if got is not None:
-                    req.blocks.extend(got)
-                    continue
-                victim = self.running[-1]
-                self._preempt(victim)
-                if victim is req:
-                    break
-        return list(self.running)
-
-    def schedule(self, prefill_bucket):
-        """One scheduling decision: ("prefill", [req]) | ("decode", reqs) |
-        ("idle", []). `prefill_bucket(n)` maps a prompt length to its padded
-        bucket (the engine passes inference's _pick_bucket)."""
-        want_prefill = self.waiting and (
-            not self.running
-            or self._decodes_since_prefill >= self.prefill_interval
-        )
-        if want_prefill:
-            req = self._try_admit(prefill_bucket)
-            if req is not None:
-                self._decodes_since_prefill = 0
-                return "prefill", [req]
-        if self.running:
-            batch = self._grow_for_decode()
-            if batch:
-                self._decodes_since_prefill += 1
-                return "decode", batch
-            # everything got preempted back to waiting; prefill next turn
-            return self.schedule(prefill_bucket)
-        return "idle", []
+                continue  # preempted while an earlier row grew its blocks
+            pending = req.num_pending
+            if pending == 1:
+                # decode row (also a prefill's final 1-token chunk): always
+                # scheduled — decode latency is never gated on the budget
+                count = 1
+            else:
+                count = min(pending, self.prefill_chunk, budget)
+                if count < 1:
+                    continue  # budget spent; this chunk waits a step
+            start = req.num_cached
+            if not self._grow(req, self.pool.blocks_for(start + count)):
+                continue  # deferred — its budget share stays available
+            if pending > 1:
+                # budget is charged only for rows that actually scheduled,
+                # so a deferred/preempted chunk's share flows to later rows
+                budget -= count
+            rows.append(ScheduledRow(req, start, count, emit=count == pending))
+        return rows

@@ -1,0 +1,173 @@
+"""Chunked-prefill scheduler/engine edge cases.
+
+Satellites from the ragged-paged-attention issue: admission exactly at the
+token budget lives in test_serving_engine.py; here: preemption of a
+half-prefilled / half-decoded request (recompute must replay already-emitted
+chunks WITHOUT re-emitting their tokens), zero-waiting-queue mixed steps,
+and chunk accounting across replays.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.serving import BlockPool, LLMEngine
+from paddle_tpu.serving.scheduler import Request, Scheduler
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, attn_impl="xla", dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(lengths, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, (n,)).tolist() for n in lengths]
+
+
+def _reference(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int64))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0)
+    return out.numpy()[0, len(prompt):].tolist()
+
+
+def test_replay_of_preempted_request_does_not_reemit():
+    """A preempted request with emitted tokens replays prompt+outputs in
+    chunks: every replay row is emit=False until the chunk that reaches the
+    last pending position — which samples the NEXT token, not a repeat."""
+    pool = BlockPool(num_blocks=64, num_layers=1, block_size=4, num_heads=1,
+                     head_dim=4)
+    sched = Scheduler(pool, max_batch=2, token_budget=4, prefill_chunk=4)
+    req = Request([1] * 9, max_new_tokens=8)
+    sched.add(req)
+    # prefill 9 tokens in chunks of 4: emit only on the last
+    emits = []
+    for _ in range(3):
+        (row,) = sched.schedule()
+        emits.append(row.emit)
+        req.num_cached += row.count
+    assert emits == [False, False, True]
+    req.output_ids.extend([5, 6])  # two tokens emitted (engine would do it)
+    req.num_cached = req.num_tokens - 1  # decode steady state
+    sched._preempt(req)
+    assert req.num_cached == 0 and not req.blocks
+    # replay: 9 + 2 = 11 pending tokens -> chunks 4, 4, 3; only the chunk
+    # reaching position 10 (the last emitted token, fed back in) emits — and
+    # what it samples is output token #3, never a re-emission of 5 or 6
+    emits, counts = [], []
+    while req.num_pending > 1:
+        (row,) = sched.schedule()
+        assert row.start == req.num_cached
+        emits.append(row.emit)
+        counts.append(row.count)
+        req.num_cached += row.count
+    assert counts == [4, 4, 3]
+    assert emits == [False, False, True]
+    assert req.preemptions == 1 and req.output_ids == [5, 6]
+
+
+def test_engine_preempts_mid_serve_token_streams_exact(model):
+    """Step-by-step streams under preemption pressure: every request's
+    emitted token sequence equals its final output_ids equals the
+    sequential reference — replays never duplicate or drop a token."""
+    prompts = _prompts((6, 7, 9), seed=1)
+    engine = LLMEngine(model, block_size=4, num_blocks=10, max_batch=4,
+                       max_seq_len=64, prefill_chunk=4)
+    rids = [engine.add_request(p, max_new_tokens=10, temperature=0.0)
+            for p in prompts]
+    streams = {rid: [] for rid in rids}
+    while engine.has_unfinished():
+        for out in engine.step():
+            streams[out.request_id].append(out.token)
+    assert engine.metrics.counters["preemptions"] >= 1
+    for rid, p in zip(rids, prompts):
+        ref = _reference(model, p, 10)
+        assert streams[rid] == ref
+        assert engine.get_request(rid).output_ids == ref
+    assert engine.pool.num_free == engine.pool.num_blocks - 1
+
+
+def test_zero_waiting_queue_mixed_steps(model):
+    """With the waiting queue empty, a long prompt keeps chunking WHILE the
+    other lane decodes — mixed steps with num_waiting == 0, and the decode
+    lane emits a token in every one of them."""
+    p_short, p_long = _prompts((4, 40), seed=2)
+    engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                       prefill_chunk=8)
+    r1 = engine.add_request(p_short, max_new_tokens=12, temperature=0.0)
+    engine.step()  # admit + prefill r1 (emits its first token)
+    r2 = engine.add_request(p_long, max_new_tokens=4, temperature=0.0)
+    mixed_with_empty_queue = 0
+    decode_progress = []
+    while engine.get_request(r2).num_pending > 1 or not engine.get_request(
+            r2).output_ids:
+        n1 = len(engine.get_request(r1).output_ids)
+        engine.step()
+        if (engine.metrics.gauges["num_waiting"] == 0
+                and len(engine.get_request(r1).output_ids) == n1 + 1):
+            mixed_with_empty_queue += 1
+            decode_progress.append(True)
+    # 40-token prompt at chunk 8 -> 5 chunk steps, all riding with r1's
+    # decode rows after admission emptied the queue
+    assert mixed_with_empty_queue >= 4
+    while engine.has_unfinished():
+        engine.step()
+    assert engine.get_request(r1).output_ids == _reference(model, p_short, 12)
+    assert engine.get_request(r2).output_ids == _reference(model, p_long, 4)
+
+
+def test_preemption_priority_is_arrival_order_not_list_position():
+    """A preempted-and-readmitted request sits at the END of the running
+    list but keeps its arrival age: an arrival-younger sequence must defer
+    rather than victimize it, while the arrival-oldest may still reclaim
+    from the true youngest."""
+    pool = BlockPool(num_blocks=5, num_layers=1, block_size=4, num_heads=1,
+                     head_dim=4)  # 4 usable
+    sched = Scheduler(pool, max_batch=3, token_budget=12, prefill_chunk=4)
+    r1, r2, r3 = (Request([1] * 4, max_new_tokens=8) for _ in range(3))
+    for r in (r1, r2, r3):
+        sched.add(r)
+    rows = sched.schedule()  # one block each, 1 free
+    assert [w.req for w in rows] == [r1, r2, r3]
+    for w in rows:
+        w.req.num_cached += w.count
+    # simulate r2 having been preempted + re-admitted: list-youngest now,
+    # but still arrival-older than r3
+    sched.running.remove(r2)
+    sched.running.append(r2)
+    # r3 wants 3 blocks: takes the free one, then the pool is dry — r2 (the
+    # list-tail) is NOT fair game, and r3 has no arrival-younger victim
+    assert sched._grow(r3, 3) is False
+    assert r2.blocks and r2.preemptions == 0
+    # the arrival-oldest r1 reclaims from the arrival-youngest holder (r3)
+    assert sched._grow(r1, 3) is True
+    assert r3.preemptions == 1 and r3.state == "waiting"
+    assert r2.preemptions == 0
+
+
+def test_scheduler_defers_younger_prefill_when_pool_dry():
+    """FCFS block priority: when the pool is dry, a younger mid-prefill row
+    defers (no self-thrash) while an older sequence keeps its blocks and
+    advances."""
+    pool = BlockPool(num_blocks=5, num_layers=1, block_size=4, num_heads=1,
+                     head_dim=4)  # 4 usable blocks
+    sched = Scheduler(pool, max_batch=2, token_budget=32, prefill_chunk=8)
+    r1 = Request([1] * 12, max_new_tokens=8)   # 3 blocks at 12 tokens
+    r2 = Request([1] * 8, max_new_tokens=8)
+    sched.add(r1)
+    sched.add(r2)
+    rows = sched.schedule()  # r1 chunk 8 (2 blocks) + r2 chunk 8 (2 blocks)
+    assert [(w.req, w.count) for w in rows] == [(r1, 8), (r2, 8)]
+    for w in rows:
+        w.req.num_cached += w.count
+    # r1's last chunk needs a 3rd block; pool is dry -> r2 (younger, holds
+    # blocks) is preempted, r1 proceeds, r2 replays later
+    rows = sched.schedule()
+    assert [(w.req, w.count, w.emit) for w in rows] == [(r1, 4, True)]
+    assert r2.state == "waiting" and r2.num_cached == 0
+    assert r2.preemptions == 1

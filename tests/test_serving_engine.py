@@ -1,12 +1,15 @@
 """paddle_tpu.serving: continuous-batching engine over the paged KV cache.
 
-Acceptance criteria from the serving issue: paged-cache generation matches
+Acceptance criteria from the serving issues: paged-cache generation matches
 sequential `GPT.generate` greedy outputs token-for-token while serving
 overlapping requests of different prompt lengths; requests admitted
 mid-decode join the running batch; preemption under a tiny pool frees and
-recomputes correctly; and the whole workload compiles at most once per
-(prefill bucket, decode) shape — watched by the engine's `jit_traces`
-counter, which increments inside the traced step body (trace time only).
+recomputes correctly; and the whole workload — any prompt lengths, chunked
+prefill mixed with decode — compiles exactly TWO programs, watched by the
+engine's `jit_traces` counter, which increments inside the traced step body
+(trace time only). Chunked-prefill edge cases live in
+test_serving_chunked.py; Pallas-kernel/fallback parity in
+test_paged_attention_kernel.py.
 """
 import numpy as np
 import pytest
@@ -52,16 +55,34 @@ def test_paged_matches_generate_greedy_overlapping(model):
     assert engine.pool.num_free == engine.pool.num_blocks - 1  # all freed
 
 
-def test_distinct_buckets_compile_once_each(model):
-    """Prompt lengths spanning two buckets compile two prefill programs and
-    ONE decode program — re-serving the same shapes adds zero traces."""
+def test_mixed_lengths_compile_two_programs(model):
+    """Chunked prefill retired the per-bucket programs: prompts of ANY
+    length share one mixed (max_batch, prefill_chunk) program plus one
+    decode (max_batch, 1) program — re-serving different lengths adds zero
+    traces."""
     engine = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64)
-    prompts = _prompts((4, 20), seed=1)  # buckets 16 and 32
-    engine.generate(prompts, max_new_tokens=4, temperature=0.0)
-    assert engine.metrics.counters["jit_traces"] == 3
-    engine.generate(_prompts((7, 30), seed=2), max_new_tokens=4,
+    prompts = _prompts((4, 20), seed=1)
+    outs = engine.generate(prompts, max_new_tokens=4, temperature=0.0)
+    for p, o in zip(prompts, outs):
+        assert o == _reference(model, p, 4)
+    assert engine.metrics.counters["jit_traces"] == 2
+    engine.generate(_prompts((7, 30, 44), seed=2), max_new_tokens=4,
                     temperature=0.0)
-    assert engine.metrics.counters["jit_traces"] == 3  # no recompiles
+    assert engine.metrics.counters["jit_traces"] == 2  # no recompiles
+
+
+def test_long_prompt_prefills_in_chunks(model):
+    """A prompt longer than prefill_chunk streams into the arena a chunk at
+    a time — several mixed steps before the first token — and still matches
+    the sequential reference exactly (chunk boundaries change no math)."""
+    (p,) = _prompts((29,), seed=7)
+    engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                       prefill_chunk=8)
+    (out,) = engine.generate([p], max_new_tokens=5, temperature=0.0)
+    assert out == _reference(model, p, 5)
+    # 29 tokens at chunk 8 -> 4 mixed steps (the last emits token 1)
+    assert engine.metrics.counters["mixed_steps"] == 4
+    assert engine.metrics.counters["jit_traces"] == 2
 
 
 def test_staggered_add_request_mid_decode(model):
@@ -133,13 +154,18 @@ def test_request_validation(model):
         engine.add_request([], max_new_tokens=4)
     with pytest.raises(ValueError, match="max_new_tokens"):
         engine.add_request([1, 2], max_new_tokens=0)
-    # worst-case recompute prefill (prompt + max_new - 1 after a preempt)
-    # must fit the token budget, or a preemption could wedge the queue
+    with pytest.raises(ValueError, match="token_budget"):
+        LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                  token_budget=0)
+    # chunking removed the bucketed engine's token-budget admission limit:
+    # a prompt (or post-preempt recompute) larger than the budget streams
+    # through in chunks instead of being rejected
     tight = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
-                      token_budget=16)
-    with pytest.raises(ValueError, match="token budget"):
-        tight.add_request(list(range(10)), max_new_tokens=10)  # worst 19 -> 32
-    tight.add_request(list(range(10)), max_new_tokens=7)  # worst 16: fits
+                      token_budget=8)
+    p = _prompts((20,), seed=11)[0]
+    (out,) = tight.generate([p], max_new_tokens=6, temperature=0.0)
+    assert out == _reference(model, p, 6)
+    assert tight.metrics.counters["mixed_steps"] >= 3  # 20 tokens / chunk 8
 
 
 def test_generate_and_stream_release_requests(model):
@@ -175,10 +201,12 @@ def test_metrics_schedule_view_and_snapshot(model):
     json.dumps(snap)  # JSON-able end to end
     assert snap["counters"]["generated_tokens"] == 4
     assert "decode_step" in snap["latency"]
+    assert "ttft" in snap["latency"]  # time-to-first-token, for bench
+    assert snap["latency"]["ttft"]["p95_ms"] >= snap["latency"]["ttft"]["p50_ms"]
     view = engine.metrics.schedule_view()
     st = view["serving-engine"]
     assert st["span_ms"] > 0 and 0 < st["utilization"] <= 1.0
-    assert st["n_ops"] == snap["counters"]["prefill_steps"] + snap[
+    assert st["n_ops"] == snap["counters"]["mixed_steps"] + snap[
         "counters"]["decode_steps"]
     buf = io.StringIO()
     xplane.print_schedule_analysis(view, file=buf)
@@ -190,57 +218,98 @@ def test_block_pool_alloc_free_copy():
 
     pool = BlockPool(num_blocks=6, num_layers=2, block_size=4, num_heads=2,
                      head_dim=8)
+    # head-major arena: [layers, heads, blocks, block_size, head_dim]
+    assert pool.k.shape == (2, 2, 6, 4, 8)
     assert pool.num_free == 5  # block 0 reserved as null
     a = pool.allocate(3)
     assert a is not None and 0 not in a
     assert pool.allocate(3) is None  # only 2 left
-    pool.k = pool.k.at[a[0]].set(1.0)
+    pool.k = pool.k.at[:, :, a[0]].set(1.0)
     b = pool.allocate(1)
     pool.copy_blocks([a[0]], [b[0]])
-    assert float(jnp.sum(pool.k[b[0]])) == float(jnp.sum(pool.k[a[0]]))
+    assert float(jnp.sum(pool.k[:, :, b[0]])) == float(
+        jnp.sum(pool.k[:, :, a[0]]))
     pool.free(a + b)
     assert pool.num_free == 5
     with pytest.raises(ValueError, match="null"):
         pool.free([0])
 
 
-def test_scheduler_fcfs_and_token_budget():
-    """Admission is FCFS and respects the token budget; decode has priority
-    between admissions."""
+def test_scheduler_fcfs_mixed_rows_and_token_budget():
+    """One mixed plan per step: FCFS lane admission, decode rows always
+    ride, prefill chunks split under the per-step token budget."""
     pool = BlockPool(num_blocks=64, num_layers=1, block_size=4, num_heads=1,
                      head_dim=4)
-    sched = Scheduler(pool, max_batch=2, token_budget=16, prefill_interval=2)
-    bucket = lambda n: 16 if n <= 16 else 32
-    r1 = Request([1] * 4, max_new_tokens=4)
+    sched = Scheduler(pool, max_batch=2, token_budget=6, prefill_chunk=6)
+    r1 = Request([1] * 10, max_new_tokens=4)
     r2 = Request([1] * 4, max_new_tokens=4)
     r3 = Request([1] * 4, max_new_tokens=4)
     for r in (r1, r2, r3):
         sched.add(r)
-    kind, picked = sched.schedule(bucket)
-    assert kind == "prefill" and picked[0] is r1
-    r1.num_cached = 4
-    # decode-priority: r2 must wait prefill_interval decode steps
-    kind, _ = sched.schedule(bucket)
-    assert kind == "decode"
-    r1.num_cached += 1
-    kind, _ = sched.schedule(bucket)
-    assert kind == "decode"
-    r1.num_cached += 1
-    kind, picked = sched.schedule(bucket)
-    assert kind == "prefill" and picked[0] is r2  # FCFS order
-    r2.num_cached = 4
-    # max_batch=2: r3 cannot be admitted while r1, r2 run
-    for _ in range(4):
-        kind, _ = sched.schedule(bucket)
-        assert kind == "decode"
-        for r in (r1, r2):
-            r.num_cached += 1
+    # max_batch=2 lanes: r1 gets a full 6-token chunk, r2 (FCFS next) gets
+    # nothing this step (budget spent); r3 waits for a lane
+    rows = sched.schedule()
+    assert [(w.req, w.start, w.count, w.emit) for w in rows] == [
+        (r1, 0, 6, False)
+    ]
+    assert r2.state == "running" and r3.state == "waiting"
+    r1.num_cached += 6
+    # next step: r1's last 4 prompt tokens (emits) + r2's full 4-token
+    # prompt would exceed budget 6 -> r2 gets the 2 remaining tokens
+    rows = sched.schedule()
+    assert [(w.req, w.count, w.emit) for w in rows] == [
+        (r1, 4, True), (r2, 2, False)
+    ]
+    for w in rows:
+        w.req.num_cached += w.count
+    r1.output_ids.append(7)  # r1's first token emitted -> decode row next
+    # mixed step: r1 decodes (never budget-gated) while r2 finishes prefill
+    rows = sched.schedule()
+    assert [(w.req, w.count, w.emit) for w in rows] == [
+        (r1, 1, True), (r2, 2, True)
+    ]
+    for w in rows:
+        w.req.num_cached += w.count
     sched.finish(r1)
     sched.finish(r2)
-    kind, picked = sched.schedule(bucket)
-    assert kind == "prefill" and picked[0] is r3
-    # over-budget head blocks with nothing running -> loud error
-    sched2 = Scheduler(pool, max_batch=2, token_budget=8, prefill_interval=1)
-    sched2.add(Request([1] * 12, max_new_tokens=1))
-    with pytest.raises(ValueError, match="token budget"):
-        sched2.schedule(bucket)
+    # freed lanes: r3 admitted FCFS, prompt fits one chunk
+    rows = sched.schedule()
+    assert [(w.req, w.count, w.emit) for w in rows] == [(r3, 4, True)]
+
+
+def test_scheduler_admission_exactly_at_token_budget():
+    """Chunk packing fills the budget exactly: three rows' chunks sum to
+    token_budget with the tail row truncated, never overshooting."""
+    pool = BlockPool(num_blocks=64, num_layers=1, block_size=4, num_heads=1,
+                     head_dim=4)
+    sched = Scheduler(pool, max_batch=4, token_budget=12, prefill_chunk=5)
+    reqs = [Request([1] * n, max_new_tokens=2) for n in (5, 5, 9, 8)]
+    for r in reqs:
+        sched.add(r)
+    rows = sched.schedule()
+    assert [(w.req, w.count) for w in rows] == [
+        (reqs[0], 5), (reqs[1], 5), (reqs[2], 2)  # 5+5+2 == budget 12
+    ]
+    assert sum(w.count for w in rows) == 12
+    for w in rows:
+        w.req.num_cached += w.count
+        if w.emit:
+            w.req.output_ids.append(3)
+    # next step: the two finished-prefill rows decode (not budget-gated)
+    # while the mid-prompt rows take chunk-capped budget shares
+    rows = sched.schedule()
+    assert [(w.req, w.count, w.emit) for w in rows] == [
+        (reqs[0], 1, True), (reqs[1], 1, True),
+        (reqs[2], 5, False), (reqs[3], 5, False),
+    ]
+
+
+def test_scheduler_pool_too_small_fails_loudly():
+    """The oldest sequence failing to grow with no younger victims is a
+    config error, not a livelock."""
+    pool = BlockPool(num_blocks=3, num_layers=1, block_size=4, num_heads=1,
+                     head_dim=4)
+    sched = Scheduler(pool, max_batch=2, token_budget=64, prefill_chunk=64)
+    sched.add(Request([1] * 12, max_new_tokens=1))  # needs 3 blocks, pool has 2
+    with pytest.raises(ValueError, match="KV blocks"):
+        sched.schedule()
