@@ -266,8 +266,11 @@ class Model:
         in_sh = (pspecs, bspecs, ospecs, ns(P()), ns(P())) + batch_in
         # outputs (for metrics) take compiler-chosen shardings (None)
         out_sh = (ns(P()), None, bspecs, pspecs, ospecs)
+        from ..parallel.spmd import mesh_donate_argnums
+
         return jax.jit(
-            step, in_shardings=in_sh, out_shardings=out_sh, donate_argnums=(0, 2)
+            step, in_shardings=in_sh, out_shardings=out_sh,
+            donate_argnums=mesh_donate_argnums((0, 2)),
         )
 
     def _make_eval_step(self, n_inputs, n_labels, with_loss):

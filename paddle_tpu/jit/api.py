@@ -95,7 +95,7 @@ class StaticFunction:
             return any(StaticFunction._contains_tensor(x) for x in v)
         if isinstance(v, dict):
             return any(StaticFunction._contains_tensor(x) for x in v.values())
-        return isinstance(v, (Tensor, np.ndarray))
+        return isinstance(v, (Tensor, np.ndarray, jax.Array))
 
     def _key(self, args, kwargs=None):
         key = []
@@ -114,10 +114,12 @@ class StaticFunction:
             v = kwargs[k]
             if isinstance(v, Tensor):
                 key.append((k, tuple(v.shape), str(np.dtype(v.dtype))))
-            elif isinstance(v, np.ndarray):
+            elif isinstance(v, (np.ndarray, jax.Array)):
                 # keyed like a Tensor: repr() truncates large arrays, so two
-                # different arrays could collide on one cache key
-                key.append((k, v.shape, str(v.dtype)))
+                # different arrays could collide on one cache key (raw
+                # jax.Array kwargs would additionally be baked into the
+                # traced closure as constants if left on the repr path)
+                key.append((k, tuple(v.shape), str(np.dtype(v.dtype))))
             else:
                 if self._contains_tensor(v):
                     raise TypeError(
@@ -151,7 +153,7 @@ class StaticFunction:
         # first call's values for every later same-shape kwarg
         kw_names = tuple(sorted(
             k for k, v in (kwargs or {}).items()
-            if isinstance(v, (Tensor, np.ndarray))
+            if isinstance(v, (Tensor, np.ndarray, jax.Array))
         ))
         if entry is None:
             training = layer.training
@@ -198,7 +200,7 @@ class StaticFunction:
         # shape/dtype keyed, value passed per call
         kw_names = tuple(sorted(
             k for k, v in kwargs.items()
-            if isinstance(v, (Tensor, np.ndarray))
+            if isinstance(v, (Tensor, np.ndarray, jax.Array))
         ))
         if entry is None:
             from ..core import autograd

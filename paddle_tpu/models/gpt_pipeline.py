@@ -18,6 +18,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.pipeline import gpipe, make_pipeline_loss, stack_stage_params
+from ..parallel.spmd import mesh_donate_argnums as _mesh_donate
 
 
 def _init_block(key, H, F, n_heads):
@@ -219,7 +220,7 @@ def make_pipelined_gpt(cfg, mesh, n_microbatches, schedule="gpipe"):
         jax.jit,
         in_shardings=(pspecs, ns(P("dp")), ns(P("dp")), ns(P())),
         out_shardings=(ns(P()), pspecs),
-        donate_argnums=(0,),
+        donate_argnums=_mesh_donate((0,)),
     )
     def train_step(p, ids, labels, lr):
         loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)

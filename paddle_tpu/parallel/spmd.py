@@ -24,6 +24,17 @@ from ..core.functional import functional_call, state_dict_arrays
 from ..core.tensor import Tensor
 
 
+def mesh_donate_argnums(argnums):
+    """donate_argnums for a MESH-SHARDED jit, disabled on the CPU host
+    platform. The fake-device CPU mesh (xla_force_host_platform_device_count,
+    tests/_cpu_mesh.py) miscompiles donation of sharded buffers in this
+    jaxlib: outputs alias freed inputs, so the loss trajectory silently
+    drifts from step 2 and the process segfaults a few steps later
+    (reproduced via test_distributed_spmd zs=2). Real accelerator backends
+    keep the donation — it halves peak param+optimizer-state memory."""
+    return () if jax.default_backend() == "cpu" else tuple(argnums)
+
+
 def _largest_divisible_dim(shape, degree):
     best = None
     for i, s in enumerate(shape):
@@ -280,7 +291,7 @@ class ShardedTrainStep:
             step,
             in_shardings=in_shardings,
             out_shardings=out_shardings,
-            donate_argnums=(0, 2),
+            donate_argnums=mesh_donate_argnums((0, 2)),
         )
 
     def __call__(self, params, buffers, opt_state, lr, key, *batch):
@@ -404,7 +415,7 @@ class LocalSGDTrainStep:
             step,
             in_shardings=(rspec, bspec, ospec, ns(P()), ns(P()), ns(P())) + batch_in,
             out_shardings=(ns(P()), rspec, bspec, ospec, ns(P())),
-            donate_argnums=(0, 2),
+            donate_argnums=mesh_donate_argnums((0, 2)),
         )
 
     def __call__(self, params, buffers, opt_state, count, lr, key, *batch):

@@ -104,8 +104,13 @@ def interval_union_stats(intervals, to_ms=1.0, top_gaps=10, min_span=1e-12,
     between merged runs become top_gaps. Units are whatever the caller uses
     (ps for xplane captures, seconds for serving.ServingMetrics); `to_ms`
     converts them to milliseconds and `min_span` floors the utilization
-    denominator in native units."""
+    denominator in native units. An empty interval list (e.g. a metrics
+    scrape before the first engine step) yields a zeroed record rather
+    than an error."""
     iv = sorted(intervals)
+    if not iv:
+        return {"span_ms": 0.0, "busy_ms": 0.0, "idle_ms": 0.0,
+                "utilization": 0.0, "n_ops": 0, "top_gaps": []}
     span_start = iv[0][0]
     span_end = max(e for _, e, _ in iv)
     busy = 0

@@ -20,8 +20,22 @@ Quickstart::
         print(out.token, out.finished)                       # overlaps rid
     print(engine.get_request(rid).output_ids)
     print(engine.metrics.snapshot())
+
+The async serving frontend (`AsyncLLMEngine` in frontend.py) runs the step
+loop in a background thread and fans tokens out to per-request asyncio
+streams with admission control, deadlines, cancellation, and graceful
+drain; `ServingServer` (server.py, stdlib-only) exposes it over HTTP:
+OpenAI-style `/v1/completions` with SSE streaming, `/healthz`, and a
+Prometheus `/metrics` endpoint. See README "HTTP serving quickstart".
 """
 from .block_pool import BlockPool, PagedState, paged_attention  # noqa: F401
 from .engine import LLMEngine, StepOutput  # noqa: F401
+from .frontend import (  # noqa: F401
+    AsyncLLMEngine,
+    EngineClosedError,
+    EngineOverloadedError,
+    RequestStream,
+)
 from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
+from .server import ServingServer  # noqa: F401

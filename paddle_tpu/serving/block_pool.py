@@ -121,6 +121,10 @@ class BlockPool:
         self.v = jnp.zeros(shape, dt)
         # block 0 reserved as the null/scratch block
         self._free = list(range(self.num_blocks - 1, 0, -1))
+        # live (handed-out) block ids: with finish/preempt/abort all freeing
+        # blocks, a double free would put one block on the free list twice
+        # and later alias two sequences onto it — caught loudly instead
+        self._allocated = set()
 
     @property
     def num_free(self):
@@ -135,12 +139,16 @@ class BlockPool:
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
         return out
 
     def free(self, blocks):
         for b in blocks:
             if b == 0:
                 raise ValueError("cannot free the null block")
+            if b not in self._allocated:
+                raise ValueError(f"double free of block {b}")
+            self._allocated.discard(b)
             self._free.append(b)
 
     def copy_blocks(self, src, dst):

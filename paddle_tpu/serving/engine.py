@@ -104,18 +104,58 @@ class LLMEngine:
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=request_id)
+        return self.add(req)
+
+    def validate(self, req):
+        """Admission-time request validation, shared by `add` and the async
+        frontend's `submit` (which must reject bad requests BEFORE they
+        reach the engine thread). Raises ValueError on a request that could
+        never complete: too long for the model, or needing more KV blocks
+        at its worst case than the pool owns — without this check such a
+        request is accepted, becomes the oldest running sequence, and the
+        scheduler's no-livelock error then kills the whole serve instead
+        of the one offender."""
         if req.num_tokens + req.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"request {req.request_id}: prompt {req.num_tokens} + "
                 f"{req.max_new_tokens} new tokens exceeds max_seq_len "
                 f"{self.max_seq_len}"
             )
+        # worst-case cached tokens: everything but the final sampled token
+        need = self.pool.blocks_for(req.num_tokens + req.max_new_tokens - 1)
+        if need > self.pool.num_blocks - 1:
+            raise ValueError(
+                f"request {req.request_id}: needs up to {need} KV blocks "
+                f"but the pool only has {self.pool.num_blocks - 1} usable "
+                "— raise num_blocks or shorten the request"
+            )
+
+    def add(self, req):
+        """Enqueue a pre-built Request (the async frontend constructs and
+        validates Requests off the engine thread, then hands them over
+        here). Returns the request id."""
+        self.validate(req)
         if req.request_id in self._requests:
             raise ValueError(f"duplicate request id {req.request_id}")
         self._requests[req.request_id] = req
         self.scheduler.add(req)
         self.metrics.inc("requests_added")
         return req.request_id
+
+    def abort(self, request_id):
+        """Cancel a request in any live state (queued, mid-prefill,
+        decoding, or preempted awaiting re-admission): the scheduler drops
+        it from its queues, its KV blocks return to the pool, and its host
+        record is released. The request object itself stays valid — already
+        emitted `output_ids` remain readable by whoever holds it. Returns
+        True if a live request was aborted, False if the id is unknown or
+        the request already finished."""
+        req = self._requests.get(request_id)
+        if req is None or req.finished:
+            return False
+        self.scheduler.abort(req)
+        del self._requests[request_id]
+        return True
 
     def has_unfinished(self):
         return self.scheduler.has_unfinished()

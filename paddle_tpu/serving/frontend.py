@@ -1,0 +1,391 @@
+"""AsyncLLMEngine: the asyncio frontend over the synchronous LLMEngine.
+
+The engine step loop (jitted device steps + host-side scheduling) runs in
+ONE background thread that owns the engine outright; the asyncio side never
+touches the scheduler. The two talk through
+
+- a thread-safe **command queue** into the engine thread (`add`, `abort`,
+  `stop`) drained between steps, so every scheduler mutation happens on the
+  engine thread — continuous batching needs no locks; and
+- `loop.call_soon_threadsafe` **event dispatch** out of it: each step's
+  tokens fan out to per-request bounded `asyncio.Queue`s on the event loop.
+
+Backpressure is lossless and never reaches the scheduler: when a consumer
+falls behind and its queue fills, the producer stops enqueueing for that
+stream (sticky `overflow`, counted in `backpressure_drops`) instead of
+blocking — the authoritative token record is the request's own
+`output_ids`, so the consumer drains the queue's ordered prefix and then
+catches up by index. A stalled client can therefore never stall the step
+loop or any other request's stream.
+
+Robustness contract (tested in tests/test_serving_frontend.py):
+
+- **admission control** — at most ``engine.max_batch + max_waiting``
+  requests in flight; beyond that `submit` raises `EngineOverloadedError`
+  (HTTP 429 in serving/server.py) instead of queueing unboundedly;
+- **deadlines** — a per-request ``timeout_s`` aborts in-flight work from
+  the engine thread (KV blocks freed mid-generation, stream finishes with
+  ``finish_reason="timeout"``);
+- **cancellation** — `abort()` (wired to client disconnects by the server)
+  propagates into `LLMEngine.abort`, which removes the request from the
+  scheduler in any state and returns its blocks to the pool;
+- **graceful drain** — `shutdown(drain=True)` stops admitting, lets
+  in-flight requests finish (or hard-aborts them after ``timeout_s``),
+  then exits the engine thread.
+"""
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+
+_END = "__end__"
+
+
+class EngineOverloadedError(RuntimeError):
+    """The bounded wait queue is full — retry later (HTTP 429)."""
+
+
+class EngineClosedError(RuntimeError):
+    """The engine is draining or stopped — no new admissions (HTTP 503)."""
+
+
+class RequestStream:
+    """One request's async token stream (``async for tok in stream``).
+
+    Tokens arrive through a bounded queue; if the consumer lags until the
+    queue fills, delivery switches to catch-up reads from the request's
+    `output_ids` (see module docstring) — order-exact, nothing dropped,
+    nothing duplicated. After iteration ends, `finish_reason` is one of
+    ``"length" | "stop" | "timeout" | "cancelled" | "error"`` (``error``
+    carries detail in `error`).
+    """
+
+    def __init__(self, request_id, req, maxsize):
+        self.request_id = request_id
+        self.req = req                    # engine Request: output_ids is
+        self.queue = asyncio.Queue(maxsize)  # the authoritative record
+        self.wake = asyncio.Event()
+        self.done = asyncio.Event()
+        self.overflow = False             # sticky: producer gave up on the
+        self.finished = False             # queue, consumer reads by index
+        self.finish_reason = None
+        self.error = None
+        self.consumed = 0                 # tokens yielded so far
+
+    async def tokens(self):
+        while True:
+            if not self.overflow:
+                item = await self.queue.get()
+                if item is _END:
+                    return
+                self.consumed += 1
+                yield item
+                continue
+            # overflow mode: drain the queue's ordered prefix first, then
+            # catch up from output_ids by index
+            try:
+                item = self.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                item = None
+            if item is not None:
+                if item is _END:
+                    return
+                self.consumed += 1
+                yield item
+                continue
+            out = self.req.output_ids
+            if self.consumed < len(out):
+                tok = out[self.consumed]
+                self.consumed += 1
+                yield tok
+                continue
+            if self.finished:
+                return
+            # every engine-thread token append is followed by a dispatch
+            # that sets `wake`, so clearing here cannot lose a wakeup
+            self.wake.clear()
+            if self.consumed < len(self.req.output_ids) or self.finished:
+                continue
+            await self.wake.wait()
+
+    __aiter__ = tokens
+
+    async def collect(self):
+        """Drain the whole stream; returns (token_list, finish_reason)."""
+        toks = []
+        async for t in self.tokens():
+            toks.append(t)
+        return toks, self.finish_reason
+
+
+class AsyncLLMEngine:
+    def __init__(self, engine, max_waiting=64, stream_queue_size=64,
+                 default_timeout_s=None, idle_poll_s=0.02):
+        self.engine = engine
+        self.metrics = engine.metrics
+        self.max_waiting = int(max_waiting)
+        self.stream_queue_size = max(1, int(stream_queue_size))
+        self.default_timeout_s = default_timeout_s
+        self._idle_poll_s = float(idle_poll_s)
+        self._cmds = queue.Queue()
+        self._streams = {}                # rid -> RequestStream (loop side)
+        self._inflight = 0
+        self._closed = False
+        self._loop = None
+        self._thread = None
+        self._stopped = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self):
+        """Bind to the running event loop and start the engine thread."""
+        if self._thread is not None:
+            return self
+        self._loop = asyncio.get_running_loop()
+        self._stopped = asyncio.Event()
+        self._thread = threading.Thread(
+            target=self._engine_loop, name="paddle-tpu-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def started(self):
+        return self._thread is not None
+
+    @property
+    def inflight(self):
+        return self._inflight
+
+    def stop_admitting(self):
+        """Flip admission off (submit raises EngineClosedError) without
+        stopping the step loop — the load-balancer drain pattern: stop
+        taking traffic first, `shutdown()` once drained."""
+        self._closed = True
+
+    async def shutdown(self, drain=True, timeout_s=30.0):
+        """Graceful drain: stop admitting, finish (or, past ``timeout_s``,
+        abort) in-flight requests, then join the engine thread. With
+        ``drain=False`` everything in flight is aborted immediately."""
+        self._closed = True
+        if self._thread is None:
+            return
+        self._cmds.put(("stop", bool(drain)))
+        if drain and timeout_s is not None:
+            try:
+                await asyncio.wait_for(self._stopped.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                self._cmds.put(("stop", False))
+                await self._stopped.wait()
+        else:
+            await self._stopped.wait()
+        self._thread.join(timeout=5.0)
+
+    # -- request API (event-loop thread) -----------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens=16, temperature=0.0,
+               eos_token_id=None, timeout_s=None, request_id=None):
+        """Admit one request; returns its RequestStream. Raises
+        EngineClosedError when draining/stopped, EngineOverloadedError when
+        the bounded wait queue is full, ValueError on a bad request —
+        all BEFORE the request reaches the engine thread."""
+        from .scheduler import Request
+
+        if self._closed:
+            raise EngineClosedError("engine is draining; not admitting")
+        if self._thread is None:
+            raise RuntimeError("AsyncLLMEngine.start() has not been awaited")
+        limit = self.engine.max_batch + self.max_waiting
+        if self._inflight >= limit:
+            self.metrics.inc("requests_rejected")
+            raise EngineOverloadedError(
+                f"{self._inflight} requests in flight (limit {limit}: "
+                f"max_batch {self.engine.max_batch} + max_waiting "
+                f"{self.max_waiting})"
+            )
+        req = Request(prompt_ids, max_new_tokens=max_new_tokens,
+                      temperature=temperature, eos_token_id=eos_token_id,
+                      request_id=request_id)
+        self.engine.validate(req)
+        if req.request_id in self._streams:
+            raise ValueError(f"duplicate request id {req.request_id}")
+        st = RequestStream(req.request_id, req, self.stream_queue_size)
+        self._streams[req.request_id] = st
+        self._inflight += 1
+        self.metrics.set_gauge("frontend_inflight", self._inflight)
+        if timeout_s is None:
+            timeout_s = self.default_timeout_s
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        self._cmds.put(("add", req, deadline))
+        return st
+
+    async def generate(self, prompt_ids, **kwargs):
+        """Non-streaming convenience: (token_list, finish_reason)."""
+        return await self.submit(prompt_ids, **kwargs).collect()
+
+    def abort(self, request_id, reason="cancelled"):
+        """Cancel a request (client disconnect, server policy). Safe for
+        unknown/finished ids. The stream finishes with `reason`."""
+        self._cmds.put(("abort", request_id, reason))
+
+    # -- event dispatch (event-loop thread) --------------------------------
+
+    def _dispatch(self, events):
+        for ev in events:
+            kind, rid = ev[0], ev[1]
+            st = self._streams.get(rid)
+            if st is None:
+                continue
+            if kind == "tok":
+                _, _, tok, reason = ev
+                self._push_token(st, tok)
+                if reason is not None:
+                    self._finish_stream(st, reason)
+            else:  # ("finish", rid, reason, detail)
+                _, _, reason, detail = ev
+                st.error = detail
+                self._finish_stream(st, reason)
+
+    def _push_token(self, st, tok):
+        if not st.overflow:
+            try:
+                st.queue.put_nowait(tok)
+            except asyncio.QueueFull:
+                st.overflow = True
+                self.metrics.inc("backpressure_drops")
+        st.wake.set()
+
+    def _finish_stream(self, st, reason):
+        if st.finished:
+            return
+        st.finished = True
+        st.finish_reason = reason
+        if not st.overflow:
+            try:
+                st.queue.put_nowait(_END)
+            except asyncio.QueueFull:
+                st.overflow = True
+        st.wake.set()
+        st.done.set()
+        del self._streams[st.request_id]
+        self._inflight -= 1
+        self.metrics.set_gauge("frontend_inflight", self._inflight)
+
+    def _on_stopped(self):
+        # hard-stop/drain already finished every stream; anything left
+        # (e.g. an add command raced the stop) is cancelled here
+        for st in list(self._streams.values()):
+            self._finish_stream(st, "cancelled")
+        self._stopped.set()
+
+    def _to_loop(self, events):
+        try:
+            self._loop.call_soon_threadsafe(self._dispatch, events)
+        except RuntimeError:
+            pass  # event loop already closed (interpreter teardown)
+
+    # -- engine thread -----------------------------------------------------
+
+    def _engine_loop(self):
+        eng = self.engine
+        deadlines = {}   # rid -> monotonic deadline
+        live = set()     # rids this thread admitted and not yet retired
+        draining = False
+        stop = False
+        while not stop:
+            # drain commands; park on the queue (poll interval) when idle
+            cmds = []
+            try:
+                if eng.has_unfinished():
+                    cmds.append(self._cmds.get_nowait())
+                else:
+                    cmds.append(self._cmds.get(timeout=self._idle_poll_s))
+            except queue.Empty:
+                pass
+            while True:
+                try:
+                    cmds.append(self._cmds.get_nowait())
+                except queue.Empty:
+                    break
+            events = []
+            for cmd in cmds:
+                kind = cmd[0]
+                if kind == "add":
+                    _, req, deadline = cmd
+                    if draining:
+                        events.append(
+                            ("finish", req.request_id, "cancelled", None))
+                        continue
+                    try:
+                        eng.add(req)
+                    except Exception as e:  # noqa: BLE001 — fail the one
+                        events.append(       # request, not the loop
+                            ("finish", req.request_id, "error", str(e)))
+                        continue
+                    live.add(req.request_id)
+                    if deadline is not None:
+                        deadlines[req.request_id] = deadline
+                elif kind == "abort":
+                    _, rid, reason = cmd
+                    if eng.abort(rid):
+                        live.discard(rid)
+                        deadlines.pop(rid, None)
+                        self.metrics.inc("requests_cancelled")
+                        events.append(("finish", rid, reason, None))
+                elif kind == "stop":
+                    draining = True
+                    if not cmd[1]:  # hard stop: abort everything in flight
+                        for rid in list(live):
+                            if eng.abort(rid):
+                                self.metrics.inc("requests_cancelled")
+                                events.append(
+                                    ("finish", rid, "cancelled", None))
+                        live.clear()
+                        deadlines.clear()
+                        stop = True
+            now = time.monotonic()
+            for rid, dl in list(deadlines.items()):
+                if now >= dl:
+                    deadlines.pop(rid)
+                    if eng.abort(rid):
+                        live.discard(rid)
+                        self.metrics.inc("requests_timeout")
+                        events.append(("finish", rid, "timeout", None))
+            if not stop and eng.has_unfinished():
+                try:
+                    outs = eng.step()
+                except Exception as e:  # noqa: BLE001 — a poisoned step
+                    # must not kill serving: fail in-flight work loudly and
+                    # keep accepting (the engine holds no partial step
+                    # state; aborts below return every KV block)
+                    self.metrics.inc("engine_step_errors")
+                    for rid in list(live):
+                        eng.abort(rid)
+                        events.append(("finish", rid, "error", str(e)))
+                    live.clear()
+                    deadlines.clear()
+                    outs = []
+                for o in outs:
+                    reason = None
+                    if o.finished:
+                        req = eng.get_request(o.request_id)
+                        reason = (
+                            "stop"
+                            if req.eos_token_id is not None
+                            and o.token == req.eos_token_id
+                            else "length"
+                        )
+                        live.discard(o.request_id)
+                        deadlines.pop(o.request_id, None)
+                        eng.release(o.request_id)
+                    events.append(("tok", o.request_id, o.token, reason))
+            if events:
+                self._to_loop(events)
+            if draining and not stop and not eng.has_unfinished():
+                stop = True
+        try:
+            self._loop.call_soon_threadsafe(self._on_stopped)
+        except RuntimeError:
+            pass

@@ -8,12 +8,26 @@ read them without adapters:
   emits per plane (span/busy/idle/utilization/top_gaps), so
   `xplane.print_schedule_analysis` renders engine schedules exactly like
   device captures;
+- `prometheus_text()` — Prometheus text exposition for the HTTP frontend's
+  `/metrics` endpoint (serving/server.py): counters, gauges, and duration
+  summaries with p50/p95 quantiles;
 - direct attribute access for tests (`metrics.counters["preemptions"]`).
 """
 from __future__ import annotations
 
+import re
 import time
 from collections import defaultdict
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _quantile(sorted_window, pct):
+    """Nearest-rank percentile over a sorted window: ceil(pct/100 * n) - 1.
+    (int(pct/100 * n) is one rank high and reads as the max for windows up
+    to 20.) The ONE quantile convention for latency_summary and the
+    Prometheus exposition — they must never diverge."""
+    return sorted_window[max(0, -(-pct * len(sorted_window) // 100) - 1)]
 
 
 class ServingMetrics:
@@ -68,17 +82,14 @@ class ServingMetrics:
 
     def latency_summary(self):
         out = {}
-        for name, d in self._durations.items():
+        for name, d in dict(self._durations).items():
             recent = sorted(d["recent"])
             out[name] = {
                 "count": d["count"],
                 "total_ms": d["total"] * 1e3,
                 "mean_ms": d["total"] / d["count"] * 1e3,
                 "p50_ms": recent[len(recent) // 2] * 1e3,
-                # nearest-rank p95: ceil(0.95 n) - 1 (int(0.95 n) is one
-                # rank high and reads as the max for windows up to 20)
-                "p95_ms": recent[max(0, -(-95 * len(recent) // 100) - 1)]
-                * 1e3,
+                "p95_ms": _quantile(recent, 95) * 1e3,
                 "max_ms": d["max"] * 1e3,
             }
         return out
@@ -89,6 +100,44 @@ class ServingMetrics:
             "gauges": dict(self.gauges),
             "latency": self.latency_summary(),
         }
+
+    def prometheus_text(self, prefix="paddle_tpu_serving"):
+        """Prometheus text-format exposition (version 0.0.4): counters as
+        `<prefix>_<name>_total`, gauges as `<prefix>_<name>`, and each
+        duration series as a summary in SECONDS (`_count`/`_sum` plus
+        p50/p95 quantile samples from the bounded recent window)."""
+        lines = []
+
+        def _n(name):
+            return f"{prefix}_{_NAME_RE.sub('_', name)}"
+
+        # dict() snapshots: the engine thread may insert a NEW series key
+        # mid-scrape (first step after warmup); iterating the live dicts
+        # from the event loop could raise "changed size during iteration"
+        counters = dict(self.counters)
+        gauges = dict(self.gauges)
+        durations = dict(self._durations)
+        for name in sorted(counters):
+            m = _n(name) + "_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {counters[name]:g}")
+        for name in sorted(gauges):
+            m = _n(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {float(gauges[name]):g}")
+        for name in sorted(durations):
+            d = durations[name]
+            m = _n(name) + "_seconds"
+            recent = sorted(d["recent"])
+            lines.append(f"# TYPE {m} summary")
+            if recent:
+                lines.append(
+                    f'{m}{{quantile="0.5"}} {recent[len(recent) // 2]:g}')
+                lines.append(
+                    f'{m}{{quantile="0.95"}} {_quantile(recent, 95):g}')
+            lines.append(f"{m}_sum {d['total']:g}")
+            lines.append(f"{m}_count {d['count']:g}")
+        return "\n".join(lines) + "\n"
 
     def schedule_view(self, top_gaps=10, plane_name="serving-engine"):
         """Engine-schedule statistics in schedule_analysis's per-plane shape:

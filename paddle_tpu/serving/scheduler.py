@@ -38,6 +38,7 @@ _rid_counter = itertools.count()
 _arrival_counter = itertools.count()
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+ABORTED = "aborted"
 
 # One planned row of the next mixed step: feed `req.all_ids[start:start+count]`
 # at positions [start, start+count); `emit` marks rows whose last fed position
@@ -89,7 +90,13 @@ class Request:
 
     @property
     def finished(self):
-        return self.state == FINISHED
+        """Terminal — no more tokens will ever be emitted (natural
+        completion or abort); the request holds no KV blocks."""
+        return self.state in (FINISHED, ABORTED)
+
+    @property
+    def aborted(self):
+        return self.state == ABORTED
 
     @property
     def last_token(self):
@@ -139,6 +146,29 @@ class Scheduler:
         req.num_cached = 0
         if req in self.running:
             self.running.remove(req)
+
+    def abort(self, req):
+        """Remove a request from the scheduler in ANY live state — queued
+        (never admitted), running mid-prefill or mid-decode, or preempted
+        awaiting re-admission — freeing its KV blocks. After abort the
+        request is terminal: `schedule()` can never emit a row for it
+        (it sits in neither queue), and its blocks are back in the pool.
+        Idempotent for already-terminal requests."""
+        if req.finished:
+            return
+        req.state = ABORTED
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        req.num_cached = 0
+        if req in self.running:
+            self.running.remove(req)
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            pass
+        if self.metrics is not None:
+            self.metrics.inc("requests_aborted")
 
     def _preempt(self, req):
         """Preempt-by-recompute: drop the KV, re-queue at the front."""

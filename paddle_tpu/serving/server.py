@@ -1,0 +1,409 @@
+"""HTTP serving frontend: OpenAI-style completions over AsyncLLMEngine.
+
+Stdlib-only (asyncio + hand-rolled HTTP/1.1 — the container adds no web
+framework), one process, loopback-friendly for tests. Endpoints:
+
+- ``POST /v1/completions`` — OpenAI-style body. ``prompt`` is a list of
+  token ids (the repo ships no tokenizer; ``token_ids`` come back in every
+  choice and ``text`` is the space-joined ids). ``stream: true`` sends
+  server-sent events, one token per ``data:`` chunk, terminated by
+  ``data: [DONE]``. Admission control maps straight onto status codes:
+  429 when the bounded wait queue is full (`EngineOverloadedError`), 503
+  while draining (`EngineClosedError`), 400 on invalid requests. A client
+  that disconnects mid-request is detected (EOF on its socket) and its
+  request is aborted — KV blocks return to the pool while the engine keeps
+  serving everyone else.
+- ``GET /healthz`` — 200 ``{"status": "ok"}`` with in-flight gauges, 503
+  ``{"status": "draining"}`` during shutdown.
+- ``GET /metrics`` — Prometheus text exposition from ServingMetrics
+  (counters ``_total``, gauges, step/TTFT duration summaries).
+
+`ServingServer.shutdown(drain=True)` is the graceful path: the listener
+closes (no new connections), the engine stops admitting and finishes or
+aborts in-flight work, open SSE streams run to their natural end, then the
+server exits. ``python -m paddle_tpu.serving.server`` boots a demo server
+around a randomly initialized GPT (see README "HTTP serving quickstart").
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from .frontend import AsyncLLMEngine, EngineClosedError, EngineOverloadedError
+
+_MAX_HEAD = 64 * 1024
+_MAX_BODY = 8 * 1024 * 1024
+
+
+def _http_response(status, body, content_type="application/json",
+                   extra_headers=()):
+    if isinstance(body, (dict, list)):
+        body = json.dumps(body).encode()
+    elif isinstance(body, str):
+        body = body.encode()
+    head = [f"HTTP/1.1 {status}"]
+    head.append(f"Content-Type: {content_type}")
+    head.append(f"Content-Length: {len(body)}")
+    head.append("Connection: close")
+    head.extend(extra_headers)
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+def _error_body(status, message, err_type):
+    return {"error": {"message": message, "type": err_type, "code": status}}
+
+
+class ServingServer:
+    def __init__(self, engine, host="127.0.0.1", port=0,
+                 model_name="paddle-tpu-gpt", max_waiting=64,
+                 stream_queue_size=64, default_timeout_s=None):
+        if isinstance(engine, AsyncLLMEngine):
+            if (max_waiting != 64 or stream_queue_size != 64
+                    or default_timeout_s is not None):
+                raise ValueError(
+                    "max_waiting/stream_queue_size/default_timeout_s belong "
+                    "to the AsyncLLMEngine you passed — set them there"
+                )
+        else:
+            engine = AsyncLLMEngine(
+                engine, max_waiting=max_waiting,
+                stream_queue_size=stream_queue_size,
+                default_timeout_s=default_timeout_s,
+            )
+        self.engine = engine
+        self.host = host
+        self.port = int(port)
+        self.model_name = model_name
+        self._server = None
+        self._draining = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self):
+        await self.engine.start()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, limit=_MAX_HEAD
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    def begin_drain(self):
+        """Stop admitting while the listener stays up: `/healthz` flips to
+        503 (so a load balancer pulls this replica) and `/v1/completions`
+        rejects with 503, but in-flight streams keep running. Call
+        `shutdown()` to finish the drain and close."""
+        self._draining = True
+        self.engine.stop_admitting()
+
+    async def shutdown(self, drain=True, timeout_s=30.0):
+        """Graceful: stop accepting, drain (or abort) the engine, let open
+        streams finish, close. Safe to call twice."""
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+        await self.engine.shutdown(drain=drain, timeout_s=timeout_s)
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+
+    async def serve_forever(self):
+        async with self._server:
+            await self._server.serve_forever()
+
+    # -- connection handling ----------------------------------------------
+
+    async def _handle(self, reader, writer):
+        try:
+            try:
+                head = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), timeout=30.0
+                )
+            except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
+                    asyncio.TimeoutError, ConnectionError):
+                return
+            request_line, _, rest = head.decode("latin1").partition("\r\n")
+            parts = request_line.split(" ")
+            if len(parts) != 3:
+                writer.write(_http_response(
+                    "400 Bad Request",
+                    _error_body(400, "malformed request line", "bad_request"),
+                ))
+                return
+            method, path = parts[0].upper(), parts[1].split("?", 1)[0]
+            headers = {}
+            for line in rest.split("\r\n"):
+                name, sep, value = line.partition(":")
+                if sep:
+                    headers[name.strip().lower()] = value.strip()
+            body = b""
+            try:
+                length = int(headers.get("content-length", 0) or 0)
+            except ValueError:
+                writer.write(_http_response(
+                    "400 Bad Request",
+                    _error_body(400, "bad Content-Length", "bad_request"),
+                ))
+                return
+            if length:
+                if length > _MAX_BODY:
+                    writer.write(_http_response(
+                        "413 Payload Too Large",
+                        _error_body(413, "body too large", "bad_request"),
+                    ))
+                    return
+                body = await asyncio.wait_for(
+                    reader.readexactly(length), timeout=30.0
+                )
+            await self._route(method, path, body, reader, writer)
+        except (ConnectionError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError):
+            pass  # client stalled or went away mid-request — drop it
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, RuntimeError):
+                pass
+
+    async def _route(self, method, path, body, reader, writer):
+        if path == "/healthz":
+            return await self._healthz(writer)
+        if path == "/metrics":
+            writer.write(_http_response(
+                "200 OK", self.engine.metrics.prometheus_text(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            ))
+            return await writer.drain()
+        if path == "/v1/completions":
+            if method != "POST":
+                writer.write(_http_response(
+                    "405 Method Not Allowed",
+                    _error_body(405, "use POST", "bad_request"),
+                ))
+                return await writer.drain()
+            return await self._completions(body, reader, writer)
+        writer.write(_http_response(
+            "404 Not Found", _error_body(404, f"no route {path}", "not_found")
+        ))
+        await writer.drain()
+
+    async def _healthz(self, writer):
+        draining = self._draining or not self.engine.started
+        payload = {
+            "status": "draining" if draining else "ok",
+            "inflight": self.engine.inflight,
+            "gauges": {
+                k: v for k, v in dict(self.engine.metrics.gauges).items()
+                if isinstance(v, (int, float))
+            },
+        }
+        writer.write(_http_response(
+            "503 Service Unavailable" if draining else "200 OK", payload
+        ))
+        await writer.drain()
+
+    # -- /v1/completions ---------------------------------------------------
+
+    async def _completions(self, body, reader, writer):
+        try:
+            spec = json.loads(body or b"{}")
+            if not isinstance(spec, dict):
+                raise ValueError("body must be a JSON object")
+            prompt = spec.get("prompt", spec.get("prompt_token_ids"))
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int) for t in prompt)):
+                raise ValueError(
+                    "'prompt' must be a non-empty list of token ids "
+                    "(no tokenizer ships with the server)"
+                )
+            max_tokens = int(spec.get("max_tokens", 16))
+            temperature = float(spec.get("temperature", 0.0))
+            eos = spec.get("eos_token_id", spec.get("stop_token_id"))
+            if eos is not None:
+                eos = int(eos)
+            timeout_s = spec.get("timeout_s")
+            if timeout_s is not None:
+                timeout_s = float(timeout_s)
+            stream = bool(spec.get("stream", False))
+        except (ValueError, TypeError) as e:
+            writer.write(_http_response(
+                "400 Bad Request", _error_body(400, str(e), "bad_request")
+            ))
+            return await writer.drain()
+        try:
+            st = self.engine.submit(
+                prompt, max_new_tokens=max_tokens, temperature=temperature,
+                eos_token_id=eos, timeout_s=timeout_s,
+            )
+        except EngineOverloadedError as e:
+            writer.write(_http_response(
+                "429 Too Many Requests",
+                _error_body(429, str(e), "overloaded"),
+                extra_headers=("Retry-After: 1",),
+            ))
+            return await writer.drain()
+        except EngineClosedError as e:
+            writer.write(_http_response(
+                "503 Service Unavailable", _error_body(503, str(e), "draining")
+            ))
+            return await writer.drain()
+        except ValueError as e:
+            writer.write(_http_response(
+                "400 Bad Request", _error_body(400, str(e), "bad_request")
+            ))
+            return await writer.drain()
+        rid = f"cmpl-{st.request_id}"
+        # the monitor task sees EOF the moment the client goes away — even
+        # while we are parked waiting for tokens — and turns the disconnect
+        # into an engine abort that frees the request's KV blocks. Stray
+        # inbound bytes (trailing CRLF, an optimistic pipelined request —
+        # we answer Connection: close) are drained, NOT treated as a hangup
+        monitor = asyncio.ensure_future(self._watch_eof(reader))
+        work = asyncio.ensure_future(
+            self._stream_sse(st, rid, len(prompt), writer) if stream
+            else self._respond_full(st, rid, len(prompt), writer)
+        )
+        done, _ = await asyncio.wait(
+            {monitor, work}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if work not in done:
+            self.engine.abort(st.request_id)
+            self.engine.metrics.inc("client_disconnects")
+        await work
+        monitor.cancel()
+        try:
+            await monitor
+        except (asyncio.CancelledError, ConnectionError, OSError):
+            pass
+
+    @staticmethod
+    async def _watch_eof(reader):
+        while await reader.read(4096):
+            pass
+
+    def _chunk(self, rid, token_ids, finish_reason):
+        return {
+            "id": rid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "text": " ".join(str(t) for t in token_ids),
+                "token_ids": list(token_ids),
+                "finish_reason": finish_reason,
+            }],
+        }
+
+    async def _stream_sse(self, st, rid, prompt_tokens, writer):
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        n = 0
+        try:
+            await writer.drain()
+            async for tok in st:
+                n += 1
+                payload = json.dumps(self._chunk(rid, [tok], None))
+                writer.write(f"data: {payload}\n\n".encode())
+                await writer.drain()
+            final = self._chunk(rid, [], st.finish_reason)
+            final["usage"] = {
+                "prompt_tokens": prompt_tokens, "completion_tokens": n,
+                "total_tokens": prompt_tokens + n,
+            }
+            writer.write(f"data: {json.dumps(final)}\n\ndata: [DONE]\n\n"
+                         .encode())
+            await writer.drain()
+        except ConnectionError:
+            # client went away mid-stream; the monitor (or this) aborts
+            self.engine.abort(st.request_id)
+
+    async def _respond_full(self, st, rid, prompt_tokens, writer):
+        toks, reason = await st.collect()
+        if reason == "error":
+            writer.write(_http_response(
+                "500 Internal Server Error",
+                _error_body(500, st.error or "engine error", "engine_error"),
+            ))
+            return await writer.drain()
+        out = self._chunk(rid, toks, reason)
+        out["usage"] = {
+            "prompt_tokens": prompt_tokens, "completion_tokens": len(toks),
+            "total_tokens": prompt_tokens + len(toks),
+        }
+        try:
+            writer.write(_http_response("200 OK", out))
+            await writer.drain()
+        except ConnectionError:
+            pass
+
+
+def main(argv=None):
+    """Demo entry point: ``python -m paddle_tpu.serving.server`` boots a
+    randomly initialized GPT (no checkpoint ships with the repo) behind the
+    HTTP frontend — enough to exercise streaming, metrics, and the
+    backpressure/deadline knobs end to end."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--model", default="tiny", choices=("tiny", "small"))
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--max-seq-len", type=int, default=None)
+    p.add_argument("--prefill-chunk", type=int, default=None)
+    p.add_argument("--max-waiting", type=int, default=64,
+                   help="wait-queue bound beyond max_batch lanes (429 past it)")
+    p.add_argument("--stream-queue-size", type=int, default=64,
+                   help="per-request token queue before backpressure catch-up")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="default per-request deadline (aborts in-flight work)")
+    args = p.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from ..models.gpt import gpt_small, gpt_tiny
+    from .engine import LLMEngine
+
+    paddle.seed(0)
+    model = (gpt_tiny if args.model == "tiny" else gpt_small)(attn_impl="xla")
+    engine = LLMEngine(
+        model, block_size=args.block_size, max_batch=args.max_batch,
+        max_seq_len=args.max_seq_len, prefill_chunk=args.prefill_chunk,
+    )
+
+    async def run():
+        server = ServingServer(
+            engine, host=args.host, port=args.port,
+            max_waiting=args.max_waiting,
+            stream_queue_size=args.stream_queue_size,
+            default_timeout_s=args.timeout_s,
+        )
+        await server.start()
+        print(f"serving on http://{server.host}:{server.port} "
+              f"(POST /v1/completions, GET /healthz, GET /metrics)",
+              flush=True)
+        try:
+            await server.serve_forever()
+        except asyncio.CancelledError:
+            pass
+        finally:
+            print("draining...", flush=True)
+            await server.shutdown(drain=True)
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -293,6 +293,48 @@ def test_to_static_ndarray_kwargs_are_runtime_values():
     assert len(sf._cache) == 1
 
 
+def test_to_static_jax_array_kwargs_are_runtime_values():
+    """Raw jax.Array kwargs (flagged in the serving-frontend issue): they
+    fell through to the repr() cache key and were baked into the traced
+    closure as constants, silently replaying the first call's values for
+    every later same-shape kwarg. Now keyed by (shape, dtype) and passed
+    as runtime arrays, through both the plain-function and Layer paths."""
+    import jax.numpy as jnp
+
+    def f(x, mask=None):
+        return x * mask
+
+    sf = jit.to_static(f)
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    m1 = jnp.asarray(np.array([1, 0, 1, 0], np.float32))
+    m2 = jnp.asarray(np.array([0, 1, 0, 1], np.float32))  # same shape/dtype
+    np.testing.assert_allclose(np.asarray(sf(x, mask=m1)._array),
+                               np.asarray(m1))
+    np.testing.assert_allclose(np.asarray(sf(x, mask=m2)._array),
+                               np.asarray(m2))
+    assert len(sf._cache) == 1  # same program, different runtime kwarg
+
+    class Masked(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x, mask=None):
+            return self.fc(x) * mask
+
+    paddle.seed(0)
+    net = Masked()
+    sfnet = jit.to_static(net)
+    xb = paddle.to_tensor(np.ones((2, 4), np.float32))
+    ones = jnp.asarray(np.ones((2, 4), np.float32))
+    zeros = jnp.asarray(np.zeros((2, 4), np.float32))
+    ref = np.asarray(net.fc(xb)._array)
+    np.testing.assert_allclose(np.asarray(sfnet(xb, mask=ones)._array), ref,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sfnet(xb, mask=zeros)._array),
+                               np.zeros((2, 4)), rtol=1e-6)
+
+
 def test_to_static_rejects_tensor_in_container_kwarg():
     """A Tensor inside a container kwarg would be baked as a constant (and
     numpy's truncated repr would collide cache keys for large arrays) —

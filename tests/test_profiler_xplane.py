@@ -39,6 +39,23 @@ def test_summarize_roundtrip():
         assert "busy" in buf.getvalue()
 
 
+def test_interval_union_stats_empty_is_zeroed():
+    """An empty interval list (metrics scraped before the first engine
+    step) must yield a zeroed stats record, not IndexError (flagged in the
+    serving-frontend issue: /metrics can fire before any step lands)."""
+    from paddle_tpu.profiler import xplane
+
+    st = xplane.interval_union_stats([])
+    assert st == {"span_ms": 0.0, "busy_ms": 0.0, "idle_ms": 0.0,
+                  "utilization": 0.0, "n_ops": 0, "top_gaps": []}
+    # and the shape still renders through the shared printer
+    import io
+
+    buf = io.StringIO()
+    xplane.print_schedule_analysis({"empty-plane": st}, file=buf)
+    assert "empty-plane" in buf.getvalue()
+
+
 def test_schedule_analysis_math():
     """Executor-schedule statistics (reference executor_statistics.cc):
     exact busy/idle/gap math on a hand-built device capture."""

@@ -1,0 +1,180 @@
+"""Abort/cancellation path: scheduler removal + KV block reclamation.
+
+The serving-frontend issue's edge cases: abort a QUEUED request (never
+admitted), abort a request MID-PREFILL-CHUNK (blocks allocated, no token
+emitted yet), and abort a PREEMPTED request awaiting re-admission — in
+every case the blocks return to the pool, `schedule()` never emits a row
+for the aborted request again, and surviving requests still produce
+token-exact greedy output.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.serving import BlockPool, LLMEngine
+from paddle_tpu.serving.scheduler import Request, Scheduler
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, attn_impl="xla", dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(lengths, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, (n,)).tolist() for n in lengths]
+
+
+def _reference(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int64))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0)
+    return out.numpy()[0, len(prompt):].tolist()
+
+
+def _pool():
+    return BlockPool(num_blocks=16, num_layers=1, block_size=4, num_heads=1,
+                     head_dim=4)
+
+
+def test_abort_queued_request_never_scheduled():
+    """Abort before admission: the request leaves the waiting queue and no
+    schedule() call ever emits a row for it."""
+    pool = _pool()
+    sched = Scheduler(pool, max_batch=1, token_budget=8, prefill_chunk=8)
+    r1 = Request([1] * 4, max_new_tokens=4)
+    r2 = Request([2] * 4, max_new_tokens=4)  # stuck behind r1 (one lane)
+    sched.add(r1)
+    sched.add(r2)
+    rows = sched.schedule()
+    assert [w.req for w in rows] == [r1]
+    sched.abort(r2)
+    assert r2.finished and r2.aborted and r2 not in sched.waiting
+    r1.num_cached += rows[0].count
+    for _ in range(4):  # r2 must never surface even as lanes free up
+        assert all(w.req is not r2 for w in sched.schedule())
+    sched.finish(r1)
+    assert sched.schedule() == [] and not sched.has_unfinished()
+    assert pool.num_free == pool.num_blocks - 1
+
+
+def test_abort_mid_prefill_chunk_frees_blocks():
+    """Abort between two prefill chunks: allocated blocks go back to the
+    pool and the half-written KV is never walked again."""
+    pool = _pool()
+    sched = Scheduler(pool, max_batch=2, token_budget=4, prefill_chunk=4)
+    req = Request([1] * 10, max_new_tokens=4)  # 3 chunks of <=4
+    sched.add(req)
+    (row,) = sched.schedule()
+    req.num_cached += row.count
+    assert req.blocks and pool.num_free < pool.num_blocks - 1
+    sched.abort(req)
+    assert not req.blocks and req.num_cached == 0
+    assert pool.num_free == pool.num_blocks - 1
+    assert sched.schedule() == [] and not sched.has_unfinished()
+
+
+def test_abort_preempted_request_awaiting_readmission():
+    """A preempted request sits at the FRONT of the waiting queue holding
+    no blocks; abort must pull it out so re-admission can never replay it."""
+    pool = _pool()
+    sched = Scheduler(pool, max_batch=2, token_budget=8, prefill_chunk=8)
+    r1 = Request([1] * 8, max_new_tokens=4)
+    sched.add(r1)
+    (row,) = sched.schedule()
+    r1.num_cached += row.count
+    sched._preempt(r1)
+    assert r1 in sched.waiting and not r1.blocks
+    sched.abort(r1)
+    assert r1 not in sched.waiting and r1.aborted
+    assert sched.schedule() == [] and not sched.has_unfinished()
+    assert pool.num_free == pool.num_blocks - 1
+
+
+def test_abort_is_idempotent_and_terminal():
+    pool = _pool()
+    sched = Scheduler(pool, max_batch=1, token_budget=8, prefill_chunk=8)
+    req = Request([1] * 4, max_new_tokens=4)
+    sched.add(req)
+    sched.schedule()
+    sched.abort(req)
+    sched.abort(req)  # no double free, no error
+    assert pool.num_free == pool.num_blocks - 1
+    done = Request([1] * 4, max_new_tokens=4)
+    sched.add(done)
+    sched.schedule()
+    sched.finish(done)
+    sched.abort(done)  # aborting a finished request is a no-op
+    assert done.state == "finished"
+
+
+def test_block_pool_double_free_raises():
+    pool = _pool()
+    blocks = pool.allocate(2)
+    pool.free(blocks)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([blocks[0]])
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([pool.num_blocks - 1] if pool.num_blocks - 1 not in blocks
+                  else [blocks[1]])
+
+
+def test_engine_abort_mid_decode_survivors_exact(model):
+    """LLMEngine.abort mid-serve: the aborted request's blocks return to
+    the pool, its record is released, and the surviving requests' greedy
+    streams stay token-for-token exact."""
+    p_kill, p_keep = _prompts((9, 7), seed=3)
+    engine = LLMEngine(model, block_size=4, max_batch=4, max_seq_len=64,
+                       prefill_chunk=4)
+    rid_kill = engine.add_request(p_kill, max_new_tokens=12, temperature=0.0)
+    rid_keep = engine.add_request(p_keep, max_new_tokens=12, temperature=0.0)
+    while len(engine.get_request(rid_kill).output_ids) < 3:
+        engine.step()
+    assert engine.abort(rid_kill) is True
+    assert engine.abort(rid_kill) is False  # already gone
+    assert engine.abort("nope") is False
+    assert engine.metrics.counters["requests_aborted"] == 1
+    streamed = []
+    while engine.has_unfinished():
+        for out in engine.step():
+            assert out.request_id == rid_keep  # never re-emitted
+            streamed.append(out.token)
+    ref = _reference(model, p_keep, 12)
+    assert engine.get_request(rid_keep).output_ids == ref
+    assert streamed == ref[len(ref) - len(streamed):]
+    engine.release(rid_keep)
+    assert engine.pool.num_free == engine.pool.num_blocks - 1
+    assert engine._requests == {}
+
+
+def test_engine_abort_queued_and_preempted(model):
+    """Abort across states through the engine API: one request still
+    queued (tiny pool keeps it out), one preempted; pool returns to idle
+    and the survivor completes exactly."""
+    prompts = _prompts((6, 7, 9), seed=1)
+    engine = LLMEngine(model, block_size=4, num_blocks=10, max_batch=4,
+                       max_seq_len=64)
+    rids = [engine.add_request(p, max_new_tokens=10, temperature=0.0)
+            for p in prompts]
+    engine.step()
+    # drive until somebody gets preempted (pool of 9 usable blocks forces it)
+    for _ in range(30):
+        if engine.metrics.counters["preemptions"] >= 1:
+            break
+        engine.step()
+    assert engine.metrics.counters["preemptions"] >= 1
+    # abort everything except the first request, whatever state it's in
+    for rid in rids[1:]:
+        engine.abort(rid)
+    while engine.has_unfinished():
+        for out in engine.step():
+            assert out.request_id == rids[0]
+    assert engine.get_request(rids[0]).output_ids == _reference(
+        model, prompts[0], 10)
+    engine.release(rids[0])
+    assert engine.pool.num_free == engine.pool.num_blocks - 1

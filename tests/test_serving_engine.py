@@ -154,6 +154,14 @@ def test_request_validation(model):
         engine.add_request([], max_new_tokens=4)
     with pytest.raises(ValueError, match="max_new_tokens"):
         engine.add_request([1, 2], max_new_tokens=0)
+    # a request whose worst-case KV need exceeds the whole pool is rejected
+    # at ADMISSION — otherwise it becomes the oldest running sequence and
+    # the scheduler's no-livelock error kills the whole serve mid-flight
+    small = LLMEngine(model, block_size=4, num_blocks=4, max_batch=2,
+                      max_seq_len=64)
+    with pytest.raises(ValueError, match="KV blocks"):
+        small.add_request(list(range(1, 20)), max_new_tokens=4)
+    small.add_request([1, 2, 3], max_new_tokens=4)  # fits: 2 of 3 blocks
     with pytest.raises(ValueError, match="token_budget"):
         LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
                   token_budget=0)

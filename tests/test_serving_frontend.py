@@ -1,0 +1,227 @@
+"""AsyncLLMEngine: the asyncio serving frontend over the engine thread.
+
+Acceptance criteria from the frontend issue, at the Python API level (the
+HTTP surface is tests/test_serving_server.py): streamed greedy tokens are
+identical to `LLMEngine.generate`'s; cancellations and deadlines abort
+in-flight work and return every KV block to the pool; admission is bounded
+(EngineOverloadedError, never an unbounded queue); a consumer that never
+reads cannot stall the step loop (bounded queues flip to lossless
+catch-up); shutdown drains with no hung tasks.
+"""
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.serving import (
+    AsyncLLMEngine,
+    EngineClosedError,
+    EngineOverloadedError,
+    LLMEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, attn_impl="xla", dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(lengths, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, (n,)).tolist() for n in lengths]
+
+
+def _reference(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int64))
+    out = model.generate(ids, max_new_tokens=n, temperature=0.0)
+    return out.numpy()[0, len(prompt):].tolist()
+
+
+def _idle(engine):
+    return engine.pool.num_free == engine.pool.num_blocks - 1
+
+
+async def _wait_for(cond, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        await asyncio.sleep(0.01)
+
+
+def test_streamed_greedy_matches_generate(model):
+    """Concurrent async streams produce token-for-token the engine's
+    sequential greedy output; the pool returns to idle after drain."""
+    prompts = _prompts((5, 9, 13), seed=0)
+    refs = [_reference(model, p, 6) for p in prompts]
+    engine = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine, max_waiting=8).start()
+        streams = [fe.submit(p, max_new_tokens=6, temperature=0.0)
+                   for p in prompts]
+        results = await asyncio.gather(*(s.collect() for s in streams))
+        await fe.shutdown(drain=True)
+        return results, fe
+
+    results, fe = asyncio.run(main())
+    for (toks, reason), ref in zip(results, refs):
+        assert toks == ref
+        assert reason == "length"
+    assert _idle(engine)
+    assert engine._requests == {}
+    assert not fe._thread.is_alive()
+
+
+def test_slow_consumer_backpressure_is_lossless(model):
+    """A consumer that reads NOTHING until generation completes: the step
+    loop never blocks (the request finishes anyway), the bounded queue
+    overflows into catch-up mode, and the late reader still gets the exact
+    token sequence."""
+    (p,) = _prompts((8,), seed=4)
+    ref = _reference(model, p, 10)
+    engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine, stream_queue_size=2).start()
+        st = fe.submit(p, max_new_tokens=10, temperature=0.0)
+        # do not consume a single token until the engine says it's done —
+        # if a full queue could block the scheduler thread, this would hang
+        await asyncio.wait_for(st.done.wait(), 60.0)
+        assert st.overflow
+        toks, reason = await st.collect()
+        await fe.shutdown()
+        return toks, reason
+
+    toks, reason = asyncio.run(main())
+    assert toks == ref and reason == "length"
+    assert engine.metrics.counters["backpressure_drops"] >= 1
+    assert _idle(engine)
+
+
+def test_cancellation_midstream_frees_blocks(model):
+    """abort() mid-decode: the stream ends with finish_reason 'cancelled',
+    the other stream is unaffected (token-exact), and every KV block is
+    back in the pool."""
+    p_kill, p_keep = _prompts((9, 7), seed=3)
+    ref_keep = _reference(model, p_keep, 12)
+    engine = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine).start()
+        st_kill = fe.submit(p_kill, max_new_tokens=30, temperature=0.0)
+        st_keep = fe.submit(p_keep, max_new_tokens=12, temperature=0.0)
+        got = []
+        async for tok in st_kill:
+            got.append(tok)
+            if len(got) == 2:
+                fe.abort(st_kill.request_id)
+        keep = await st_keep.collect()
+        await fe.shutdown(drain=True)
+        return st_kill, got, keep
+
+    st_kill, got, keep = asyncio.run(main())
+    assert st_kill.finish_reason == "cancelled"
+    assert 2 <= len(got) < 30  # ended early, after the abort landed
+    assert keep == (ref_keep, "length")
+    assert engine.metrics.counters["requests_cancelled"] == 1
+    assert _idle(engine)
+
+
+def test_deadline_aborts_inflight_work(model):
+    """A per-request timeout fires from the engine thread mid-generation:
+    finish_reason 'timeout', partial output, pool back to idle."""
+    (p,) = _prompts((6,), seed=5)
+    engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine).start()
+        st = fe.submit(p, max_new_tokens=56, temperature=0.0, timeout_s=0.15)
+        toks, reason = await st.collect()
+        await fe.shutdown()
+        return toks, reason
+
+    toks, reason = asyncio.run(main())
+    assert reason == "timeout"
+    assert len(toks) < 56
+    assert engine.metrics.counters["requests_timeout"] == 1
+    assert _idle(engine)
+
+
+def test_admission_bounded_wait_queue(model):
+    """Past max_batch + max_waiting in-flight requests, submit raises
+    EngineOverloadedError — requests are rejected, never queued without
+    bound."""
+    prompts = _prompts((4, 4, 4), seed=6)
+    engine = LLMEngine(model, block_size=8, max_batch=1, max_seq_len=64)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine, max_waiting=1).start()
+        s1 = fe.submit(prompts[0], max_new_tokens=20, temperature=0.0)
+        s2 = fe.submit(prompts[1], max_new_tokens=20, temperature=0.0)
+        with pytest.raises(EngineOverloadedError):
+            fe.submit(prompts[2], max_new_tokens=20, temperature=0.0)
+        await asyncio.gather(s1.collect(), s2.collect())
+        # capacity freed: admission works again
+        s4 = fe.submit(prompts[2], max_new_tokens=2, temperature=0.0)
+        await s4.collect()
+        await fe.shutdown()
+
+    asyncio.run(main())
+    assert engine.metrics.counters["requests_rejected"] == 1
+    assert _idle(engine)
+
+
+def test_graceful_drain_and_closed_rejection(model):
+    """shutdown(drain=True) right after submitting: in-flight requests
+    run to completion (token-exact), new submits raise EngineClosedError,
+    the engine thread exits with no hung tasks."""
+    prompts = _prompts((5, 11), seed=7)
+    refs = [_reference(model, p, 8) for p in prompts]
+    engine = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine).start()
+        streams = [fe.submit(p, max_new_tokens=8, temperature=0.0)
+                   for p in prompts]
+        drain = asyncio.ensure_future(fe.shutdown(drain=True))
+        results = await asyncio.gather(*(s.collect() for s in streams))
+        await drain
+        with pytest.raises(EngineClosedError):
+            fe.submit(prompts[0], max_new_tokens=2)
+        return results, fe
+
+    results, fe = asyncio.run(main())
+    assert results == [(r, "length") for r in refs]
+    assert not fe._thread.is_alive()
+    assert _idle(engine)
+
+
+def test_hard_shutdown_cancels_inflight(model):
+    """shutdown(drain=False) aborts everything immediately; streams finish
+    'cancelled' and the pool still returns to idle."""
+    (p,) = _prompts((6,), seed=8)
+    engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine).start()
+        st = fe.submit(p, max_new_tokens=56, temperature=0.0)
+        await _wait_for(lambda: len(st.req.output_ids) >= 1,
+                        msg="first token")
+        await fe.shutdown(drain=False)
+        toks, reason = await st.collect()
+        return toks, reason, fe
+
+    toks, reason, fe = asyncio.run(main())
+    assert reason == "cancelled"
+    assert len(toks) < 56
+    assert not fe._thread.is_alive()
+    assert _idle(engine)
