@@ -7,7 +7,11 @@ arena (PAPERS.md "Ragged Paged Attention"), attends through a ragged
 Pallas kernel on TPU (XLA gather fallback elsewhere,
 ops/pallas/paged_attention.py), and compiles exactly TWO XLA programs —
 one mixed prefill+decode step and one pure-decode step — regardless of
-traffic or prompt lengths.
+traffic or prompt lengths. Automatic prefix caching (ref-counted
+content-hashed blocks with a cached-free LRU tier and copy-on-write) is
+on by default — shared system prompts/few-shot templates skip their
+prefill on every hit; disable with ``PADDLE_TPU_PREFIX_CACHE=0`` or
+``LLMEngine(prefix_cache=False)``.
 
 Quickstart::
 
@@ -28,7 +32,12 @@ drain; `ServingServer` (server.py, stdlib-only) exposes it over HTTP:
 OpenAI-style `/v1/completions` with SSE streaming, `/healthz`, and a
 Prometheus `/metrics` endpoint. See README "HTTP serving quickstart".
 """
-from .block_pool import BlockPool, PagedState, paged_attention  # noqa: F401
+from .block_pool import (  # noqa: F401
+    BlockPool,
+    PagedState,
+    chain_block_hashes,
+    paged_attention,
+)
 from .engine import LLMEngine, StepOutput  # noqa: F401
 from .frontend import (  # noqa: F401
     AsyncLLMEngine,

@@ -20,10 +20,52 @@ which the causal ``kpos <= qpos`` mask then discards.
 
 Host-side bookkeeping (the free list) is plain Python — allocation decisions
 are scheduling, not device work.
+
+**Automatic prefix caching** (vLLM-style) lives entirely in this host-side
+bookkeeping: every block carries a refcount, and FULL blocks (all
+``block_size`` token slots written) can be published under a chained
+content hash — ``h_i = hash((h_{i-1}, tokens of block i))`` — into a
+hash→block index. A published block whose refcount drops to zero moves to
+a **cached-free LRU tier** instead of the truly-free list: its KV stays
+valid and `match_prefix` can hand it to a later request with the same
+token prefix (refcount goes back up, the prefill skips those tokens).
+``num_free`` counts BOTH tiers; `allocate` pops truly-free blocks first
+and evicts cached blocks oldest-first only when the free list runs dry,
+so caching never reduces the pool's usable capacity. Writes into a block
+shared by several sequences go through copy-on-write (`copy_blocks` +
+the scheduler's `_ensure_writable`).
 """
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
+
 import numpy as np
+
+
+def chain_block_hashes(token_ids, block_size):
+    """Chained content digests of each FULL block of `token_ids`.
+
+    ``h_i = sha256(h_{i-1} || tokens[i*bs:(i+1)*bs])`` (empty seed), so a
+    block's digest commits to the ENTIRE token prefix through its last
+    token — two sequences share digest i iff their first
+    ``(i+1)*block_size`` tokens are identical. The trailing partial block
+    (if any) gets no digest: only immutable full blocks are shareable.
+    A real cryptographic digest, NOT Python's builtin ``hash``: the index
+    serves KV across requests, so an engineerable collision would silently
+    hand one prompt another prompt's KV blocks (the vLLM prefix-cache
+    collision advisory, CVE-2025-25183).
+    """
+    bs = int(block_size)
+    hashes = []
+    h = b""
+    for i in range(len(token_ids) // bs):
+        m = hashlib.sha256(h)
+        m.update(np.asarray(token_ids[i * bs:(i + 1) * bs],
+                            np.int64).tobytes())
+        h = m.digest()
+        hashes.append(h)
+    return hashes
 
 
 class PagedLayerView:
@@ -101,13 +143,22 @@ def paged_attention(q, k_new, v_new, view, scale=None):
 class BlockPool:
     """Host-side allocator over the device arena.
 
-    Owns the K/V arena arrays plus the free list. `allocate`/`free` are pure
-    bookkeeping; `positions_to_slots` maps token positions to (block, offset)
-    scatter targets for a sequence's block list.
+    Owns the K/V arena arrays plus the two-tier free bookkeeping:
+
+    - ``_free``    — truly-free blocks (contents meaningless);
+    - ``_cached``  — refcount-0 blocks whose full-block KV is still valid
+      and published in ``_hash_index`` (LRU order: oldest first). They are
+      reusable via `match_prefix` until `allocate` evicts them.
+
+    A block handed out (or pinned via a cache hit) lives in ``_refcount``;
+    every holder releases exactly once, and a release below zero — the
+    double-free that would alias two sequences onto one block — raises.
+    `positions_to_slots` maps token positions to (block, offset) scatter
+    targets for a sequence's block list.
     """
 
     def __init__(self, num_blocks, num_layers, block_size, num_heads,
-                 head_dim, dtype=None):
+                 head_dim, dtype=None, metrics=None):
         import jax.numpy as jnp
 
         if num_blocks < 2:
@@ -121,43 +172,147 @@ class BlockPool:
         self.v = jnp.zeros(shape, dt)
         # block 0 reserved as the null/scratch block
         self._free = list(range(self.num_blocks - 1, 0, -1))
-        # live (handed-out) block ids: with finish/preempt/abort all freeing
-        # blocks, a double free would put one block on the free list twice
-        # and later alias two sequences onto it — caught loudly instead
-        self._allocated = set()
+        self._refcount = {}           # block -> holders (held blocks only)
+        self._hash_index = {}         # content hash -> block
+        self._block_hash = {}         # block -> content hash (inverse)
+        self._cached = OrderedDict()  # refcount-0 indexed blocks, LRU order
+        self.evictions = 0
+        self.metrics = metrics
+        self._copy_fn = None          # jitted donated block-copy (lazy)
 
     @property
     def num_free(self):
-        return len(self._free)
+        """Allocatable blocks: truly free PLUS evictable cached-free."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def num_cached_blocks(self):
+        """Blocks currently parked in the cached-free tier."""
+        return len(self._cached)
 
     def blocks_for(self, num_tokens):
         """How many blocks a sequence of `num_tokens` tokens needs."""
         return max(1, -(-int(num_tokens) // self.block_size))
 
+    def refcount(self, block):
+        """Holders of `block` (0 = free or cached-free)."""
+        return self._refcount.get(int(block), 0)
+
+    def block_hash(self, block):
+        """The content hash `block` is published under, or None."""
+        return self._block_hash.get(int(block))
+
     def allocate(self, n):
-        """Pop `n` blocks off the free list, or None if not enough."""
-        if n > len(self._free):
+        """Pop `n` blocks, or None if not enough. Truly-free blocks go
+        first; only when that list is empty are cached-free blocks evicted,
+        LRU (least recently released/matched) first — eviction is the ONLY
+        way a published hash leaves the index."""
+        if n > self.num_free:
             return None
-        out = [self._free.pop() for _ in range(n)]
-        self._allocated.update(out)
+        out = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                b, _ = self._cached.popitem(last=False)  # LRU victim
+                h = self._block_hash.pop(b)
+                del self._hash_index[h]
+                self.evictions += 1
+                if self.metrics is not None:
+                    self.metrics.inc("prefix_cache_evictions")
+            self._refcount[b] = 1
+            out.append(b)
         return out
 
     def free(self, blocks):
-        for b in blocks:
+        """Release `blocks` without publishing hashes (back-compat alias
+        for `release`)."""
+        self.release(blocks)
+
+    def release(self, blocks, hashes=()):
+        """Drop one holder's reference on each of `blocks`. A block whose
+        refcount reaches zero retires to the cached-free tier when
+        ``hashes[i]`` supplies its (valid, full-block) content hash, to the
+        truly-free list otherwise. Raises on the null block and on
+        refcount underflow (a double free)."""
+        for i, b in enumerate(blocks):
+            b = int(b)
             if b == 0:
                 raise ValueError("cannot free the null block")
-            if b not in self._allocated:
+            rc = self._refcount.get(b)
+            if rc is None:
                 raise ValueError(f"double free of block {b}")
-            self._allocated.discard(b)
+            if rc > 1:
+                self._refcount[b] = rc - 1
+                continue
+            del self._refcount[b]
+            self._retire(b, hashes[i] if i < len(hashes) else None)
+
+    def _retire(self, b, h):
+        """Move refcount-0 block `b` to its tier, keeping ``_hash_index``
+        and ``_block_hash`` exact inverses throughout."""
+        old = self._block_hash.get(b)
+        if h is None:
+            if old is not None:
+                # hashless retire of a published block (e.g. a partially
+                # re-written tail): never leave a dangling index entry
+                del self._hash_index[old]
+                del self._block_hash[b]
             self._free.append(b)
+            return
+        if old is not None and old != h:
+            del self._hash_index[old]
+            del self._block_hash[b]
+        owner = self._hash_index.get(h)
+        if owner is not None and owner != b:
+            # another block already serves this content — duplicate copy
+            # (e.g. a COW clone released after its original): free truly
+            self._free.append(b)
+            return
+        self._hash_index[h] = b
+        self._block_hash[b] = h
+        self._cached[b] = h           # MRU end of the LRU order
+
+    def match_prefix(self, hashes):
+        """Longest cached prefix: walk `hashes` through the index and pin
+        (refcount++) every matched block, stopping at the first miss.
+        Returns the pinned block ids in prefix order. Matched blocks leave
+        the cached-free tier but KEEP their index entry, so concurrent
+        requests can share one pinned block (refcount > 1)."""
+        out = []
+        for h in hashes:
+            b = self._hash_index.get(h)
+            if b is None:
+                break
+            if b in self._cached:
+                del self._cached[b]
+                self._refcount[b] = 1
+            else:
+                self._refcount[b] += 1
+            out.append(b)
+        return out
 
     def copy_blocks(self, src, dst):
-        """Device-side block copy (copy-on-preempt / future forked decode):
-        arena blocks `src` are duplicated into blocks `dst` in one scatter."""
-        src = np.asarray(src, np.int32)
-        dst = np.asarray(dst, np.int32)
-        self.k = self.k.at[:, :, dst].set(self.k[:, :, src])
-        self.v = self.v.at[:, :, dst].set(self.v[:, :, src])
+        """Device-side block copy (the copy-on-write path: a sequence about
+        to append into a block shared with other holders first duplicates
+        it): arena blocks `src` are copied into blocks `dst` in one
+        scatter. Jitted with the arenas DONATED — an eager ``.at[].set``
+        would materialize a full copy of both arenas per COW (this sits on
+        the cache-hit admission path); donation lets XLA scatter in place,
+        the same contract as the engine's step program."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._copy_fn is None:
+            def _copy(k, v, s, d):
+                return (k.at[:, :, d].set(k[:, :, s]),
+                        v.at[:, :, d].set(v[:, :, s]))
+
+            self._copy_fn = jax.jit(_copy, donate_argnums=(0, 1))
+        self.k, self.v = self._copy_fn(
+            self.k, self.v, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32),
+        )
 
     def table_for(self, blocks, max_blocks):
         """Padded [max_blocks] int32 block table (0-padded) for a sequence."""

@@ -24,26 +24,44 @@ path: the same attention math runs through the block-table gather instead
 of a contiguous buffer (models/gpt.py `CausalSelfAttention` +
 ops/pallas/paged_attention.py's XLA fallback; the Pallas ragged kernel on
 TPU matches to kernel-accumulation tolerance).
+
+**Automatic prefix caching** is on by default (disable with
+``prefix_cache=False`` or ``PADDLE_TPU_PREFIX_CACHE=0``): the engine
+chains each request's full-block prompt hashes ONCE at `add`, the
+scheduler pins any cached prefix at admission so prefill starts at the
+first uncached token, and freed blocks park in the pool's cached-free LRU
+tier. A cache-hit serve is token-for-token identical to a cold serve
+(tests/test_prefix_cache.py): reused blocks hold exactly the K/V a replay
+would recompute, and writes into shared blocks copy-on-write first.
 """
 from __future__ import annotations
 
+import os
 import time
 from collections import namedtuple
 
 import numpy as np
 
 from ..core.functional import functional_call, state_dict_arrays
-from .block_pool import BlockPool, PagedState
+from .block_pool import BlockPool, PagedState, chain_block_hashes
 from .metrics import ServingMetrics
 from .scheduler import Request, Scheduler
 
 StepOutput = namedtuple("StepOutput", ["request_id", "token", "finished"])
 
 
+def _env_flag(name, default):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "off", "no", "")
+
+
 class LLMEngine:
     def __init__(self, model, block_size=16, num_blocks=None, max_batch=4,
                  prefill_chunk=None, token_budget=None, max_seq_len=None,
-                 prefill_buckets=None, prefill_interval=None, seed=0):
+                 prefill_buckets=None, prefill_interval=None, seed=0,
+                 prefix_cache=None):
         import jax
 
         model.eval()
@@ -74,18 +92,25 @@ class LLMEngine:
             # smaller budget to bound per-step prefill work instead
             token_budget = self.max_batch * self.prefill_chunk
         self.prefill_chunk = min(self.prefill_chunk, int(token_budget))
+        # prefix caching: constructor arg wins, then the env kill switch
+        self.prefix_cache = (
+            _env_flag("PADDLE_TPU_PREFIX_CACHE", True)
+            if prefix_cache is None else bool(prefix_cache)
+        )
         self.metrics = ServingMetrics()
         self._params, self._buffers = state_dict_arrays(model)
         dt = model.wte.weight._array.dtype
         self.pool = BlockPool(
             num_blocks, cfg.num_layers, self.block_size, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, dtype=dt,
+            metrics=self.metrics,
         )
         self.scheduler = Scheduler(
             self.pool, max_batch=self.max_batch,
             token_budget=int(token_budget),
             prefill_chunk=self.prefill_chunk,
             prefill_interval=prefill_interval, metrics=self.metrics,
+            prefix_cache=self.prefix_cache,
         )
         self._requests = {}
         self._step_fns = {}
@@ -137,6 +162,12 @@ class LLMEngine:
         self.validate(req)
         if req.request_id in self._requests:
             raise ValueError(f"duplicate request id {req.request_id}")
+        if self.prefix_cache and not req.block_hashes:
+            # chained once per request; the scheduler reuses them for every
+            # admission (including post-preemption re-admissions)
+            req.block_hashes = chain_block_hashes(
+                req.prompt_ids, self.block_size
+            )
         self._requests[req.request_id] = req
         self.scheduler.add(req)
         self.metrics.inc("requests_added")
@@ -251,6 +282,17 @@ class LLMEngine:
         )
         self.metrics.set_gauge("num_running", len(self.scheduler.running))
         self.metrics.set_gauge("num_waiting", len(self.scheduler.waiting))
+        if self.prefix_cache:
+            self.metrics.set_gauge(
+                "prefix_cached_blocks", self.pool.num_cached_blocks
+            )
+            lookup = self.metrics.counters.get("prefix_cache_lookup_tokens", 0)
+            if lookup:
+                self.metrics.set_gauge(
+                    "prefix_cache_hit_rate",
+                    self.metrics.counters.get("prefix_cache_hit_tokens", 0)
+                    / lookup,
+                )
         return outs
 
     def _step_rows(self, rows, S):

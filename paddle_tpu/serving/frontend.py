@@ -209,6 +209,15 @@ class AsyncLLMEngine:
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=request_id)
         self.engine.validate(req)
+        if self.engine.prefix_cache:
+            # chain the prompt's block hashes HERE, off the engine thread:
+            # engine.add skips recomputing them, so a long prompt's hashing
+            # cost never lands between two device steps
+            from .block_pool import chain_block_hashes
+
+            req.block_hashes = chain_block_hashes(
+                req.prompt_ids, self.engine.block_size
+            )
         if req.request_id in self._streams:
             raise ValueError(f"duplicate request id {req.request_id}")
         st = RequestStream(req.request_id, req, self.stream_queue_size)

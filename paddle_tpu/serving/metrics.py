@@ -12,6 +12,19 @@ read them without adapters:
   `/metrics` endpoint (serving/server.py): counters, gauges, and duration
   summaries with p50/p95 quantiles;
 - direct attribute access for tests (`metrics.counters["preemptions"]`).
+
+Counters and gauges are open-ended (a `defaultdict` — every series any
+producer `inc`s flows into all three exports). The prefix-cache series the
+engine/scheduler/pool emit when caching is on:
+
+- counters `prefix_cache_lookup_tokens` (full-block prompt tokens walked
+  through the index at admission), `prefix_cache_hit_tokens` (tokens of
+  MATCHED blocks — a fully-cached prompt counts 100% even though its last
+  token is re-fed as the query), `prefix_cache_evictions` (cached-free
+  blocks reclaimed by `allocate`), `prefix_cache_cow_copies`
+  (copy-on-write duplications of shared blocks);
+- gauges `prefix_cache_hit_rate` (cumulative hit/lookup) and
+  `prefix_cached_blocks` (blocks parked in the cached-free tier).
 """
 from __future__ import annotations
 

@@ -27,6 +27,23 @@ prompt+generated tokens re-queue at the FRONT of the waiting queue, and
 later chunks rebuild the KV. A row with no younger victim defers a step;
 the OLDEST sequence failing to grow means the pool cannot hold even one
 sequence, which fails loudly as a config error.
+
+**Prefix caching** hooks in at exactly three seams:
+
+- at admission, a request's precomputed ``block_hashes`` (engine-computed,
+  prompt full blocks only) walk the pool's content index; the longest
+  matched prefix is pinned (refcount++) and ``num_cached`` jumps to the
+  first uncached token — capped at ``num_tokens - 1`` so at least one
+  query token always runs (a fully-cached prompt recomputes just its last
+  token). Cached tokens are never fed, so they never touch ``token_budget``
+  — mixed steps pack that much more real prefill;
+- before a row's tokens are scattered, `_ensure_writable` copy-on-writes
+  any destination block shared with another holder (refcount > 1), so a
+  write can never corrupt a sibling's cached prefix;
+- `finish`/`abort`/`_preempt` all release KV through ONE path
+  (`_release_blocks`), which publishes the hashes of fully-written full
+  prompt blocks — freed blocks land in the pool's cached-free tier and
+  stay matchable until evicted.
 """
 from __future__ import annotations
 
@@ -66,7 +83,9 @@ class Request:
         self.state = WAITING
         self.blocks = []      # arena block ids owned by this sequence
         self.num_cached = 0   # tokens whose K/V currently live in the arena
-        self.preemptions = 0
+        self.block_hashes = []  # chained full-block prompt hashes (engine
+        self.num_matched_blocks = 0  # cache-hit pins from this admission
+        self.preemptions = 0    # (engine fills hashes when caching is on)
         self.arrival_time = time.monotonic()   # TTFT anchor for metrics
         # total arrival order, stable across preemption/re-admission —
         # the scheduler's FCFS priority key (request_id may be user-supplied
@@ -108,7 +127,8 @@ class Request:
 
 class Scheduler:
     def __init__(self, pool, max_batch=8, token_budget=2048,
-                 prefill_chunk=None, prefill_interval=None, metrics=None):
+                 prefill_chunk=None, prefill_interval=None, metrics=None,
+                 prefix_cache=True):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.token_budget = int(token_budget)
@@ -127,6 +147,7 @@ class Scheduler:
         # bucketed engine; mixed batching made it moot (decode rows ride in
         # every step, so prefill never needs rationing to protect latency)
         self.metrics = metrics
+        self.prefix_cache = bool(prefix_cache)
         self.waiting = deque()
         self.running = []
 
@@ -138,12 +159,33 @@ class Scheduler:
     def has_unfinished(self):
         return bool(self.waiting or self.running)
 
-    def finish(self, req):
-        req.state = FINISHED
+    def _release_blocks(self, req):
+        """The ONE place a request's KV blocks return to the pool
+        (finish, abort, and preemption all funnel here). Full prompt
+        blocks whose KV is completely written publish their content hash,
+        parking the block in the pool's cached-free tier for later
+        `match_prefix` hits; everything else frees truly."""
         if req.blocks:
-            self.pool.free(req.blocks)
+            n_pub = 0
+            if self.prefix_cache:
+                # blocks with fully-valid full-block content: everything
+                # the prefill has completely written PLUS everything that
+                # was matched from the index at admission — num_cached is
+                # capped below a matched block boundary for fully-cached
+                # prompts, and an early abort/preempt must not destroy
+                # that still-valid tail entry
+                n_pub = min(len(req.block_hashes),
+                            max(req.num_cached // self.pool.block_size,
+                                req.num_matched_blocks),
+                            len(req.blocks))
+            self.pool.release(req.blocks, req.block_hashes[:n_pub])
             req.blocks = []
         req.num_cached = 0
+        req.num_matched_blocks = 0
+
+    def finish(self, req):
+        req.state = FINISHED
+        self._release_blocks(req)
         if req in self.running:
             self.running.remove(req)
 
@@ -157,10 +199,7 @@ class Scheduler:
         if req.finished:
             return
         req.state = ABORTED
-        if req.blocks:
-            self.pool.free(req.blocks)
-            req.blocks = []
-        req.num_cached = 0
+        self._release_blocks(req)
         if req in self.running:
             self.running.remove(req)
         try:
@@ -171,11 +210,11 @@ class Scheduler:
             self.metrics.inc("requests_aborted")
 
     def _preempt(self, req):
-        """Preempt-by-recompute: drop the KV, re-queue at the front."""
-        if req.blocks:
-            self.pool.free(req.blocks)
-            req.blocks = []
-        req.num_cached = 0
+        """Preempt-by-recompute: drop the KV, re-queue at the front. The
+        released blocks publish their hashes, so a victim whose cached
+        prefix survives until re-admission repins it instead of replaying
+        the whole prompt."""
+        self._release_blocks(req)
         req.state = WAITING
         req.preemptions += 1
         if req in self.running:
@@ -186,17 +225,40 @@ class Scheduler:
 
     # -- policy ------------------------------------------------------------
 
-    def _grow(self, req, need):
-        """Grow `req.blocks` to `need`, preempting arrival-YOUNGER sequences
-        (FCFS priority: an older request may reclaim a younger one's blocks,
+    def _match_prefix(self, req):
+        """Pin the longest cached full-block prefix of `req`'s prompt at
+        admission. ``num_cached`` starts at the first uncached token,
+        capped at ``num_tokens - 1``: a fully-cached prompt still feeds
+        its last token (the query that samples the first output), whose
+        scatter into the shared tail block goes through copy-on-write."""
+        if self.metrics is not None:
+            self.metrics.inc("prefix_cache_lookup_tokens",
+                             len(req.block_hashes) * self.pool.block_size)
+        hit = self.pool.match_prefix(req.block_hashes)
+        if not hit:
+            return
+        req.blocks = list(hit)
+        req.num_matched_blocks = len(hit)
+        req.num_cached = min(len(hit) * self.pool.block_size,
+                             req.num_tokens - 1)
+        if self.metrics is not None:
+            # matched tokens, NOT the num_tokens-1 execution cap: a fully-
+            # cached prompt is a 100% hit (its last token is re-fed as the
+            # query, but its KV block was matched, so hit/lookup can reach
+            # 1.0 on a fully-warm workload)
+            self.metrics.inc("prefix_cache_hit_tokens",
+                             len(hit) * self.pool.block_size)
+
+    def _take_block(self, req):
+        """One block for `req`, preempting arrival-YOUNGER sequences (FCFS
+        priority: an older request may reclaim a younger one's blocks,
         never the reverse — age survives preemption/re-admission via
-        `arrival_seq`) when the pool is dry. Returns False if the row must
-        be deferred a step instead."""
-        while len(req.blocks) < need:
+        `arrival_seq`) when the pool is dry. Returns the block id, or None
+        if the row must be deferred a step instead."""
+        while True:
             got = self.pool.allocate(1)
             if got is not None:
-                req.blocks.extend(got)
-                continue
+                return got[0]
             victim = max(
                 (r for r in self.running
                  if r.arrival_seq > req.arrival_seq and r.blocks),
@@ -211,12 +273,50 @@ class Scheduler:
                 # cannot grow: the pool cannot hold even one sequence — a
                 # config error, not a scheduling state
                 raise ValueError(
-                    f"request {req.request_id}: needs {need} KV blocks but "
+                    f"request {req.request_id}: needs more KV blocks but "
                     f"the pool only has {self.pool.num_free} free with no "
                     "younger sequences to preempt — raise num_blocks or "
                     "shorten the request"
                 )
-            return False
+            return None
+
+    def _grow(self, req, need):
+        """Grow `req.blocks` to `need` blocks. Returns False to defer."""
+        while len(req.blocks) < need:
+            b = self._take_block(req)
+            if b is None:
+                return False
+            req.blocks.append(b)
+        return True
+
+    def _ensure_writable(self, req, start, count):
+        """Copy-on-write: any block about to receive token scatters in
+        positions [start, start+count) that is shared with another holder
+        (refcount > 1 — e.g. the tail block of a fully-cached prompt, or a
+        prefix block some concurrent request also pinned) is first
+        duplicated via `copy_blocks`, and `req` swaps its table entry to
+        the private copy. The copy is NOT published: the original keeps
+        serving the index. Returns False to defer (pool dry)."""
+        bs = self.pool.block_size
+        for idx in range(start // bs, (start + count - 1) // bs + 1):
+            b = req.blocks[idx]
+            if self.pool.refcount(b) <= 1:
+                continue
+            nb = self._take_block(req)
+            if nb is None:
+                return False
+            if self.pool.refcount(b) <= 1:
+                # preempting for `nb` released the other holder — the
+                # block is private again and the copy is unnecessary
+                self.pool.release([nb])
+                continue
+            self.pool.copy_blocks([b], [nb])
+            # drop OUR reference only; co-holders and the index keep the
+            # original (publish its hash back if we were the last holder)
+            self.pool.release([b], [self.pool.block_hash(b)])
+            req.blocks[idx] = nb
+            if self.metrics is not None:
+                self.metrics.inc("prefix_cache_cow_copies")
         return True
 
     def schedule(self):
@@ -227,6 +327,9 @@ class Scheduler:
         while self.waiting and len(self.running) < self.max_batch:
             req = self.waiting.popleft()
             req.state = RUNNING
+            if (self.prefix_cache and req.block_hashes and not req.blocks
+                    and req.num_cached == 0):
+                self._match_prefix(req)
             self.running.append(req)
 
         budget = self.token_budget
@@ -249,6 +352,8 @@ class Scheduler:
             start = req.num_cached
             if not self._grow(req, self.pool.blocks_for(start + count)):
                 continue  # deferred — its budget share stays available
+            if not self._ensure_writable(req, start, count):
+                continue  # deferred mid-COW — already-copied blocks stay
             if pending > 1:
                 # budget is charged only for rows that actually scheduled,
                 # so a deferred/preempted chunk's share flows to later rows

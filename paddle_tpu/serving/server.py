@@ -364,6 +364,9 @@ def main(argv=None):
                    help="per-request token queue before backpressure catch-up")
     p.add_argument("--timeout-s", type=float, default=None,
                    help="default per-request deadline (aborts in-flight work)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable automatic prefix caching (same as "
+                        "PADDLE_TPU_PREFIX_CACHE=0)")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -375,6 +378,7 @@ def main(argv=None):
     engine = LLMEngine(
         model, block_size=args.block_size, max_batch=args.max_batch,
         max_seq_len=args.max_seq_len, prefill_chunk=args.prefill_chunk,
+        prefix_cache=False if args.no_prefix_cache else None,
     )
 
     async def run():

@@ -21,6 +21,7 @@ _C_DRIVER = r"""
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 typedef void* (*create_fn)(const char*);
 typedef int (*run_fn)(void*, const float*, const int64_t*, int);
@@ -48,6 +49,16 @@ int main(int argc, char** argv) {
   data(p, 0, out);
   for (int64_t i = 0; i < ne; ++i) printf("%.6f\n", (double)out[i]);
   free(out);
+  /* ADVICE r5 regression: an out-of-range output idx must return -1 AND
+     set the thread-local error (the early returns used to skip
+     g_last_error, so callers printed a stale/empty message). */
+  int64_t bad = numel(p, 99);
+  const char* msg = err();
+  if (bad != -1 || msg == NULL || strstr(msg, "out of range") == NULL) {
+    fprintf(stderr, "bad-idx error not set: rc=%lld msg='%s'\n",
+            (long long)bad, msg ? msg : "(null)");
+    return 5;
+  }
   return 0;
 }
 """
@@ -90,3 +101,23 @@ def test_c_consumer_matches_python_predictor():
         assert proc.returncode == 0, proc.stderr[-2000:]
         got = np.asarray([float(l) for l in proc.stdout.split()], np.float32)
         np.testing.assert_allclose(got, expected.reshape(-1), rtol=1e-5, atol=1e-6)
+
+
+def test_goapi_run_keepalive_and_bounds_guards():
+    """ADVICE r5 regression (source contract — the image ships no Go
+    toolchain, so the guards are pinned at the source level): `Run` must
+    KeepAlive the Predictor past the cgo call (the NewPredictor finalizer
+    may otherwise Destroy the handle while a Run is in flight) and must
+    reject empty data/shape slices before taking `&data[0]`/`&shape[0]`
+    (which would panic)."""
+    src = open(os.path.join(REPO, "goapi", "paddle.go")).read()
+    # the finalizer that makes KeepAlive necessary is still registered
+    assert "runtime.SetFinalizer(p," in src
+    run_body = src.split("func (p *Predictor) Run(")[1].split("\nfunc ")[0]
+    assert "runtime.KeepAlive(p)" in run_body
+    assert "len(data) == 0 || len(shape) == 0" in run_body
+    # guards sit BEFORE the element-address-taking cgo call
+    guard = run_body.index("len(data) == 0")
+    keepalive = run_body.index("runtime.KeepAlive(p)")
+    call = run_body.index("C.PD_PredictorRun(")
+    assert guard < call and keepalive < call

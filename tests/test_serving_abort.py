@@ -152,6 +152,37 @@ def test_engine_abort_mid_decode_survivors_exact(model):
     assert engine._requests == {}
 
 
+def test_abort_shared_prefix_blocks_pool_invariant(model):
+    """Abort requests that hold PINNED cache-hit blocks (refcount > 1 with
+    a sibling): each abort drops exactly one reference, the survivor's
+    stream stays exact, and once everything finishes the pool is idle —
+    every refcount zero, num_free back to the idle count (cached-free
+    blocks count as free)."""
+    from tests.test_prefix_cache import assert_pool_idle
+
+    p_shared = _prompts((14,), seed=5)[0]
+    engine = LLMEngine(model, block_size=4, max_batch=4, max_seq_len=64)
+    # publish the prefix, then pin it from two warm requests
+    engine.generate([p_shared], max_new_tokens=2, temperature=0.0)
+    assert engine.pool.num_cached_blocks > 0
+    r1 = engine.add_request(p_shared + [3], max_new_tokens=8, temperature=0.0)
+    r2 = engine.add_request(p_shared + [9], max_new_tokens=8, temperature=0.0)
+    engine.step()
+    assert engine.metrics.counters["prefix_cache_hit_tokens"] >= 24
+    shared_block = engine.get_request(r1).blocks[0]
+    assert engine.get_request(r2).blocks[0] == shared_block
+    assert engine.pool.refcount(shared_block) == 2
+    assert engine.abort(r1) is True           # one ref down, sibling lives
+    assert engine.pool.refcount(shared_block) == 1
+    while engine.has_unfinished():
+        engine.step()
+    assert engine.get_request(r2).output_ids == _reference(
+        model, p_shared + [9], 8)
+    engine.release(r2)
+    assert engine.pool.num_free == engine.pool.num_blocks - 1
+    assert_pool_idle(engine.pool)
+
+
 def test_engine_abort_queued_and_preempted(model):
     """Abort across states through the engine API: one request still
     queued (tiny pool keeps it out), one preempted; pool returns to idle
