@@ -37,6 +37,30 @@ import numpy as np
 
 _T0 = time.monotonic()
 _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1140"))
+# set by main() when the backend probe fails: benches that then produce no
+# result report status "tpu_unreachable" instead of "bench_failed"
+_TPU_UNREACHABLE = False
+
+
+def _status(result, errors):
+    """Machine-readable per-line status (VERDICT item 10: a failed round
+    must be distinguishable from a zero-throughput framework):
+    ``ok`` — result landed, no errors; ``partial`` — result landed but
+    something (deadline cut, sub-bench failure, probe fallback) is in the
+    errors field; ``tpu_unreachable`` — no result AND the accelerator
+    probe failed with only environment-shaped errors (timeouts/skips)
+    since; ``bench_failed`` — no result for any other reason, including a
+    real exception AFTER the CPU fallback kicked in (that is a code bug,
+    not infra — it must not hide behind the infra label)."""
+    if result is None:
+        env_shaped = all(
+            "timed out" in e or "timeout" in e or "skipped" in e
+            or e.startswith("probe:")
+            for e in errors
+        ) if errors else True
+        return ("tpu_unreachable" if _TPU_UNREACHABLE and env_shaped
+                else "bench_failed")
+    return "partial" if errors else "ok"
 
 
 def _remaining():
@@ -255,7 +279,13 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     (production traffic's dominant shape): identical workloads served with
     caching on vs. off (`PADDLE_TPU_PREFIX_CACHE=0` also disables the
     cached engine), reporting `prefix_cache_hit_rate` and the tokens/sec of
-    each — the hot-prefix case must beat the no-cache baseline."""
+    each — the hot-prefix case must beat the no-cache baseline.
+
+    A third, repetitive-suffix wave measures SPECULATIVE DECODING
+    (prompt-lookup drafting + batched verify, serving/spec.py): the same
+    workload spec-on vs spec-off, reporting both tok/s plus
+    `spec_acceptance_rate` and tokens/step — the repetitive case must beat
+    the one-token-per-step baseline."""
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPT, GPTConfig
     from paddle_tpu.serving import LLMEngine
@@ -308,6 +338,8 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
         return None
     shared = _serve_shared_prefix(model, cfg, max_batch, rs, errors,
                                   deadline_s, on_tpu)
+    spec = _serve_spec_wave(model, cfg, max_batch, rs, errors, deadline_s,
+                            on_tpu)
     view = engine.metrics.schedule_view()
     sched = view.get("serving-engine", {})
     lat = engine.metrics.latency_summary()
@@ -332,6 +364,7 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
         "jit_traces_measured": int(counters["jit_traces"] - warm_traces),
         "engine_utilization": round(sched.get("utilization", 0.0), 4),
         **(shared or {}),
+        **(spec or {}),
     }
 
 
@@ -404,6 +437,94 @@ def _serve_shared_prefix(model, cfg, max_batch, rs, errors, deadline_s,
             m.counters.get("prefix_cache_hit_tokens", 0)),
         "prefix_cache_evictions": int(
             m.counters.get("prefix_cache_evictions", 0)),
+    }
+
+
+def _serve_spec_wave(model, cfg, max_batch, rs, errors, deadline_s, on_tpu):
+    """Speculative-decoding wave: a repetitive-suffix workload served with
+    spec decoding ON (prompt-lookup drafting + batched verify) vs OFF
+    through otherwise-identical engines. Prompts end in a repeated motif
+    and the decode runs long — greedy decode of the (random-weight) bench
+    model collapses into short token cycles within a few dozen steps, so
+    the drafter's n-gram lookups hit exactly the way they do on real
+    repetitive traffic (extraction, code edits, quoting). Reports tok/s
+    for both engines plus the spec engine's acceptance rate and
+    tokens/step; greedy outputs of the two engines are identical by the
+    engine's spec parity guarantee (tests/test_spec_decode.py)."""
+    from paddle_tpu.serving import LLMEngine
+
+    if time.monotonic() > deadline_s:
+        errors.append("gpt_serve: deadline before spec wave")
+        return None
+    n_req = max_batch if _fast() else 2 * max_batch
+    # the long decode tail is where the model's output goes cyclic and
+    # acceptance climbs — r06 sweep: max_new 64 broke even on CPU, 128 won
+    # 1.31x (acceptance 0.54, min_ngram=2 to skip spurious unigram drafts)
+    max_new = 128 if not _fast() else 64
+    motif_len, n_motif = 8, 3
+    prompts = []
+    for _ in range(n_req):
+        motif = rs.randint(0, cfg.vocab_size, (motif_len,)).tolist()
+        head = rs.randint(0, cfg.vocab_size, (16,)).tolist()
+        prompts.append(head + motif * n_motif)
+
+    def wave(spec_on):
+        eng = LLMEngine(model, block_size=16, max_batch=max_batch,
+                        spec_decoding=spec_on, num_spec_tokens=4,
+                        spec_min_ngram=2, prefix_cache=False)
+        # prime compiles every program the wave will use: mixed + decode,
+        # and on the spec engine the verify step too (a repeated-token
+        # prompt guarantees the drafter proposes from the first decode)
+        eng.generate([[7] * 24], max_new_tokens=6)
+        eng.metrics.reset_schedule()
+        # counters are engine-lifetime: snapshot after priming so the wave
+        # reports ITS deltas, not the priming request's drafts/steps
+        keys = ("generated_tokens", "spec_proposed_tokens",
+                "spec_accepted_tokens", "verify_steps", "mixed_steps",
+                "decode_steps")
+        base = {k: eng.metrics.counters.get(k, 0) for k in keys}
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=max_new)
+        t0 = time.perf_counter()
+        while eng.has_unfinished():
+            if time.monotonic() > deadline_s:
+                errors.append("gpt_serve: deadline mid spec wave; "
+                              "comparison dropped")
+                for rid in list(eng._requests):
+                    eng.abort(rid)
+                return 0.0, {}
+            eng.step()
+        dt = time.perf_counter() - t0
+        d = {k: eng.metrics.counters.get(k, 0) - base[k] for k in keys}
+        toks = d["generated_tokens"]
+        return (toks / dt if dt > 0 and toks else 0.0), d
+
+    try:
+        tok_s_spec, d = wave(spec_on=True)
+        if not tok_s_spec or time.monotonic() > deadline_s:
+            return None
+        tok_s_off, _ = wave(spec_on=False)
+    except Exception as e:  # noqa: BLE001 — the main wave already landed
+        errors.append(f"gpt_serve spec wave: {type(e).__name__}: "
+                      f"{str(e)[:200]}")
+        return None
+    if not tok_s_off:
+        return None
+    steps = d["verify_steps"] + d["mixed_steps"] + d["decode_steps"]
+    return {
+        "spec_requests": n_req,
+        "spec_max_new_tokens": max_new,
+        "spec_tok_s": round(tok_s_spec, 1),
+        "spec_tok_s_off": round(tok_s_off, 1),
+        "spec_speedup": round(tok_s_spec / tok_s_off, 3),
+        "spec_acceptance_rate": round(
+            d["spec_accepted_tokens"] / d["spec_proposed_tokens"], 4
+        ) if d["spec_proposed_tokens"] else 0.0,
+        "spec_tokens_per_step": round(
+            d["generated_tokens"] / steps, 3) if steps else 0.0,
+        "spec_verify_steps": int(d["verify_steps"]),
+        "spec_proposed_tokens": int(d["spec_proposed_tokens"]),
+        "spec_accepted_tokens": int(d["spec_accepted_tokens"]),
     }
 
 
@@ -702,6 +823,7 @@ def _emit(gpt, extras, errors):
         "value": (gpt or {}).get("value", 0.0),
         "unit": "tokens/sec",
         "vs_baseline": 1.0 if gpt else 0.0,
+        "status": _status(gpt, errors),
     }
     if gpt:
         out["mfu"] = gpt["mfu"]
@@ -726,10 +848,7 @@ def _emit_model(name, r, unit, metric=None):
         "value": 0.0,
         "unit": unit,
         "vs_baseline": 1.0 if result else 0.0,
-        "status": "ok" if result else (
-            "timeout" if any("timed out" in e or "timeout" in e
-                             for e in errs) else "error"
-        ),
+        "status": _status(result, errs),
     }
     if result:
         line.update(result)
@@ -758,6 +877,8 @@ def main():
     # primary metric; CPU finishes the whole suite in minutes).
     note = _probe_backend()
     if note:
+        global _TPU_UNREACHABLE
+        _TPU_UNREACHABLE = "forcing JAX_PLATFORMS=cpu" in note
         _log(note)
         errors.append(f"probe: {note}")
 

@@ -5,13 +5,18 @@ admits requests mid-flight (FCFS, chunked prefill under a per-step token
 budget, preemption-by-recompute), stores K/V in a head-major block-paged
 arena (PAPERS.md "Ragged Paged Attention"), attends through a ragged
 Pallas kernel on TPU (XLA gather fallback elsewhere,
-ops/pallas/paged_attention.py), and compiles exactly TWO XLA programs —
-one mixed prefill+decode step and one pure-decode step — regardless of
-traffic or prompt lengths. Automatic prefix caching (ref-counted
-content-hashed blocks with a cached-free LRU tier and copy-on-write) is
-on by default — shared system prompts/few-shot templates skip their
-prefill on every hit; disable with ``PADDLE_TPU_PREFIX_CACHE=0`` or
-``LLMEngine(prefix_cache=False)``.
+ops/pallas/paged_attention.py), and compiles at most THREE XLA programs —
+one mixed prefill+decode step, one pure-decode step, and (speculative
+decoding only) one verify step — regardless of traffic or prompt lengths.
+Automatic prefix caching (ref-counted content-hashed blocks with a
+cached-free LRU tier and copy-on-write) is on by default — shared system
+prompts/few-shot templates skip their prefill on every hit; disable with
+``PADDLE_TPU_PREFIX_CACHE=0`` or ``LLMEngine(prefix_cache=False)``.
+Speculative decoding (serving/spec.py: prompt-lookup n-gram drafting +
+batched parallel verification, no draft model) is OFF by default — enable
+with ``LLMEngine(spec_decoding=True)`` or ``PADDLE_TPU_SPEC_DECODE=1`` to
+score up to ``num_spec_tokens + 1`` decode positions per step; greedy
+outputs stay token-for-token identical to non-speculative decode.
 
 Quickstart::
 
@@ -48,3 +53,4 @@ from .frontend import (  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .server import ServingServer  # noqa: F401
+from .spec import NgramDrafter, apply_top_k_top_p  # noqa: F401

@@ -186,6 +186,12 @@ class BlockPool:
         return len(self._free) + len(self._cached)
 
     @property
+    def num_truly_free(self):
+        """Blocks allocatable WITHOUT evicting a cached-free prefix block
+        (what ``allocate(n, evict=False)`` can hand out)."""
+        return len(self._free)
+
+    @property
     def num_cached_blocks(self):
         """Blocks currently parked in the cached-free tier."""
         return len(self._cached)
@@ -202,12 +208,15 @@ class BlockPool:
         """The content hash `block` is published under, or None."""
         return self._block_hash.get(int(block))
 
-    def allocate(self, n):
+    def allocate(self, n, evict=True):
         """Pop `n` blocks, or None if not enough. Truly-free blocks go
         first; only when that list is empty are cached-free blocks evicted,
         LRU (least recently released/matched) first — eviction is the ONLY
-        way a published hash leaves the index."""
-        if n > self.num_free:
+        way a published hash leaves the index. ``evict=False`` restricts
+        the request to truly-free blocks (speculative-decoding
+        reservations: a drafted token that MIGHT be rejected must never
+        push a cached prefix out of the index)."""
+        if n > (self.num_free if evict else len(self._free)):
             return None
         out = []
         for _ in range(n):

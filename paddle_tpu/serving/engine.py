@@ -2,7 +2,7 @@
 
 `add_request` enqueues, `step` runs ONE mixed device step (decode rows plus
 chunked-prefill rows, planned by the scheduler), `stream` yields a request's
-tokens as they land. The whole serve compiles to exactly TWO programs no
+tokens as they land. The whole serve compiles to at most THREE programs no
 matter how requests arrive:
 
 - the **mixed step** at ``(max_batch, prefill_chunk)`` — every running
@@ -10,7 +10,17 @@ matter how requests arrive:
   their next chunk, padding goes to the null block;
 - the **decode step** at ``(max_batch, 1)`` — the same program specialized
   to the (dominant) all-decode case so steady-state decoding never pays the
-  chunk-width compute.
+  chunk-width compute;
+- the **verify step** at ``(max_batch, 1 + num_spec_tokens)`` (speculative
+  decoding only, off by default) — a decode row carries its pending token
+  AND up to `num_spec_tokens` prompt-lookup drafted candidates
+  (serving/spec.py); all positions are scored in one invocation and the
+  accepted prefix advances the sequence by up to ``k + 1`` tokens. Enable
+  with ``spec_decoding=True`` or ``PADDLE_TPU_SPEC_DECODE=1``; with greedy
+  sampling the output is token-for-token identical to non-speculative
+  decode, and with temperature sampling the verify step runs rejection
+  sampling against the same temperature/top-k/top-p-processed
+  distribution, so the output distribution is unchanged.
 
 Prefill buckets are gone: a prompt of ANY length streams into the arena
 `prefill_chunk` tokens at a time while the running batch keeps decoding in
@@ -61,7 +71,8 @@ class LLMEngine:
     def __init__(self, model, block_size=16, num_blocks=None, max_batch=4,
                  prefill_chunk=None, token_budget=None, max_seq_len=None,
                  prefill_buckets=None, prefill_interval=None, seed=0,
-                 prefix_cache=None):
+                 prefix_cache=None, spec_decoding=None, num_spec_tokens=4,
+                 spec_max_ngram=3, spec_min_ngram=1):
         import jax
 
         model.eval()
@@ -97,6 +108,27 @@ class LLMEngine:
             _env_flag("PADDLE_TPU_PREFIX_CACHE", True)
             if prefix_cache is None else bool(prefix_cache)
         )
+        # speculative decoding: default OFF; constructor arg wins over the
+        # PADDLE_TPU_SPEC_DECODE env gate. num_spec_tokens fixes the verify
+        # program's width (per-request knobs can only lower the draft cap)
+        self.spec_decoding = (
+            _env_flag("PADDLE_TPU_SPEC_DECODE", False)
+            if spec_decoding is None else bool(spec_decoding)
+        )
+        self.num_spec_tokens = int(num_spec_tokens)
+        drafter = None
+        if self.spec_decoding:
+            from .spec import NgramDrafter
+
+            if self.num_spec_tokens + 1 > self.max_seq_len:
+                raise ValueError(
+                    f"num_spec_tokens {self.num_spec_tokens} does not fit "
+                    f"max_seq_len {self.max_seq_len}"
+                )
+            drafter = NgramDrafter(
+                num_spec_tokens=self.num_spec_tokens,
+                max_ngram=spec_max_ngram, min_ngram=spec_min_ngram,
+            )
         self.metrics = ServingMetrics()
         self._params, self._buffers = state_dict_arrays(model)
         dt = model.wte.weight._array.dtype
@@ -110,7 +142,7 @@ class LLMEngine:
             token_budget=int(token_budget),
             prefill_chunk=self.prefill_chunk,
             prefill_interval=prefill_interval, metrics=self.metrics,
-            prefix_cache=self.prefix_cache,
+            prefix_cache=self.prefix_cache, drafter=drafter,
         )
         self._requests = {}
         self._step_fns = {}
@@ -119,16 +151,23 @@ class LLMEngine:
     # -- request lifecycle -------------------------------------------------
 
     def add_request(self, prompt_ids, max_new_tokens=16, temperature=0.0,
-                    eos_token_id=None, request_id=None):
+                    eos_token_id=None, request_id=None, top_k=None,
+                    top_p=None, spec_decoding=None, num_spec_tokens=None):
         """Enqueue one generation request; returns its id. Admission happens
         inside a later `step()` (continuous batching: requests join the
         running batch between decode steps, never blocking them). Prompts of
         any length are accepted — prefill is chunked under the scheduler's
-        token budget, so no prompt can monopolize a step."""
+        token budget, so no prompt can monopolize a step. `top_k`/`top_p`
+        restrict the sampling support (temperature > 0 only; greedy
+        ignores them); `spec_decoding=False` / `num_spec_tokens` opt this
+        request out of (or cap) speculative drafting on a spec-enabled
+        engine."""
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
-                      request_id=request_id)
+                      request_id=request_id, top_k=top_k, top_p=top_p,
+                      spec_decoding=spec_decoding,
+                      num_spec_tokens=num_spec_tokens)
         return self.add(req)
 
     def validate(self, req):
@@ -209,20 +248,23 @@ class LLMEngine:
 
     # -- compiled step -----------------------------------------------------
 
-    def _get_step_fn(self, B, S):
-        """One jitted step program per (batch, width) shape — exactly two
-        exist: the mixed step (max_batch, prefill_chunk) and the decode
-        step (max_batch, 1)."""
-        if (B, S) in self._step_fns:
-            return self._step_fns[(B, S)]
+    def _get_step_fn(self, B, S, kind="step"):
+        """One jitted program per (batch, width, kind) — at most three
+        exist: the mixed step (max_batch, prefill_chunk), the decode step
+        (max_batch, 1), and (speculative engines only) the verify step
+        (max_batch, 1 + num_spec_tokens)."""
+        if (B, S, kind) in self._step_fns:
+            return self._step_fns[(B, S, kind)]
         import jax
         import jax.numpy as jnp
+
+        from .spec import apply_top_k_top_p, spec_accept_arrays
 
         model = self.model
         metrics = self.metrics
 
-        def step(params, buffers, k_arena, v_arena, ids, block_tables,
-                 slots, offs, qpos, q_start, kv_live, last_idx, temps, key):
+        def forward(params, buffers, k_arena, v_arena, ids, block_tables,
+                    slots, offs, qpos, q_start, kv_live):
             # runs at TRACE time only — the test's recompile alarm
             metrics.inc("jit_traces")
             state = PagedState(k_arena, v_arena, block_tables, slots, offs,
@@ -231,19 +273,40 @@ class LLMEngine:
                 model, params, buffers, args=(ids,),
                 kwargs={"caches": state}, training=False,
             )
+            return logits, state
+
+        def step(params, buffers, k_arena, v_arena, ids, block_tables,
+                 slots, offs, qpos, q_start, kv_live, last_idx, temps,
+                 top_ks, top_ps, key):
+            logits, state = forward(params, buffers, k_arena, v_arena, ids,
+                                    block_tables, slots, offs, qpos,
+                                    q_start, kv_live)
             lg = logits[jnp.arange(ids.shape[0]), last_idx].astype(jnp.float32)
             greedy = jnp.argmax(lg, axis=-1)
             scaled = lg / jnp.maximum(temps[:, None], 1e-6)
+            scaled = apply_top_k_top_p(scaled, top_ks, top_ps)
             sampled = jax.random.categorical(key, scaled, axis=-1)
             tok = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
             return tok, state.k, state.v
 
-        fn = jax.jit(step, donate_argnums=(2, 3))
-        self._step_fns[(B, S)] = fn
+        def verify(params, buffers, k_arena, v_arena, ids, block_tables,
+                   slots, offs, qpos, q_start, kv_live, spec_lens, temps,
+                   top_ks, top_ps, key):
+            logits, state = forward(params, buffers, k_arena, v_arena, ids,
+                                    block_tables, slots, offs, qpos,
+                                    q_start, kv_live)
+            accept, out_tok = spec_accept_arrays(
+                logits, ids, spec_lens, temps, top_ks, top_ps, key
+            )
+            return accept, out_tok, state.k, state.v
+
+        fn = jax.jit(verify if kind == "verify" else step,
+                     donate_argnums=(2, 3))
+        self._step_fns[(B, S, kind)] = fn
         return fn
 
     def _run_step(self, fn, ids, tables, slots, offs, qpos, q_start, kv_live,
-                  last_idx, temps):
+                  last_idx, temps, top_ks, top_ps):
         import jax
         import jax.numpy as jnp
 
@@ -253,9 +316,25 @@ class LLMEngine:
             jnp.asarray(ids), jnp.asarray(tables), jnp.asarray(slots),
             jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(q_start),
             jnp.asarray(kv_live), jnp.asarray(last_idx), jnp.asarray(temps),
-            sub,
+            jnp.asarray(top_ks), jnp.asarray(top_ps), sub,
         )
         return np.asarray(tok)  # host sync: the step is done when this lands
+
+    def _run_verify(self, fn, ids, tables, slots, offs, qpos, q_start,
+                    kv_live, spec_lens, temps, top_ks, top_ps):
+        import jax
+        import jax.numpy as jnp
+
+        self._key, sub = jax.random.split(self._key)
+        accept, out_tok, self.pool.k, self.pool.v = fn(
+            self._params, self._buffers, self.pool.k, self.pool.v,
+            jnp.asarray(ids), jnp.asarray(tables), jnp.asarray(slots),
+            jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(q_start),
+            jnp.asarray(kv_live), jnp.asarray(spec_lens),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            sub,
+        )
+        return np.asarray(accept), np.asarray(out_tok)
 
     # -- one engine step ---------------------------------------------------
 
@@ -265,12 +344,19 @@ class LLMEngine:
         rows = self.scheduler.schedule()
         if not rows:
             return []
-        # the dominant all-decode steps run at width 1; any step carrying a
-        # prefill chunk runs at the fixed chunk width — two shapes total
-        S = 1 if all(r.count == 1 for r in rows) else self.prefill_chunk
-        kind = "decode" if S == 1 else "mixed"
+        # the dominant all-decode steps run at width 1; a decode step where
+        # the drafter proposed candidates runs at the fixed verify width;
+        # any step carrying a prefill chunk runs at the fixed chunk width —
+        # three shapes total
+        if any(r.count > 1 for r in rows):
+            S, kind = self.prefill_chunk, "mixed"
+        elif any(r.draft for r in rows):
+            S, kind = 1 + self.num_spec_tokens, "verify"
+        else:
+            S, kind = 1, "decode"
         with self.metrics.timed(f"{kind}_step"):
-            outs = self._step_rows(rows, S)
+            outs = (self._verify_rows(rows, S) if kind == "verify"
+                    else self._step_rows(rows, S))
         self.metrics.inc(f"{kind}_steps")
         self.metrics.set_gauge(
             "tokens_in_flight",
@@ -282,6 +368,22 @@ class LLMEngine:
         )
         self.metrics.set_gauge("num_running", len(self.scheduler.running))
         self.metrics.set_gauge("num_waiting", len(self.scheduler.waiting))
+        c = self.metrics.counters
+        n_steps = (c.get("mixed_steps", 0) + c.get("decode_steps", 0)
+                   + c.get("verify_steps", 0))
+        if n_steps:
+            self.metrics.set_gauge(
+                "tokens_per_step", c.get("generated_tokens", 0) / n_steps
+            )
+        if self.spec_decoding and c.get("spec_proposed_tokens"):
+            self.metrics.set_gauge(
+                "spec_acceptance_rate",
+                c["spec_accepted_tokens"] / c["spec_proposed_tokens"],
+            )
+            self.metrics.set_gauge(
+                "spec_mean_accepted_len",
+                c["spec_accepted_tokens"] / c["spec_drafted_rows"],
+            )
         if self.prefix_cache:
             self.metrics.set_gauge(
                 "prefix_cached_blocks", self.pool.num_cached_blocks
@@ -295,45 +397,121 @@ class LLMEngine:
                 )
         return outs
 
+    def _row_arrays(self, S):
+        """Zeroed per-step host marshalling arrays shared by the step and
+        verify paths (one dict so the two fill loops cannot drift apart
+        on a future per-row field)."""
+        B = self.max_batch
+        return {
+            "ids": np.zeros((B, S), np.int32),
+            "qpos": np.zeros((B, S), np.int32),
+            "slots": np.zeros((B, S), np.int32),
+            "offs": np.zeros((B, S), np.int32),
+            "tables": np.zeros((B, self.max_blocks), np.int32),
+            "temps": np.zeros(B, np.float32),
+            "top_ks": np.zeros(B, np.int32),
+            "top_ps": np.ones(B, np.float32),
+            "q_start": np.zeros(B, np.int32),
+            # idle lanes walk just the null block
+            "kv_live": np.ones(B, np.int32),
+        }
+
+    def _fill_row(self, a, i, req, start, w, S):
+        """Everything about row `i` that does not depend on WHICH tokens
+        are fed: scatter targets for positions [start, start+w), the block
+        table, and the per-row sampling knobs."""
+        a["qpos"][i, :w] = np.arange(start, start + w)
+        a["slots"][i], a["offs"][i] = self.pool.positions_to_slots(
+            req.blocks, start, w, S
+        )
+        a["tables"][i] = self.pool.table_for(req.blocks, self.max_blocks)
+        a["temps"][i] = req.temperature
+        a["top_ks"][i] = req.top_k or 0
+        a["top_ps"][i] = 1.0 if req.top_p is None else req.top_p
+        a["q_start"][i] = start
+        a["kv_live"][i] = (start + w - 1) // self.block_size + 1
+
     def _step_rows(self, rows, S):
         """Run one ragged step: every scheduled row feeds `count` tokens at
         positions [start, start+count); rows whose chunk reaches the
         sequence's last pending token sample its next one."""
-        B = self.max_batch
-        ids = np.zeros((B, S), np.int32)
-        qpos = np.zeros((B, S), np.int32)
-        slots = np.zeros((B, S), np.int32)
-        offs = np.zeros((B, S), np.int32)
-        tables = np.zeros((B, self.max_blocks), np.int32)
-        temps = np.zeros(B, np.float32)
-        last_idx = np.zeros(B, np.int32)
-        q_start = np.zeros(B, np.int32)
-        kv_live = np.ones(B, np.int32)  # idle lanes walk just the null block
+        a = self._row_arrays(S)
+        last_idx = np.zeros(self.max_batch, np.int32)
         for i, row in enumerate(rows):
             req, start, count = row.req, row.start, row.count
             if start == req.num_tokens - 1:
                 # decode fast path: the single pending token is always the
                 # last one — skip rebuilding prompt+outputs every step
-                ids[i, 0] = req.last_token
+                a["ids"][i, 0] = req.last_token
             else:
-                ids[i, :count] = req.all_ids[start:start + count]
-            qpos[i, :count] = np.arange(start, start + count)
-            slots[i], offs[i] = self.pool.positions_to_slots(
-                req.blocks, start, count, S
-            )
-            tables[i] = self.pool.table_for(req.blocks, self.max_blocks)
-            temps[i] = req.temperature
+                a["ids"][i, :count] = req.all_ids[start:start + count]
             last_idx[i] = count - 1
-            q_start[i] = start
-            kv_live[i] = (start + count - 1) // self.block_size + 1
-        fn = self._get_step_fn(B, S)
-        tok = self._run_step(fn, ids, tables, slots, offs, qpos, q_start,
-                             kv_live, last_idx, temps)
+            self._fill_row(a, i, req, start, count, S)
+        fn = self._get_step_fn(self.max_batch, S)
+        tok = self._run_step(fn, a["ids"], a["tables"], a["slots"], a["offs"],
+                             a["qpos"], a["q_start"], a["kv_live"], last_idx,
+                             a["temps"], a["top_ks"], a["top_ps"])
         outs = []
         for i, row in enumerate(rows):
             row.req.num_cached += row.count
             if row.emit:
                 outs.append(self._emit(row.req, int(tok[i])))
+        return outs
+
+    def _verify_rows(self, rows, S):
+        """Run one speculative verify step: every row feeds its pending
+        token plus its (possibly empty) drafted candidates, the jitted
+        verify program scores all positions at once, and the accepted
+        prefix — drafts up to the first rejection, then the model's own
+        token for the stop slot — is emitted. Rejected tails roll back:
+        their KV slots are stale (overwritten before they are ever
+        attended, exactly like any future position) and their reserved
+        blocks return to the pool via `reclaim_spec_blocks`."""
+        a = self._row_arrays(S)
+        spec_lens = np.zeros(self.max_batch, np.int32)
+        for i, row in enumerate(rows):
+            req, start, k = row.req, row.start, len(row.draft)
+            w = 1 + k
+            # drafts only ever attach to emitting decode rows, so the fed
+            # token at `start` is the pending last token; a non-emitting
+            # 1-token chunk row (mid-prefill under budget=1) rides along
+            # draftless and feeds its chunk token
+            a["ids"][i, 0] = (req.last_token if start == req.num_tokens - 1
+                              else req.all_ids[start])
+            if k:
+                a["ids"][i, 1:w] = row.draft
+            spec_lens[i] = k
+            self._fill_row(a, i, req, start, w, S)
+        fn = self._get_step_fn(self.max_batch, S, kind="verify")
+        accept, out_tok = self._run_verify(
+            fn, a["ids"], a["tables"], a["slots"], a["offs"], a["qpos"],
+            a["q_start"], a["kv_live"], spec_lens, a["temps"], a["top_ks"],
+            a["top_ps"],
+        )
+        outs = []
+        for i, row in enumerate(rows):
+            req, k = row.req, len(row.draft)
+            if not row.emit:
+                req.num_cached += 1
+                continue
+            n_acc = 0
+            while n_acc < k and accept[i, n_acc]:
+                n_acc += 1
+            if k:
+                self.metrics.inc("spec_drafted_rows")
+                self.metrics.inc("spec_proposed_tokens", k)
+                self.metrics.inc("spec_accepted_tokens", n_acc)
+            # the fed run [pending, accepted drafts] is real sequence
+            # content, so its KV is valid — advance num_cached BEFORE
+            # emitting (an eos inside the run finishes the request, and
+            # release publishes full prompt blocks off num_cached)
+            req.num_cached += 1 + n_acc
+            for t in list(row.draft[:n_acc]) + [int(out_tok[i, n_acc])]:
+                outs.append(self._emit(req, int(t)))
+                if req.finished:
+                    break
+            if not req.finished:
+                self.scheduler.reclaim_spec_blocks(req)
         return outs
 
     def _emit(self, req, token):

@@ -186,11 +186,15 @@ class AsyncLLMEngine:
     # -- request API (event-loop thread) -----------------------------------
 
     def submit(self, prompt_ids, max_new_tokens=16, temperature=0.0,
-               eos_token_id=None, timeout_s=None, request_id=None):
+               eos_token_id=None, timeout_s=None, request_id=None,
+               top_k=None, top_p=None, spec_decoding=None,
+               num_spec_tokens=None):
         """Admit one request; returns its RequestStream. Raises
         EngineClosedError when draining/stopped, EngineOverloadedError when
         the bounded wait queue is full, ValueError on a bad request —
-        all BEFORE the request reaches the engine thread."""
+        all BEFORE the request reaches the engine thread. `top_k`/`top_p`
+        restrict the sampling support; `spec_decoding`/`num_spec_tokens`
+        opt out of (or cap) speculative drafting per request."""
         from .scheduler import Request
 
         if self._closed:
@@ -207,7 +211,9 @@ class AsyncLLMEngine:
             )
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
-                      request_id=request_id)
+                      request_id=request_id, top_k=top_k, top_p=top_p,
+                      spec_decoding=spec_decoding,
+                      num_spec_tokens=num_spec_tokens)
         self.engine.validate(req)
         if self.engine.prefix_cache:
             # chain the prompt's block hashes HERE, off the engine thread:

@@ -25,6 +25,18 @@ engine/scheduler/pool emit when caching is on:
   (copy-on-write duplications of shared blocks);
 - gauges `prefix_cache_hit_rate` (cumulative hit/lookup) and
   `prefix_cached_blocks` (blocks parked in the cached-free tier).
+
+The speculative-decoding series (engine emits when spec decoding is on):
+
+- counters `spec_proposed_tokens` (drafted candidates fed through verify
+  steps), `spec_accepted_tokens` (candidates that survived verification),
+  `spec_drafted_rows` (verify rows that carried a draft), `verify_steps`
+  and the `verify_step` duration series (next to `mixed_step` /
+  `decode_step`);
+- gauges `spec_acceptance_rate` (cumulative accepted/proposed),
+  `spec_mean_accepted_len` (accepted per drafted row), and
+  `tokens_per_step` (generated tokens per device step — THE number
+  speculative decoding exists to raise above 1.0).
 """
 from __future__ import annotations
 

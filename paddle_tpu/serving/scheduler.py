@@ -44,6 +44,20 @@ sequence, which fails loudly as a config error.
   (`_release_blocks`), which publishes the hashes of fully-written full
   prompt blocks — freed blocks land in the pool's cached-free tier and
   stay matchable until evicted.
+
+**Speculative decoding** (serving/spec.py) extends a pure-decode step's
+rows in a post-planning pass: when every planned row is a 1-token decode
+row and a drafter is configured, `_attach_drafts` asks the prompt-lookup
+drafter for up to ``num_spec_tokens`` candidate continuations per row and
+reserves KV blocks for them through `_reserve_spec`. The reservation is
+deliberately second-class memory traffic: it only takes TRULY-free blocks
+(never evicts cached prefixes, never preempts another sequence —
+speculation must not steal from real work), drafted tokens are charged to
+the step's ``token_budget``, and a short pool simply trims the draft.
+After verification the engine calls `reclaim_spec_blocks`, which frees
+the reservation's rejected tail (always private, never published) so any
+interleaving of accepts, rejections, preemptions, and aborts returns the
+pool to its idle free count.
 """
 from __future__ import annotations
 
@@ -60,14 +74,21 @@ ABORTED = "aborted"
 # One planned row of the next mixed step: feed `req.all_ids[start:start+count]`
 # at positions [start, start+count); `emit` marks rows whose last fed position
 # is the sequence's final pending token — the engine samples their next token.
-ScheduledRow = namedtuple("ScheduledRow", ["req", "start", "count", "emit"])
+# `draft` (speculative decoding, pure-decode steps only) carries up to
+# num_spec_tokens drafted candidates fed AFTER the pending token; blocks for
+# them are already reserved when the row is returned.
+ScheduledRow = namedtuple(
+    "ScheduledRow", ["req", "start", "count", "emit", "draft"],
+    defaults=((),),
+)
 
 
 class Request:
     """One generation request and its host-side serving state."""
 
     def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
-                 eos_token_id=None, request_id=None):
+                 eos_token_id=None, request_id=None, top_k=None, top_p=None,
+                 spec_decoding=None, num_spec_tokens=None):
         self.request_id = (
             request_id if request_id is not None else next(_rid_counter)
         )
@@ -78,6 +99,24 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.temperature = float(temperature)
+        # sampling support restriction (0/None = off): top-k keeps the k
+        # highest-probability tokens, top-p the smallest nucleus reaching p
+        self.top_k = None if top_k in (None, 0) else int(top_k)
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError("top_k must be >= 1 (or 0/None to disable)")
+        self.top_p = None if top_p is None else float(top_p)
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        # speculative decoding overrides: None defers to the engine; False
+        # (or num_spec_tokens=0) opts this request out; num_spec_tokens
+        # lowers the per-row draft cap (never raises it past the engine's
+        # compiled verify width)
+        self.spec_decoding = spec_decoding
+        self.num_spec_tokens = (
+            None if num_spec_tokens is None else int(num_spec_tokens)
+        )
+        if self.num_spec_tokens is not None and self.num_spec_tokens < 0:
+            raise ValueError("num_spec_tokens must be >= 0")
         self.eos_token_id = eos_token_id
         self.output_ids = []
         self.state = WAITING
@@ -128,7 +167,7 @@ class Request:
 class Scheduler:
     def __init__(self, pool, max_batch=8, token_budget=2048,
                  prefill_chunk=None, prefill_interval=None, metrics=None,
-                 prefix_cache=True):
+                 prefix_cache=True, drafter=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.token_budget = int(token_budget)
@@ -148,6 +187,9 @@ class Scheduler:
         # every step, so prefill never needs rationing to protect latency)
         self.metrics = metrics
         self.prefix_cache = bool(prefix_cache)
+        # speculative decoding: a drafter (serving/spec.py NgramDrafter)
+        # makes pure-decode steps carry drafted candidates; None = off
+        self.drafter = drafter
         self.waiting = deque()
         self.running = []
 
@@ -359,4 +401,89 @@ class Scheduler:
                 # so a deferred/preempted chunk's share flows to later rows
                 budget -= count
             rows.append(ScheduledRow(req, start, count, emit=count == pending))
+        if (self.drafter is not None and rows
+                and all(r.count == 1 for r in rows)):
+            # pure-decode step: every row feeds exactly one token, so the
+            # verify program's (max_batch, 1 + num_spec) width can carry
+            # drafted candidates. Steps with prefill chunks never draft —
+            # the mixed program stays one of exactly three.
+            rows = self._attach_drafts(rows, budget)
         return rows
+
+    # -- speculative decoding ----------------------------------------------
+
+    def _attach_drafts(self, rows, budget):
+        """Ask the drafter for candidate continuations of each emitting
+        decode row and reserve KV for them. Drafted tokens are charged to
+        the remaining step `budget` (a verify step's extra width is real
+        compute); rows keep their plain-decode shape when the request opted
+        out, nothing matched, or memory/budget ran dry.
+
+        Majority gate: a verify step pays its full ``1 + num_spec`` width
+        for EVERY lane, drafted or not, so when fewer than half the rows
+        have a proposal the whole step stays plain decode — the occasional
+        lone draft cannot tax the batch (proposals are host-side and free;
+        nothing is reserved before the gate passes)."""
+        proposals = []
+        n_proposing = 0
+        for row in rows:
+            req = row.req
+            cap = self.drafter.num_spec_tokens
+            if req.num_spec_tokens is not None:
+                cap = min(cap, req.num_spec_tokens)
+            # the accepted run emits up to k+1 tokens; never draft past the
+            # request's remaining token allowance
+            cap = min(cap, req.remaining_new_tokens() - 1)
+            draft = []
+            if row.emit and req.spec_decoding is not False and cap >= 1:
+                draft = self.drafter.propose(req.all_ids, cap)
+            proposals.append(draft)
+            n_proposing += bool(draft)
+        if 2 * n_proposing < len(rows):
+            return rows
+        out = []
+        for row, draft in zip(rows, proposals):
+            draft = draft[:budget]
+            if draft:
+                draft = self._reserve_spec(row.req, row.start, draft)
+            if draft:
+                budget -= len(draft)
+                row = row._replace(draft=tuple(draft))
+            out.append(row)
+        return out
+
+    def _reserve_spec(self, req, start, draft):
+        """Reserve KV blocks for `draft` speculative tokens after the
+        pending token at `start`; returns the (possibly trimmed) draft.
+
+        Speculation is an optimization, so its memory is second-class: only
+        TRULY-free blocks are taken (``evict=False`` — a drafted token must
+        never evict a cached prefix) and no sequence is ever preempted for
+        one. The pending token's own block was already made writable by
+        `_ensure_writable`, and planned rows only ever own blocks through
+        ``start // block_size``, so every reserved block is freshly
+        allocated (refcount 1, unpublished) — `reclaim_spec_blocks` can
+        free a rejected tail without touching shared state."""
+        bs = self.pool.block_size
+        avail = self.pool.num_truly_free
+        k = min(len(draft), (len(req.blocks) + avail) * bs - start - 1)
+        if k < 1:
+            return []
+        need = self.pool.blocks_for(start + 1 + k) - len(req.blocks)
+        if need > 0:
+            got = self.pool.allocate(need, evict=False)
+            if got is None:  # raced nothing (host-side), but stay safe
+                return []
+            req.blocks.extend(got)
+        return draft[:k]
+
+    def reclaim_spec_blocks(self, req):
+        """Roll back the speculative reservation's rejected tail after a
+        verify step: keep the blocks covering the sequence's tokens (the
+        new pending token included), truly-free the rest. The freed blocks
+        are always private and unpublished (see `_reserve_spec`), so
+        refcounts, prefix-cache hashes, and COW state are untouched."""
+        keep = self.pool.blocks_for(req.num_tokens)
+        if len(req.blocks) > keep:
+            self.pool.release(req.blocks[keep:])
+            del req.blocks[keep:]

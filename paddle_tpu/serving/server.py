@@ -5,7 +5,10 @@ framework), one process, loopback-friendly for tests. Endpoints:
 
 - ``POST /v1/completions`` — OpenAI-style body. ``prompt`` is a list of
   token ids (the repo ships no tokenizer; ``token_ids`` come back in every
-  choice and ``text`` is the space-joined ids). ``stream: true`` sends
+  choice and ``text`` is the space-joined ids). Sampling knobs:
+  ``temperature`` (0 = greedy), ``top_k``, ``top_p``; speculative-decoding
+  overrides ``spec_decoding`` / ``num_spec_tokens`` apply when the engine
+  was built with it enabled. ``stream: true`` sends
   server-sent events, one token per ``data:`` chunk, terminated by
   ``data: [DONE]``. Admission control maps straight onto status codes:
   429 when the bounded wait queue is full (`EngineOverloadedError`), 503
@@ -219,6 +222,18 @@ class ServingServer:
                 )
             max_tokens = int(spec.get("max_tokens", 16))
             temperature = float(spec.get("temperature", 0.0))
+            top_k = spec.get("top_k")
+            if top_k is not None:
+                top_k = int(top_k)
+            top_p = spec.get("top_p")
+            if top_p is not None:
+                top_p = float(top_p)
+            spec_decoding = spec.get("spec_decoding")
+            if spec_decoding is not None:
+                spec_decoding = bool(spec_decoding)
+            num_spec_tokens = spec.get("num_spec_tokens")
+            if num_spec_tokens is not None:
+                num_spec_tokens = int(num_spec_tokens)
             eos = spec.get("eos_token_id", spec.get("stop_token_id"))
             if eos is not None:
                 eos = int(eos)
@@ -234,7 +249,9 @@ class ServingServer:
         try:
             st = self.engine.submit(
                 prompt, max_new_tokens=max_tokens, temperature=temperature,
-                eos_token_id=eos, timeout_s=timeout_s,
+                eos_token_id=eos, timeout_s=timeout_s, top_k=top_k,
+                top_p=top_p, spec_decoding=spec_decoding,
+                num_spec_tokens=num_spec_tokens,
             )
         except EngineOverloadedError as e:
             writer.write(_http_response(
@@ -367,6 +384,13 @@ def main(argv=None):
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable automatic prefix caching (same as "
                         "PADDLE_TPU_PREFIX_CACHE=0)")
+    p.add_argument("--spec-decode", action="store_true",
+                   help="enable speculative decoding (prompt-lookup "
+                        "drafting + batched verify; same as "
+                        "PADDLE_TPU_SPEC_DECODE=1)")
+    p.add_argument("--num-spec-tokens", type=int, default=4,
+                   help="drafted tokens per decode row when speculative "
+                        "decoding is on (fixes the verify program width)")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -379,6 +403,8 @@ def main(argv=None):
         model, block_size=args.block_size, max_batch=args.max_batch,
         max_seq_len=args.max_seq_len, prefill_chunk=args.prefill_chunk,
         prefix_cache=False if args.no_prefix_cache else None,
+        spec_decoding=True if args.spec_decode else None,
+        num_spec_tokens=args.num_spec_tokens,
     )
 
     async def run():

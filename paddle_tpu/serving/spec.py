@@ -1,0 +1,226 @@
+"""Speculative decoding: prompt-lookup (n-gram) drafting + batched
+parallel verification.
+
+Decode latency is lower-bounded by one model invocation per token — unless
+several tokens are scored per invocation. This module supplies the two
+halves the engine/scheduler wire together:
+
+- **`NgramDrafter`** (host side, no draft model): propose up to
+  ``num_spec_tokens`` continuation candidates for a decoding sequence by
+  matching its most recent *n*-gram suffix against its OWN prompt+output
+  history (prompt-lookup decoding). Free to compute, and strong exactly
+  where serving traffic is repetitive — extraction, code edits, structured
+  output, any decode that quotes its prompt.
+- **verification math** (device side): a decode row carries its pending
+  token AND the k drafted tokens; one jitted step scores all ``k+1``
+  positions at once (the third compiled serving program, shape
+  ``(max_batch, 1 + num_spec_tokens)``, next to mixed and decode).
+  `spec_accept_arrays` turns the step's logits into per-position accept
+  flags plus the token to emit where the accepted run stops:
+
+  - **greedy** (``temperature == 0``): drafted token j is accepted iff it
+    equals the argmax at position j-1 — the emitted run is by construction
+    token-for-token identical to sequential greedy decode (each accepted
+    draft IS the token non-speculative decode would have fed next, so the
+    chained logits are the sequential logits);
+  - **sampling**: rejection sampling against the processed distribution
+    (temperature, then `apply_top_k_top_p`). The n-gram draft is a point
+    mass q = δ(d), so drafted token d is accepted with probability p(d),
+    and on rejection the replacement is drawn from the residual
+    ``p·(1 - δ(d))`` renormalized — the emitted tokens are distributed
+    exactly as sequential sampling from p (the standard speculative
+    rejection-sampling identity, here with a deterministic proposer).
+
+The accepted prefix advances the sequence by up to ``k+1`` tokens per
+step; the host loop (engine `_verify_rows`) truncates at the first
+rejection and rolls back the speculative KV-block reservation for the
+rejected tail (scheduler `reclaim_spec_blocks`).
+"""
+from __future__ import annotations
+
+
+class NgramDrafter:
+    """Prompt-lookup drafting: match the sequence's recent suffix against
+    its own history and propose what followed the previous occurrence.
+
+    For n from ``max_ngram`` down to ``min_ngram``: take the last n tokens
+    of prompt+outputs, find the most recent earlier occurrence of that
+    n-gram WITH a full ``max_tokens`` continuation, and propose the tokens
+    that followed it. Longer n-grams are tried first (a longer context
+    match is a better predictor). Matches too close to the sequence end
+    to supply a full draft are only a fallback: on cyclic output — the
+    dominant accepting regime — the nearest match sits just before the
+    suffix and would truncate the draft to a token or two, while a match
+    one period further back drafts the whole window (the verify step pays
+    its full ``1 + num_spec`` width either way, so short drafts waste
+    it). Returns ``[]`` when nothing matches — the row then runs as a
+    plain decode row, so drafting can never slow a sequence down by more
+    than the (amortized) verify-width cost.
+    """
+
+    def __init__(self, num_spec_tokens=4, max_ngram=3, min_ngram=1):
+        self.num_spec_tokens = int(num_spec_tokens)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        if self.num_spec_tokens < 1:
+            raise ValueError("num_spec_tokens must be >= 1")
+        if not 1 <= self.min_ngram <= self.max_ngram:
+            raise ValueError("need 1 <= min_ngram <= max_ngram")
+
+    def propose(self, all_ids, max_tokens=None):
+        """Drafted continuation of `all_ids` (list of ints), at most
+        ``min(max_tokens, num_spec_tokens)`` tokens; ``[]`` on no match.
+
+        The match itself is vectorized: per n-gram size, n shifted
+        numpy comparisons AND-ed over all candidate start positions —
+        this runs once per decode row per step, so a Python loop over a
+        multi-thousand-token history would put O(L) interpreter work on
+        the host path that speculation exists to shorten."""
+        import numpy as np
+
+        cap = self.num_spec_tokens
+        if max_tokens is not None:
+            cap = min(cap, int(max_tokens))
+        L = len(all_ids)
+        if cap < 1 or L < self.min_ngram + 1:
+            return []
+        arr = np.asarray(all_ids, np.int64)
+        for n in range(min(self.max_ngram, L - 1), self.min_ngram - 1, -1):
+            suffix = arr[L - n:]
+            # candidate starts i in [0, L-n-1]: i + n <= L - 1 guarantees
+            # at least one continuation token exists
+            m = np.ones(L - n, bool)
+            for j in range(n):
+                m &= arr[j:j + L - n] == suffix[j]
+            hits = np.flatnonzero(m)
+            if not hits.size:
+                continue
+            # most recent match with a FULL draft window; a match too
+            # close to the end (truncated draft) only as a fallback
+            full = hits[hits + n + cap <= L]
+            i = int(full[-1] if full.size else hits[-1])
+            return arr[i + n:i + n + cap].tolist()
+        return []
+
+
+def apply_top_k_top_p(scaled, top_ks, top_ps):
+    """Mask `scaled` logits ``[..., V]`` to the per-row top-k / nucleus
+    top-p support. ``top_ks`` (int, 0 = off) and ``top_ps`` (float, 1.0 =
+    off) broadcast against ``scaled[..., 0]``. Top-k keeps the k largest
+    logits (ties at the k-th value all survive, matching `GPT.generate`);
+    top-p keeps the smallest set of tokens whose descending-probability
+    cumsum reaches p (ties at the cutoff survive). The top-1 token always
+    survives both, so the masked row is never empty; greedy argmax is
+    unchanged by construction.
+
+    The filter needs a full descending sort of the vocab axis — by far
+    the most expensive non-model op in a step — so the whole thing sits
+    behind a ``lax.cond``: batches where every row has both knobs off
+    (the common greedy/temperature-only case) skip it at RUNTIME while
+    still sharing the one compiled program."""
+    import jax
+    import jax.numpy as jnp
+
+    V = scaled.shape[-1]
+    active = jnp.any(((top_ks > 0) & (top_ks < V)) | (top_ps < 1.0))
+    return jax.lax.cond(
+        active, _apply_top_k_top_p, lambda s, k, p: s,
+        scaled, top_ks, top_ps,
+    )
+
+
+def _apply_top_k_top_p(scaled, top_ks, top_ps):
+    import jax
+    import jax.numpy as jnp
+
+    V = scaled.shape[-1]
+    tk = top_ks[..., None]
+    # ONE descending sort serves both filters: softmax is monotone, so the
+    # top-k prefix of the sorted logits IS the top-k-filtered distribution
+    # in sorted order (a second sort of the probabilities would be the
+    # verify step's single most expensive non-model op)
+    svals = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(svals, jnp.clip(tk - 1, 0, V - 1), axis=-1)
+    k_active = (tk > 0) & (tk < V)
+    scaled = jnp.where(k_active & (scaled < kth), -jnp.inf, scaled)
+    tp = top_ps[..., None]
+    # nucleus over the top-k SURVIVORS (sequential semantics): positions
+    # past k in the sorted order drop out of the softmax/cumsum
+    in_k = ~k_active | (jnp.arange(V) < tk)
+    sp = jax.nn.softmax(jnp.where(in_k, svals, -jnp.inf), axis=-1)
+    csum = jnp.cumsum(sp, axis=-1)
+    # the LOGIT of the last token inside the nucleus: the value at the
+    # first index where the cumulative mass reaches p (argmax finds the
+    # first True). Cutting in logit space keeps comparisons exact — sorted
+    # values are bit-copies of `scaled`, whereas a recomputed probability
+    # can drift an ulp and mask the whole row. When float32 cumsum tops
+    # out BELOW p (p near 1 on a large vocab), argmax of all-False would
+    # be 0 — the cut must fall to the last position (keep everything),
+    # not the first (collapse to greedy)
+    reached = csum >= tp
+    cut_idx = jnp.where(
+        reached.any(axis=-1, keepdims=True),
+        jnp.argmax(reached, axis=-1)[..., None], V - 1,
+    )
+    cut_logit = jnp.take_along_axis(svals, cut_idx, axis=-1)
+    return jnp.where((tp < 1.0) & (scaled < cut_logit), -jnp.inf, scaled)
+
+
+def spec_accept_arrays(logits, ids, spec_lens, temps, top_ks, top_ps, key):
+    """Verify-step accept/emit math (runs inside the jitted verify
+    program). All inputs are jnp arrays:
+
+      logits    [B, S, V]  float — model logits at the S fed positions
+                (position j scored the row's prefix through fed token j)
+      ids       [B, S] int — fed tokens: ``ids[:, 0]`` is the pending
+                token, ``ids[:, 1:]`` the drafted candidates (padded rows
+                beyond each row's draft are ignored via `spec_lens`)
+      spec_lens [B] int — live drafted tokens per row (0 = plain decode)
+      temps/top_ks/top_ps [B] — per-row sampling params
+      key       PRNG key
+
+    Returns ``(accept [B, S-1] bool, out_tok [B, S] int32)``:
+    ``accept[:, j]`` says drafted token ``ids[:, j+1]`` survives at slot
+    j; ``out_tok[:, j]`` is the token to emit where the accepted run stops
+    at slot j — the greedy argmax / rejection-residual sample for a
+    rejection slot, the full-distribution sample for the bonus slot
+    (``j == spec_lens``). The host emits ``draft[:a] + [out_tok[a]]``
+    where ``a`` is the count of leading accepts."""
+    import jax
+    import jax.numpy as jnp
+
+    B, S, V = logits.shape
+    lg = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lg, axis=-1)                   # [B, S]
+    scaled = lg / jnp.maximum(temps, 1e-6)[:, None, None]
+    scaled = apply_top_k_top_p(
+        scaled, top_ks[:, None], top_ps[:, None]
+    )
+    probs = jax.nn.softmax(scaled, axis=-1)
+    drafts = ids[:, 1:]                                # [B, S-1]
+    p_draft = jnp.take_along_axis(
+        probs[:, :-1], drafts[..., None], axis=-1
+    )[..., 0]                                          # [B, S-1]
+    k_u, k_r, k_b = jax.random.split(key, 3)
+    u = jax.random.uniform(k_u, (B, S - 1))
+    accept = jnp.where(
+        temps[:, None] > 0.0,
+        u < p_draft,
+        drafts == greedy[:, :-1],
+    )
+    # residual for a rejection at slot j: p with the drafted token zeroed
+    # (q is a point mass, so max(0, p - q) renormalized = p minus d's mass)
+    resid = probs[:, :-1] * (1.0 - jax.nn.one_hot(drafts, V, dtype=probs.dtype))
+    resid_tok = jax.random.categorical(k_r, jnp.log(resid), axis=-1)
+    full_tok = jax.random.categorical(k_b, jnp.log(probs), axis=-1)
+    # bonus slot (all live drafts accepted) samples the FULL distribution;
+    # rejection slots sample the residual. resid_tok has no column for the
+    # last slot, which can only ever be a bonus slot.
+    is_bonus = jnp.arange(S)[None, :] >= spec_lens[:, None]
+    sample_tok = jnp.where(
+        is_bonus,
+        full_tok,
+        jnp.concatenate([resid_tok, full_tok[:, -1:]], axis=1),
+    )
+    out_tok = jnp.where(temps[:, None] > 0.0, sample_tok, greedy)
+    return accept, out_tok.astype(jnp.int32)

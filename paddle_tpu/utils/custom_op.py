@@ -24,6 +24,7 @@ Missing backward => the op is forward-only (stop_gradient outputs).
 from __future__ import annotations
 
 import ctypes
+import warnings
 
 import numpy as np
 
@@ -36,6 +37,61 @@ from ..ops._helpers import T
 from . import cpp_extension
 
 REGISTRY = {}
+
+# ops that already warned about being traced into a compiled program —
+# one warning per op name, not one per trace (a bucketed predictor can
+# legitimately trace the same program several times)
+_TRACE_WARNED = set()
+
+
+def _in_abstract_trace(x):
+    """True when `x` is being traced into a COMPILED program (jit /
+    static-graph replay) — a DynamicJaxprTracer, possibly wrapped in
+    autodiff tracers (jit-of-grad). Eager autodiff also passes tracers
+    through (jax.vjp linearization), but their `.primal` chain bottoms
+    out at a concrete array, not a jaxpr tracer — no warning there."""
+    try:
+        from jax.interpreters import partial_eval as pe
+
+        dyn = pe.DynamicJaxprTracer
+    except Exception:  # noqa: BLE001 — schema drift: fall back to coarse
+        try:
+            return isinstance(x, jax.core.Tracer)
+        except Exception:  # noqa: BLE001 — diagnostics must never crash
+            return False
+    for _ in range(8):  # unwrap nested autodiff/batching tracers
+        if isinstance(x, dyn):
+            return True
+        # JVPTracer carries `.primal`, vmap's BatchTracer carries `.val`
+        nxt = getattr(x, "primal", None)
+        if nxt is None:
+            nxt = getattr(x, "val", None)
+        if nxt is None:
+            return False
+        x = nxt
+    return False
+
+
+def _warn_if_traced(name, x):
+    """Warn (once per op) when a host-callback custom op is being TRACED
+    into a jit/static program: the callback does not fuse — every
+    execution of the compiled program pays a device->host round trip
+    (device flush, host ctypes call on a copied buffer, result upload)
+    per call site, serialized against the surrounding program. That cost
+    is invisible at trace time, which is exactly when users assume jit
+    made everything fast."""
+    if name in _TRACE_WARNED or not _in_abstract_trace(x):
+        return
+    _TRACE_WARNED.add(name)
+    warnings.warn(
+        f"custom op '{name}' is a HOST-callback op being traced into a "
+        "jit/static program: every execution pays a device->host round "
+        "trip (sync + host copy + C call) at this call site — it will "
+        "not fuse with surrounding device ops. Keep it outside hot "
+        "compiled loops, or port the kernel to Pallas (ops/pallas/) to "
+        "run it on-device.",
+        stacklevel=4,
+    )
 
 
 def _c_fn(lib, sym, n_bufs):
@@ -88,6 +144,7 @@ def load_custom_op(name, sources, extra_cxx_flags=None, verbose=False):
 
     @jax.custom_vjp
     def f(a):
+        _warn_if_traced(name, a)
         out = jax.pure_callback(
             host_fwd, jax.ShapeDtypeStruct(a.shape, jnp.float32),
             a.astype(jnp.float32),

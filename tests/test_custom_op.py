@@ -97,6 +97,47 @@ def test_custom_op_in_static_program(cube2):
     np.testing.assert_allclose(out[0], [3.0, 12.0, 33.0], rtol=1e-6)
 
 
+def test_traced_host_callback_warns_once(cube2):
+    """VERDICT item 7: tracing a host-callback custom op into a compiled
+    program warns ONCE, naming the per-call host round trip — eager use
+    (including eager autograd) stays silent."""
+    import warnings
+
+    from paddle_tpu.utils import custom_op as co
+
+    co._TRACE_WARNED.discard("cube2")
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning fails the test
+        cube2(x)                        # eager forward: silent
+        xg = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        xg.stop_gradient = False
+        cube2(xg).sum().backward()      # eager autograd: silent
+    assert "cube2" not in co._TRACE_WARNED
+
+    from paddle_tpu import static
+
+    with pytest.warns(UserWarning, match="host.*round trip") as rec:
+        prog = static.Program()
+        with static.program_guard(prog):
+            y = cube2(static.data("x", [2], "float32"))
+        static.Executor().run(
+            prog, feed={"x": np.array([1.0, 2.0], np.float32)},
+            fetch_list=[y],
+        )
+    assert len([w for w in rec if "cube2" in str(w.message)]) == 1
+    # once per op: a second compiled program does not warn again
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        prog2 = static.Program()
+        with static.program_guard(prog2):
+            y2 = cube2(static.data("x", [2], "float32"))
+        static.Executor().run(
+            prog2, feed={"x": np.array([3.0, 4.0], np.float32)},
+            fetch_list=[y2],
+        )
+
+
 def test_forward_only_op_refuses_grad(cpp_source):
     stepfn = load_custom_op("stepfn", [cpp_source])
     x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))

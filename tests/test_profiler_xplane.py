@@ -5,6 +5,7 @@ import os
 import tempfile
 
 import numpy as np
+import pytest
 
 
 def test_summarize_roundtrip():
@@ -141,6 +142,90 @@ def test_schedule_analysis_reports_per_capture():
             assert s["busy_ms"] == 10.0
             assert s["idle_ms"] == 0.0
             assert not s["top_gaps"]
+
+
+def test_real_capture_schema_canary():
+    """VERDICT residual risk: schema drift in jax's xplane output would
+    pass CI (the math tests build captures by hand) and fail in the
+    field. Record a REAL `jax.profiler` capture of a tiny jitted loop and
+    assert every structural property the tool chain depends on, straight
+    off the serialized ``.xplane.pb``:
+
+    - the logdir contains exactly the capture file `find_xplane_files`
+      globs for;
+    - the vendored minimal proto parses it: planes carry lines, lines
+      carry events, and every event's ``metadata_id`` resolves through
+      ``event_metadata`` to a non-empty name with a positive duration
+      (the exact fields `summarize`/`schedule_analysis` read);
+    - the jitted loop is VISIBLE: an op named after our function reaches
+      `summarize`'s op table, so event->metadata name resolution works on
+      real data, not just hand-built messages;
+    - `schedule_analysis` fed the ``.pb`` path (not the dir) yields a
+      plane with at least as many ops as the loop ran steps, a positive
+      span, and a sane utilization.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.profiler import xplane
+    from paddle_tpu.profiler._xplane import xplane_pb2
+
+    steps = 5
+    with tempfile.TemporaryDirectory() as td:
+        @jax.jit
+        def tiny_loop_step(x):
+            return jnp.tanh(x @ x.T).sum()
+
+        x = jnp.ones((128, 128))
+        tiny_loop_step(x).block_until_ready()  # compile outside the trace
+        with jax.profiler.trace(td):
+            acc = jnp.float32(0.0)
+            for _ in range(steps):
+                acc = acc + tiny_loop_step(x)
+            acc.block_until_ready()
+
+        files = xplane.find_xplane_files(td)
+        assert len(files) == 1, os.listdir(td)
+        pb = files[0]
+        assert pb.endswith(".xplane.pb")
+
+        xs = xplane_pb2.XSpace()
+        with open(pb, "rb") as f:
+            xs.ParseFromString(f.read())
+        event_planes = [p for p in xs.planes
+                        if any(line.events for line in p.lines)]
+        assert event_planes, [p.name for p in xs.planes]
+        n_resolved = 0
+        total_dur_ps = 0
+        for plane in event_planes:
+            em = plane.event_metadata
+            for line in plane.lines:
+                for ev in line.events:
+                    assert ev.metadata_id in em, (plane.name, line.name)
+                    assert em[ev.metadata_id].name, ev.metadata_id
+                    total_dur_ps += ev.duration_ps
+                    n_resolved += 1
+        assert n_resolved >= steps
+        # durations must carry real time — a schema change that zeroes
+        # duration_ps would make every busy/utilization stat silently 0
+        assert total_dur_ps > 0
+
+        meta_names = [em[mid].name for plane in event_planes
+                      for em in (plane.event_metadata,) for mid in em]
+        assert any("tiny_loop_step" in n for n in meta_names)
+        # ... and the same op flows through summarize's name resolution
+        # (top= wide enough that a fast op is not cut by busy-time rank)
+        summary = xplane.summarize(pb, device_only=False, top=100000)
+        ops = [name for entry in summary.values()
+               for name, _ in entry["by_op"]]
+        assert any("tiny_loop_step" in name for name in ops)
+
+        st = xplane.schedule_analysis(pb)
+        assert st, "no planes analyzed from the pb file"
+        best = max(st.values(), key=lambda s: s["n_ops"])
+        assert best["n_ops"] >= steps
+        assert best["span_ms"] > 0
+        assert 0 < best["utilization"] <= 1.0
 
 
 def test_schedule_analysis_on_real_cpu_capture():

@@ -264,6 +264,49 @@ def test_server_draining_rejects_with_503(model):
     assert reason == "length"  # the in-flight request finished the drain
 
 
+def test_server_sampling_and_spec_knobs_passthrough(model):
+    """/v1/completions passes top_k/top_p and the speculative-decoding
+    overrides through to the engine: top_k=1 at high temperature is
+    greedy-exact, a spec-enabled server still serves token-exact greedy
+    completions, and the spec series reaches /metrics."""
+    (p,) = _prompts((7,), seed=7)
+    ref = _reference(model, p, 8)
+
+    async def main():
+        engine = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64,
+                           spec_decoding=True, num_spec_tokens=3)
+        server = ServingServer(engine, host="127.0.0.1", port=0)
+        await server.start()
+        status, body = await _http(
+            server.port, "POST", "/v1/completions",
+            {"prompt": p, "max_tokens": 8, "temperature": 3.0, "top_k": 1,
+             "top_p": 0.9},
+        )
+        # per-request opt-out rides the same body
+        ostatus, obody = await _http(
+            server.port, "POST", "/v1/completions",
+            {"prompt": p, "max_tokens": 8, "spec_decoding": False},
+        )
+        bstatus, _ = await _http(server.port, "POST", "/v1/completions",
+                                 {"prompt": p, "top_p": "hot"})
+        mstatus, metrics = await _http(server.port, "GET", "/metrics")
+        await server.shutdown(drain=True)
+        return (engine, status, json.loads(body), ostatus, json.loads(obody),
+                bstatus, mstatus, metrics.decode())
+
+    engine, status, out, ostatus, oout, bstatus, mstatus, metrics = \
+        asyncio.run(main())
+    assert status == 200
+    assert out["choices"][0]["token_ids"] == ref  # top_k=1 == greedy
+    assert ostatus == 200
+    assert oout["choices"][0]["token_ids"] == ref
+    assert bstatus == 400
+    assert mstatus == 200
+    assert "paddle_tpu_serving_spec_proposed_tokens_total" in metrics
+    assert "paddle_tpu_serving_verify_steps_total" in metrics
+    assert _idle(engine)
+
+
 @pytest.mark.slow
 def test_server_soak_mixed_traffic(model):
     """Soak: waves of streamed/non-streamed/cancelled/timed-out requests
