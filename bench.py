@@ -336,6 +336,20 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     generated = engine.metrics.counters["generated_tokens"] - warm_tokens
     if not generated:
         return None
+    # with PADDLE_TPU_TRACE set the engine recorded a lifecycle/step trace
+    # of the whole measured wave — dump it Perfetto-loadable next to the
+    # BENCH json (the per-phase step breakdown perf PRs report against)
+    trace_info = {}
+    if engine.tracer is not None:
+        trace_path = os.environ.get("PADDLE_TPU_TRACE_PATH",
+                                    "bench_serve_trace.json")
+        try:
+            trace_info = {
+                "trace_path": trace_path,
+                "trace_events": engine.tracer.dump(trace_path),
+            }
+        except OSError as e:
+            errors.append(f"gpt_serve: trace dump failed: {e}")
     shared = _serve_shared_prefix(model, cfg, max_batch, rs, errors,
                                   deadline_s, on_tpu)
     spec = _serve_spec_wave(model, cfg, max_batch, rs, errors, deadline_s,
@@ -363,6 +377,7 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
         "jit_traces": int(counters["jit_traces"]),
         "jit_traces_measured": int(counters["jit_traces"] - warm_traces),
         "engine_utilization": round(sched.get("utilization", 0.0), 4),
+        **trace_info,
         **(shared or {}),
         **(spec or {}),
     }

@@ -221,6 +221,78 @@ def print_schedule_analysis(logdir_or_file, top_gaps=10, file=None):
                   f"  before {g['before_op']}", file=f)
 
 
+_STEP_ANNOTATION_RE = re.compile(r"^paddle_tpu\.step (\d+)$")
+
+
+def engine_step_spans(logdir_or_file):
+    """Serving-engine step annotations in a capture: {step_id ->
+    {"start_us", "end_us", "dur_us", "plane"}}.
+
+    While `serving.trace.EngineTracer` is on, the engine wraps every
+    device dispatch in a `jax.profiler.TraceAnnotation` named
+    ``paddle_tpu.step <id>`` with the SAME id the host trace's ``step``
+    span carries. A `jax.profiler.trace` capture taken during a traced
+    serve therefore contains one annotation event per engine step; this
+    walks every plane for them. Duplicate ids (an annotation mirrored on
+    several lines) merge to their union span."""
+    out = {}
+    for path in _capture_paths(logdir_or_file):
+        xs = _load_space(path)
+        for plane in xs.planes:
+            em = plane.event_metadata
+            for line in plane.lines:
+                base = line.timestamp_ns * 1000
+                for ev in line.events:
+                    m = _STEP_ANNOTATION_RE.match(em[ev.metadata_id].name)
+                    if not m:
+                        continue
+                    sid = int(m.group(1))
+                    s = (base + ev.offset_ps) / 1e6      # ps -> us
+                    e = s + ev.duration_ps / 1e6
+                    if sid in out:
+                        s = min(s, out[sid]["start_us"])
+                        e = max(e, out[sid]["end_us"])
+                    out[sid] = {"start_us": s, "end_us": e,
+                                "dur_us": e - s, "plane": plane.name}
+    return out
+
+
+def join_engine_steps(chrome_trace, logdir_or_file):
+    """Join a serving trace (`EngineTracer.chrome_trace()` dict, or a path
+    to its dumped JSON) to a device capture by step id.
+
+    Returns one record per host ``step`` span, sorted by step id:
+    ``{"step", "kind", "host_ts_us", "host_dur_us", "capture_dur_us",
+    "capture_plane"}`` — capture fields are None for steps the capture
+    did not cover (the two recorders have independent lifetimes). The
+    two clocks are unrelated, so only DURATIONS are comparable across
+    the join, never absolute timestamps."""
+    import json as _json
+
+    if isinstance(chrome_trace, str):
+        with open(chrome_trace) as f:
+            chrome_trace = _json.load(f)
+    device = engine_step_spans(logdir_or_file)
+    rows = []
+    for ev in chrome_trace.get("traceEvents", ()):
+        args = ev.get("args") or {}
+        if ev.get("ph") != "X" or "step" not in args \
+                or not ev.get("name", "").startswith("step["):
+            continue
+        sid = args["step"]
+        d = device.get(sid)
+        rows.append({
+            "step": sid,
+            "kind": args.get("kind"),
+            "host_ts_us": ev["ts"],
+            "host_dur_us": ev["dur"],
+            "capture_dur_us": None if d is None else d["dur_us"],
+            "capture_plane": None if d is None else d["plane"],
+        })
+    rows.sort(key=lambda r: r["step"])
+    return rows
+
+
 def print_summary(logdir_or_file, device_only=True, top=20, file=None):
     """Human-readable rendering of summarize() (the reference tool's
     console table)."""

@@ -34,8 +34,13 @@ The async serving frontend (`AsyncLLMEngine` in frontend.py) runs the step
 loop in a background thread and fans tokens out to per-request asyncio
 streams with admission control, deadlines, cancellation, and graceful
 drain; `ServingServer` (server.py, stdlib-only) exposes it over HTTP:
-OpenAI-style `/v1/completions` with SSE streaming, `/healthz`, and a
-Prometheus `/metrics` endpoint. See README "HTTP serving quickstart".
+OpenAI-style `/v1/completions` with SSE streaming, `/healthz` (with pool
+saturation gauges), and a Prometheus `/metrics` endpoint. Observability
+(serving/trace.py, ``PADDLE_TPU_TRACE``): a ring-buffered per-request
+lifecycle + engine-step tracer exporting Perfetto-loadable JSON at
+``GET /debug/trace``, joinable to device xplane captures by step id;
+``PADDLE_TPU_REQUEST_LOG=1`` adds one JSON summary log line per request.
+See README "Observability".
 """
 from .block_pool import (  # noqa: F401
     BlockPool,
@@ -54,3 +59,4 @@ from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .server import ServingServer  # noqa: F401
 from .spec import NgramDrafter, apply_top_k_top_p  # noqa: F401
+from .trace import EngineTracer  # noqa: F401

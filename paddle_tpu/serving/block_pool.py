@@ -158,7 +158,7 @@ class BlockPool:
     """
 
     def __init__(self, num_blocks, num_layers, block_size, num_heads,
-                 head_dim, dtype=None, metrics=None):
+                 head_dim, dtype=None, metrics=None, tracer=None):
         import jax.numpy as jnp
 
         if num_blocks < 2:
@@ -178,6 +178,7 @@ class BlockPool:
         self._cached = OrderedDict()  # refcount-0 indexed blocks, LRU order
         self.evictions = 0
         self.metrics = metrics
+        self.tracer = tracer          # serving/trace.py EngineTracer or None
         self._copy_fn = None          # jitted donated block-copy (lazy)
 
     @property
@@ -219,6 +220,7 @@ class BlockPool:
         if n > (self.num_free if evict else len(self._free)):
             return None
         out = []
+        n_evicted = 0
         for _ in range(n):
             if self._free:
                 b = self._free.pop()
@@ -227,10 +229,16 @@ class BlockPool:
                 h = self._block_hash.pop(b)
                 del self._hash_index[h]
                 self.evictions += 1
+                n_evicted += 1
                 if self.metrics is not None:
                     self.metrics.inc("prefix_cache_evictions")
             self._refcount[b] = 1
             out.append(b)
+        if self.tracer is not None and n_evicted:
+            self.tracer.pool_instant(
+                "evict", {"blocks": n_evicted,
+                          "cached_free": len(self._cached),
+                          "truly_free": len(self._free)})
         return out
 
     def free(self, blocks):

@@ -43,9 +43,24 @@ first uncached token, and freed blocks park in the pool's cached-free LRU
 tier. A cache-hit serve is token-for-token identical to a cold serve
 (tests/test_prefix_cache.py): reused blocks hold exactly the K/V a replay
 would recompute, and writes into shared blocks copy-on-write first.
+
+**Observability** (serving/trace.py, off by default): ``trace=...`` or
+``PADDLE_TPU_TRACE=1`` (or a sampling fraction) turns on the
+ring-buffered lifecycle/step tracer — per-request span trees and a
+per-`step()` phase timeline exported as Perfetto-loadable trace-event
+JSON (``GET /debug/trace`` on the HTTP server, `engine.tracer.dump()`
+anywhere else), with step ids stamped into `jax.profiler` annotations so
+device captures join back to host spans. Disabled, ``self.tracer`` is
+None and every hook is one pointer test. Independently,
+``request_log=True`` / ``PADDLE_TPU_REQUEST_LOG=1`` logs ONE structured
+JSON line per finished/aborted request (queue wait, TTFT, cached/spec
+tokens, preemptions) on the ``paddle_tpu.serving.request`` logger — the
+greppable fallback when full tracing is off.
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
 import time
 from collections import namedtuple
@@ -56,6 +71,8 @@ from ..core.functional import functional_call, state_dict_arrays
 from .block_pool import BlockPool, PagedState, chain_block_hashes
 from .metrics import ServingMetrics
 from .scheduler import Request, Scheduler
+
+_request_log = logging.getLogger("paddle_tpu.serving.request")
 
 StepOutput = namedtuple("StepOutput", ["request_id", "token", "finished"])
 
@@ -72,7 +89,8 @@ class LLMEngine:
                  prefill_chunk=None, token_budget=None, max_seq_len=None,
                  prefill_buckets=None, prefill_interval=None, seed=0,
                  prefix_cache=None, spec_decoding=None, num_spec_tokens=4,
-                 spec_max_ngram=3, spec_min_ngram=1):
+                 spec_max_ngram=3, spec_min_ngram=1, trace=None,
+                 trace_buffer=None, request_log=None):
         import jax
 
         model.eval()
@@ -130,12 +148,36 @@ class LLMEngine:
                 max_ngram=spec_max_ngram, min_ngram=spec_min_ngram,
             )
         self.metrics = ServingMetrics()
+        # tracing: off unless trace/PADDLE_TPU_TRACE asks for it. A value
+        # in (0, 1) samples that fraction of requests; the step timeline
+        # is always recorded while the tracer exists. When off, tracer is
+        # None and every hook site below is a single pointer test — the
+        # untraced serve is byte-identical to the pre-trace engine.
+        from .trace import (EngineTracer, trace_capacity_from_env,
+                            trace_sample_from_env)
+
+        if trace is None:
+            sample = trace_sample_from_env()
+        elif trace is True:
+            sample = 1.0
+        elif trace is False:
+            sample = 0.0
+        else:
+            sample = min(max(float(trace), 0.0), 1.0)
+        cap = (trace_capacity_from_env() if trace_buffer is None
+               else max(16, int(trace_buffer)))
+        self.tracer = (EngineTracer(capacity=cap, sample=sample)
+                       if sample > 0.0 else None)
+        self.request_log = (
+            _env_flag("PADDLE_TPU_REQUEST_LOG", False)
+            if request_log is None else bool(request_log)
+        )
         self._params, self._buffers = state_dict_arrays(model)
         dt = model.wte.weight._array.dtype
         self.pool = BlockPool(
             num_blocks, cfg.num_layers, self.block_size, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, dtype=dt,
-            metrics=self.metrics,
+            metrics=self.metrics, tracer=self.tracer,
         )
         self.scheduler = Scheduler(
             self.pool, max_batch=self.max_batch,
@@ -143,16 +185,19 @@ class LLMEngine:
             prefill_chunk=self.prefill_chunk,
             prefill_interval=prefill_interval, metrics=self.metrics,
             prefix_cache=self.prefix_cache, drafter=drafter,
+            tracer=self.tracer,
         )
         self._requests = {}
         self._step_fns = {}
+        self._phases = {}   # current step's {phase: (t0, t1)} when tracing
         self._key = jax.random.PRNGKey(seed)
 
     # -- request lifecycle -------------------------------------------------
 
     def add_request(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                     eos_token_id=None, request_id=None, top_k=None,
-                    top_p=None, spec_decoding=None, num_spec_tokens=None):
+                    top_p=None, spec_decoding=None, num_spec_tokens=None,
+                    trace=None):
         """Enqueue one generation request; returns its id. Admission happens
         inside a later `step()` (continuous batching: requests join the
         running batch between decode steps, never blocking them). Prompts of
@@ -161,13 +206,14 @@ class LLMEngine:
         restrict the sampling support (temperature > 0 only; greedy
         ignores them); `spec_decoding=False` / `num_spec_tokens` opt this
         request out of (or cap) speculative drafting on a spec-enabled
-        engine."""
+        engine; `trace=True`/`False` forces this request into (out of)
+        the lifecycle tracer regardless of its sampling fraction."""
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=request_id, top_k=top_k, top_p=top_p,
                       spec_decoding=spec_decoding,
-                      num_spec_tokens=num_spec_tokens)
+                      num_spec_tokens=num_spec_tokens, trace=trace)
         return self.add(req)
 
     def validate(self, req):
@@ -210,6 +256,10 @@ class LLMEngine:
         self._requests[req.request_id] = req
         self.scheduler.add(req)
         self.metrics.inc("requests_added")
+        tr = self.tracer
+        if tr is not None and tr.should_trace(req):
+            req.traced = True
+            tr.begin_request(req)
         return req.request_id
 
     def abort(self, request_id):
@@ -225,6 +275,7 @@ class LLMEngine:
             return False
         self.scheduler.abort(req)
         del self._requests[request_id]
+        self._finalize(req, "aborted")
         return True
 
     def has_unfinished(self):
@@ -305,28 +356,46 @@ class LLMEngine:
         self._step_fns[(B, S, kind)] = fn
         return fn
 
+    def _annotation(self, step_id):
+        """While tracing, the device dispatch runs under a jax.profiler
+        TraceAnnotation named after the step id — the join key that lets
+        profiler.xplane.engine_step_spans line device captures up against
+        the host step timeline. A no-op context when tracing is off."""
+        if self.tracer is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.TraceAnnotation(
+            self.tracer.step_annotation(step_id))
+
     def _run_step(self, fn, ids, tables, slots, offs, qpos, q_start, kv_live,
-                  last_idx, temps, top_ks, top_ps):
+                  last_idx, temps, top_ks, top_ps, step_id=0):
+        """Dispatch the step program; returns the DEVICE token array (the
+        caller's np.asarray on it is the step's one host sync)."""
         import jax
         import jax.numpy as jnp
 
         self._key, sub = jax.random.split(self._key)
-        tok, self.pool.k, self.pool.v = fn(
+        args = (
             self._params, self._buffers, self.pool.k, self.pool.v,
             jnp.asarray(ids), jnp.asarray(tables), jnp.asarray(slots),
             jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(q_start),
             jnp.asarray(kv_live), jnp.asarray(last_idx), jnp.asarray(temps),
             jnp.asarray(top_ks), jnp.asarray(top_ps), sub,
         )
-        return np.asarray(tok)  # host sync: the step is done when this lands
+        with self._annotation(step_id):
+            tok, self.pool.k, self.pool.v = fn(*args)
+        return tok
 
     def _run_verify(self, fn, ids, tables, slots, offs, qpos, q_start,
-                    kv_live, spec_lens, temps, top_ks, top_ps):
+                    kv_live, spec_lens, temps, top_ks, top_ps, step_id=0):
         import jax
         import jax.numpy as jnp
 
         self._key, sub = jax.random.split(self._key)
-        accept, out_tok, self.pool.k, self.pool.v = fn(
+        args = (
             self._params, self._buffers, self.pool.k, self.pool.v,
             jnp.asarray(ids), jnp.asarray(tables), jnp.asarray(slots),
             jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(q_start),
@@ -334,13 +403,17 @@ class LLMEngine:
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
             sub,
         )
-        return np.asarray(accept), np.asarray(out_tok)
+        with self._annotation(step_id):
+            accept, out_tok, self.pool.k, self.pool.v = fn(*args)
+        return accept, out_tok
 
     # -- one engine step ---------------------------------------------------
 
     def step(self):
         """Run one mixed (or pure-decode) step; returns [StepOutput] for
         every request that produced a token this step."""
+        tr = self.tracer
+        t_plan0 = time.monotonic() if tr is not None else 0.0
         rows = self.scheduler.schedule()
         if not rows:
             return []
@@ -354,9 +427,22 @@ class LLMEngine:
             S, kind = 1 + self.num_spec_tokens, "verify"
         else:
             S, kind = 1, "decode"
+        step_id = tr.next_step_id() if tr is not None else 0
+        if tr is not None:
+            self._phases = {"plan": (t_plan0, time.monotonic())}
         with self.metrics.timed(f"{kind}_step"):
-            outs = (self._verify_rows(rows, S) if kind == "verify"
-                    else self._step_rows(rows, S))
+            outs = (self._verify_rows(rows, S, step_id) if kind == "verify"
+                    else self._step_rows(rows, S, step_id))
+        if tr is not None:
+            tr.record_step(step_id, kind, self._phases, {
+                "rows": len(rows),
+                "decode_rows": sum(1 for r in rows
+                                   if r.count == 1 and not r.draft),
+                "prefill_rows": sum(1 for r in rows if r.count > 1),
+                "spec_lanes": sum(1 for r in rows if r.draft),
+                "fed_tokens": sum(r.count + len(r.draft) for r in rows),
+                "emitted_tokens": len(outs),
+            })
         self.metrics.inc(f"{kind}_steps")
         self.metrics.set_gauge(
             "tokens_in_flight",
@@ -431,10 +517,12 @@ class LLMEngine:
         a["q_start"][i] = start
         a["kv_live"][i] = (start + w - 1) // self.block_size + 1
 
-    def _step_rows(self, rows, S):
+    def _step_rows(self, rows, S, step_id=0):
         """Run one ragged step: every scheduled row feeds `count` tokens at
         positions [start, start+count); rows whose chunk reaches the
         sequence's last pending token sample its next one."""
+        tr = self.tracer
+        t_build = time.monotonic() if tr is not None else 0.0
         a = self._row_arrays(S)
         last_idx = np.zeros(self.max_batch, np.int32)
         for i, row in enumerate(rows):
@@ -448,17 +536,36 @@ class LLMEngine:
             last_idx[i] = count - 1
             self._fill_row(a, i, req, start, count, S)
         fn = self._get_step_fn(self.max_batch, S)
-        tok = self._run_step(fn, a["ids"], a["tables"], a["slots"], a["offs"],
-                             a["qpos"], a["q_start"], a["kv_live"], last_idx,
-                             a["temps"], a["top_ks"], a["top_ps"])
+        t_disp = time.monotonic() if tr is not None else 0.0
+        tok_dev = self._run_step(
+            fn, a["ids"], a["tables"], a["slots"], a["offs"],
+            a["qpos"], a["q_start"], a["kv_live"], last_idx,
+            a["temps"], a["top_ks"], a["top_ps"], step_id=step_id)
+        t_sync = time.monotonic() if tr is not None else 0.0
+        tok = np.asarray(tok_dev)  # host sync: the step lands here
+        t_emit = time.monotonic() if tr is not None else 0.0
         outs = []
         for i, row in enumerate(rows):
             row.req.num_cached += row.count
             if row.emit:
                 outs.append(self._emit(row.req, int(tok[i])))
+        if tr is not None:
+            t_end = time.monotonic()
+            self._phases.update(build=(t_build, t_disp),
+                                dispatch=(t_disp, t_sync),
+                                sync=(t_sync, t_emit),
+                                emit=(t_emit, t_end))
+            for row in rows:
+                if row.req.traced:
+                    tr.row_span(
+                        row.req,
+                        "prefill_chunk" if row.count > 1 else "decode",
+                        t_disp, t_emit,
+                        {"step": step_id, "start": row.start,
+                         "count": row.count, "emit": row.emit})
         return outs
 
-    def _verify_rows(self, rows, S):
+    def _verify_rows(self, rows, S, step_id=0):
         """Run one speculative verify step: every row feeds its pending
         token plus its (possibly empty) drafted candidates, the jitted
         verify program scores all positions at once, and the accepted
@@ -467,6 +574,8 @@ class LLMEngine:
         their KV slots are stale (overwritten before they are ever
         attended, exactly like any future position) and their reserved
         blocks return to the pool via `reclaim_spec_blocks`."""
+        tr = self.tracer
+        t_build = time.monotonic() if tr is not None else 0.0
         a = self._row_arrays(S)
         spec_lens = np.zeros(self.max_batch, np.int32)
         for i, row in enumerate(rows):
@@ -483,16 +592,26 @@ class LLMEngine:
             spec_lens[i] = k
             self._fill_row(a, i, req, start, w, S)
         fn = self._get_step_fn(self.max_batch, S, kind="verify")
+        t_disp = time.monotonic() if tr is not None else 0.0
         accept, out_tok = self._run_verify(
             fn, a["ids"], a["tables"], a["slots"], a["offs"], a["qpos"],
             a["q_start"], a["kv_live"], spec_lens, a["temps"], a["top_ks"],
-            a["top_ps"],
+            a["top_ps"], step_id=step_id,
         )
+        t_sync = time.monotonic() if tr is not None else 0.0
+        accept, out_tok = np.asarray(accept), np.asarray(out_tok)
+        t_emit = time.monotonic() if tr is not None else 0.0
         outs = []
         for i, row in enumerate(rows):
             req, k = row.req, len(row.draft)
             if not row.emit:
                 req.num_cached += 1
+                if tr is not None and req.traced:
+                    # a draftless chunk row riding a verify step still
+                    # rode the step — its lifecycle must show it
+                    tr.row_span(req, "prefill_chunk", t_disp, t_emit,
+                                {"step": step_id, "start": row.start,
+                                 "count": 1, "emit": False})
                 continue
             n_acc = 0
             while n_acc < k and accept[i, n_acc]:
@@ -501,24 +620,38 @@ class LLMEngine:
                 self.metrics.inc("spec_drafted_rows")
                 self.metrics.inc("spec_proposed_tokens", k)
                 self.metrics.inc("spec_accepted_tokens", n_acc)
+                req.spec_accepted += n_acc
             # the fed run [pending, accepted drafts] is real sequence
             # content, so its KV is valid — advance num_cached BEFORE
             # emitting (an eos inside the run finishes the request, and
             # release publishes full prompt blocks off num_cached)
             req.num_cached += 1 + n_acc
+            if tr is not None and req.traced:
+                tr.row_span(req, "verify", t_disp, t_emit,
+                            {"step": step_id, "drafted": k,
+                             "accepted": n_acc})
             for t in list(row.draft[:n_acc]) + [int(out_tok[i, n_acc])]:
                 outs.append(self._emit(req, int(t)))
                 if req.finished:
                     break
             if not req.finished:
                 self.scheduler.reclaim_spec_blocks(req)
+        if tr is not None:
+            self._phases.update(build=(t_build, t_disp),
+                                dispatch=(t_disp, t_sync),
+                                sync=(t_sync, t_emit),
+                                emit=(t_emit, time.monotonic()))
         return outs
 
     def _emit(self, req, token):
         if not req.output_ids:
+            now = time.monotonic()
+            req.first_token_time = now
             self.metrics.observe(
-                "ttft", time.monotonic() - req.arrival_time, interval=False
+                "ttft", now - req.arrival_time, interval=False
             )
+            if req.traced:
+                self.tracer.first_token(req, now)
         req.output_ids.append(token)
         self.metrics.inc("generated_tokens")
         done = (
@@ -528,7 +661,47 @@ class LLMEngine:
         if done:
             self.scheduler.finish(req)
             self.metrics.inc("requests_finished")
+            self._finalize(req, "finished")
         return StepOutput(req.request_id, token, done)
+
+    def _finalize(self, req, reason):
+        """Request-terminal observability (finish AND abort funnel here):
+        close the lifecycle trace span and emit the one-line JSON summary
+        log. Both are no-ops in the default configuration."""
+        if req.traced:
+            self.tracer.end_request(req, reason)
+        if self.request_log:
+            now = time.monotonic()
+            ms = lambda t: None if t is None else round(t * 1e3, 3)  # noqa: E731
+            _request_log.info(json.dumps({
+                "event": "request_done",
+                "request_id": str(req.request_id),
+                "reason": reason,
+                "prompt_tokens": len(req.prompt_ids),
+                "output_tokens": len(req.output_ids),
+                "prefix_hit_tokens": req.prefix_hit_tokens,
+                "spec_accepted_tokens": req.spec_accepted,
+                "preemptions": req.preemptions,
+                "queue_wait_ms": ms(None if req.admit_time is None
+                                    else req.admit_time - req.arrival_time),
+                "ttft_ms": ms(None if req.first_token_time is None
+                              else req.first_token_time - req.arrival_time),
+                "total_ms": ms(now - req.arrival_time),
+            }, sort_keys=True))
+
+    def pool_stats(self):
+        """Saturation gauges for /healthz (serving/server.py) and
+        operators: block-pool occupancy split by tier plus scheduler queue
+        depths — enough to see saturation without scraping /metrics."""
+        usable = self.pool.num_blocks - 1
+        return {
+            "blocks_total": usable,
+            "blocks_truly_free": self.pool.num_truly_free,
+            "blocks_cached_free": self.pool.num_cached_blocks,
+            "blocks_allocated": usable - self.pool.num_free,
+            "requests_running": len(self.scheduler.running),
+            "requests_waiting": len(self.scheduler.waiting),
+        }
 
     # -- conveniences ------------------------------------------------------
 

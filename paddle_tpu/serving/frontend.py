@@ -188,13 +188,15 @@ class AsyncLLMEngine:
     def submit(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                eos_token_id=None, timeout_s=None, request_id=None,
                top_k=None, top_p=None, spec_decoding=None,
-               num_spec_tokens=None):
+               num_spec_tokens=None, trace=None):
         """Admit one request; returns its RequestStream. Raises
         EngineClosedError when draining/stopped, EngineOverloadedError when
         the bounded wait queue is full, ValueError on a bad request —
         all BEFORE the request reaches the engine thread. `top_k`/`top_p`
         restrict the sampling support; `spec_decoding`/`num_spec_tokens`
-        opt out of (or cap) speculative drafting per request."""
+        opt out of (or cap) speculative drafting per request;
+        `trace=True`/`False` forces this request into (out of) the
+        engine's lifecycle tracer regardless of its sampling fraction."""
         from .scheduler import Request
 
         if self._closed:
@@ -213,7 +215,7 @@ class AsyncLLMEngine:
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=request_id, top_k=top_k, top_p=top_p,
                       spec_decoding=spec_decoding,
-                      num_spec_tokens=num_spec_tokens)
+                      num_spec_tokens=num_spec_tokens, trace=trace)
         self.engine.validate(req)
         if self.engine.prefix_cache:
             # chain the prompt's block hashes HERE, off the engine thread:

@@ -46,6 +46,57 @@ from collections import defaultdict
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
+# HELP text for the well-known series (open-ended producers get a generic
+# fallback). Scrapers surface these verbatim, so say what the number IS,
+# not how it is computed.
+_HELP = {
+    "requests_added": "Requests accepted by the engine",
+    "requests_finished": "Requests that ran to natural completion",
+    "requests_aborted": "Requests cancelled in-flight (disconnect, "
+                        "deadline, policy)",
+    "requests_rejected": "Requests rejected at admission (bounded queue "
+                         "full)",
+    "generated_tokens": "Tokens emitted across all requests",
+    "preemptions": "Sequences preempted-by-recompute for KV blocks",
+    "mixed_steps": "Device steps carrying at least one prefill chunk",
+    "decode_steps": "Pure-decode device steps",
+    "verify_steps": "Speculative verify device steps",
+    "jit_traces": "XLA program traces (recompile alarm; constant after "
+                  "warmup)",
+    "mixed_step": "Mixed-step wall time",
+    "decode_step": "Decode-step wall time",
+    "verify_step": "Verify-step wall time",
+    "ttft": "Request arrival to first emitted token",
+    "tokens_in_flight": "Tokens held by running sequences",
+    "num_running": "Sequences in the running batch",
+    "num_waiting": "Requests waiting for a lane",
+    "block_utilization": "Fraction of usable KV blocks allocated",
+    "tokens_per_step": "Generated tokens per device step",
+    "prefix_cache_hit_tokens": "Prompt tokens served from the prefix "
+                               "cache",
+    "prefix_cache_lookup_tokens": "Prompt tokens walked through the "
+                                  "prefix index",
+    "prefix_cache_evictions": "Cached-free blocks evicted by allocation",
+    "prefix_cache_cow_copies": "Copy-on-write block duplications",
+    "prefix_cache_hit_rate": "Cumulative prefix-cache hit/lookup ratio",
+    "prefix_cached_blocks": "Blocks parked in the cached-free tier",
+    "spec_proposed_tokens": "Drafted candidate tokens fed to verify "
+                            "steps",
+    "spec_accepted_tokens": "Drafted tokens that survived verification",
+    "spec_drafted_rows": "Verify rows that carried a draft",
+    "spec_acceptance_rate": "Cumulative accepted/proposed draft ratio",
+    "spec_mean_accepted_len": "Accepted draft tokens per drafted row",
+    "backpressure_drops": "Streams switched to catch-up mode (consumer "
+                          "lagged)",
+    "client_disconnects": "Requests aborted because the client went away",
+    "frontend_inflight": "Requests admitted by the frontend and not yet "
+                         "finished",
+    "engine_step_errors": "Engine steps that raised (in-flight work "
+                          "failed over)",
+    "requests_cancelled": "Requests aborted via the frontend",
+    "requests_timeout": "Requests aborted by their deadline",
+}
+
 
 def _quantile(sorted_window, pct):
     """Nearest-rank percentile over a sorted window: ceil(pct/100 * n) - 1.
@@ -129,12 +180,24 @@ class ServingMetrics:
     def prometheus_text(self, prefix="paddle_tpu_serving"):
         """Prometheus text-format exposition (version 0.0.4): counters as
         `<prefix>_<name>_total`, gauges as `<prefix>_<name>`, and each
-        duration series as a summary in SECONDS (`_count`/`_sum` plus
-        p50/p95 quantile samples from the bounded recent window)."""
+        duration series as a summary in SECONDS. Every family carries
+        `# HELP` and `# TYPE` lines, and every summary carries `_count` +
+        `_sum`, so a scraper can compute TRUE rates and mean latencies
+        (`rate(x_sum)/rate(x_count)`) over any window it likes. The
+        exported p50/p95 quantile samples, by contrast, come from a
+        BOUNDED window of the most recent observations (`max_intervals`,
+        default 4096) — they describe recent behavior, not the whole
+        process lifetime, and cannot be aggregated across replicas; use
+        the `_count`/`_sum` pair for anything longitudinal."""
         lines = []
 
         def _n(name):
             return f"{prefix}_{_NAME_RE.sub('_', name)}"
+
+        def _header(metric, name, kind, note=""):
+            help_text = _HELP.get(name, f"{name} ({kind})")
+            lines.append(f"# HELP {metric} {help_text}{note}")
+            lines.append(f"# TYPE {metric} {kind}")
 
         # dict() snapshots: the engine thread may insert a NEW series key
         # mid-scrape (first step after warmup); iterating the live dicts
@@ -144,17 +207,19 @@ class ServingMetrics:
         durations = dict(self._durations)
         for name in sorted(counters):
             m = _n(name) + "_total"
-            lines.append(f"# TYPE {m} counter")
+            _header(m, name, "counter")
             lines.append(f"{m} {counters[name]:g}")
         for name in sorted(gauges):
             m = _n(name)
-            lines.append(f"# TYPE {m} gauge")
+            _header(m, name, "gauge")
             lines.append(f"{m} {float(gauges[name]):g}")
         for name in sorted(durations):
             d = durations[name]
             m = _n(name) + "_seconds"
             recent = sorted(d["recent"])
-            lines.append(f"# TYPE {m} summary")
+            _header(m, name, "summary",
+                    note=f" (seconds; quantiles over the most recent "
+                         f"{self._max_intervals} observations)")
             if recent:
                 lines.append(
                     f'{m}{{quantile="0.5"}} {recent[len(recent) // 2]:g}')

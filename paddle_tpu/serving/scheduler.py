@@ -88,7 +88,7 @@ class Request:
 
     def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                  eos_token_id=None, request_id=None, top_k=None, top_p=None,
-                 spec_decoding=None, num_spec_tokens=None):
+                 spec_decoding=None, num_spec_tokens=None, trace=None):
         self.request_id = (
             request_id if request_id is not None else next(_rid_counter)
         )
@@ -126,6 +126,16 @@ class Request:
         self.num_matched_blocks = 0  # cache-hit pins from this admission
         self.preemptions = 0    # (engine fills hashes when caching is on)
         self.arrival_time = time.monotonic()   # TTFT anchor for metrics
+        # observability (serving/trace.py + the per-request summary log):
+        # `trace` is the per-request tracer override (None = defer to the
+        # engine's sampling fraction), `traced` the engine's decision
+        self.trace = None if trace is None else bool(trace)
+        self.traced = False
+        self.wait_since = self.arrival_time  # start of current wait span
+        self.admit_time = None        # FIRST admission (queue-wait anchor)
+        self.first_token_time = None
+        self.prefix_hit_tokens = 0    # prefix-cache tokens matched for us
+        self.spec_accepted = 0        # drafted tokens verification kept
         # total arrival order, stable across preemption/re-admission —
         # the scheduler's FCFS priority key (request_id may be user-supplied
         # and unorderable; list position forgets age after a re-admit)
@@ -167,7 +177,7 @@ class Request:
 class Scheduler:
     def __init__(self, pool, max_batch=8, token_budget=2048,
                  prefill_chunk=None, prefill_interval=None, metrics=None,
-                 prefix_cache=True, drafter=None):
+                 prefix_cache=True, drafter=None, tracer=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.token_budget = int(token_budget)
@@ -190,6 +200,9 @@ class Scheduler:
         # speculative decoding: a drafter (serving/spec.py NgramDrafter)
         # makes pure-decode steps carry drafted candidates; None = off
         self.drafter = drafter
+        # lifecycle tracer (serving/trace.py EngineTracer) or None; every
+        # hook below is gated on `tracer is not None and req.traced`
+        self.tracer = tracer
         self.waiting = deque()
         self.running = []
 
@@ -259,6 +272,9 @@ class Scheduler:
         self._release_blocks(req)
         req.state = WAITING
         req.preemptions += 1
+        req.wait_since = time.monotonic()
+        if self.tracer is not None and req.traced:
+            self.tracer.request_instant(req, "preempt")
         if req in self.running:
             self.running.remove(req)
         self.waiting.appendleft(req)
@@ -283,6 +299,7 @@ class Scheduler:
         req.num_matched_blocks = len(hit)
         req.num_cached = min(len(hit) * self.pool.block_size,
                              req.num_tokens - 1)
+        req.prefix_hit_tokens = len(hit) * self.pool.block_size
         if self.metrics is not None:
             # matched tokens, NOT the num_tokens-1 execution cap: a fully-
             # cached prompt is a 100% hit (its last token is re-fed as the
@@ -324,11 +341,17 @@ class Scheduler:
 
     def _grow(self, req, need):
         """Grow `req.blocks` to `need` blocks. Returns False to defer."""
+        had = len(req.blocks)
         while len(req.blocks) < need:
             b = self._take_block(req)
             if b is None:
                 return False
             req.blocks.append(b)
+        if (self.tracer is not None and req.traced
+                and len(req.blocks) > had):
+            self.tracer.request_instant(
+                req, "alloc", {"blocks": len(req.blocks) - had,
+                               "total": len(req.blocks)})
         return True
 
     def _ensure_writable(self, req, start, count):
@@ -359,6 +382,9 @@ class Scheduler:
             req.blocks[idx] = nb
             if self.metrics is not None:
                 self.metrics.inc("prefix_cache_cow_copies")
+            if self.tracer is not None and req.traced:
+                self.tracer.request_instant(req, "cow",
+                                            {"src": b, "dst": nb})
         return True
 
     def schedule(self):
@@ -372,6 +398,11 @@ class Scheduler:
             if (self.prefix_cache and req.block_hashes and not req.blocks
                     and req.num_cached == 0):
                 self._match_prefix(req)
+            now = time.monotonic()
+            if req.admit_time is None:
+                req.admit_time = now   # queue wait = first admission only
+            if self.tracer is not None and req.traced:
+                self.tracer.request_admitted(req, now)
             self.running.append(req)
 
         budget = self.token_budget
@@ -475,6 +506,9 @@ class Scheduler:
             if got is None:  # raced nothing (host-side), but stay safe
                 return []
             req.blocks.extend(got)
+            if self.tracer is not None and req.traced:
+                self.tracer.request_instant(req, "spec_reserve",
+                                            {"blocks": need})
         return draft[:k]
 
     def reclaim_spec_blocks(self, req):
@@ -485,5 +519,9 @@ class Scheduler:
         refcounts, prefix-cache hashes, and COW state are untouched."""
         keep = self.pool.blocks_for(req.num_tokens)
         if len(req.blocks) > keep:
+            n = len(req.blocks) - keep
             self.pool.release(req.blocks[keep:])
             del req.blocks[keep:]
+            if self.tracer is not None and req.traced:
+                self.tracer.request_instant(req, "spec_reclaim",
+                                            {"blocks": n})

@@ -16,10 +16,18 @@ framework), one process, loopback-friendly for tests. Endpoints:
   that disconnects mid-request is detected (EOF on its socket) and its
   request is aborted — KV blocks return to the pool while the engine keeps
   serving everyone else.
-- ``GET /healthz`` — 200 ``{"status": "ok"}`` with in-flight gauges, 503
-  ``{"status": "draining"}`` during shutdown.
+- ``GET /healthz`` — 200 ``{"status": "ok"}`` with in-flight gauges plus
+  the engine's saturation stats (`LLMEngine.pool_stats`: truly-free vs
+  cached-free vs allocated KV blocks, running/waiting request counts), so
+  a load balancer or operator can see saturation WITHOUT scraping
+  `/metrics`; 503 ``{"status": "draining"}`` during shutdown.
 - ``GET /metrics`` — Prometheus text exposition from ServingMetrics
   (counters ``_total``, gauges, step/TTFT duration summaries).
+- ``GET /debug/trace`` — the engine's lifecycle/step trace as
+  Chrome/Perfetto trace-event JSON (open at https://ui.perfetto.dev).
+  404 with a hint unless the engine was built with tracing on
+  (``PADDLE_TPU_TRACE=1`` or ``LLMEngine(trace=...)``); a request body
+  may set ``"trace": true`` to force itself into a sampled trace.
 
 `ServingServer.shutdown(drain=True)` is the graceful path: the listener
 closes (no new connections), the engine stops admitting and finishes or
@@ -178,6 +186,25 @@ class ServingServer:
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             ))
             return await writer.drain()
+        if path == "/debug/trace":
+            tracer = getattr(self.engine.engine, "tracer", None)
+            if tracer is None:
+                writer.write(_http_response(
+                    "404 Not Found",
+                    _error_body(
+                        404,
+                        "tracing is off — start the engine with "
+                        "PADDLE_TPU_TRACE=1 (or LLMEngine(trace=...)) to "
+                        "record a lifecycle/step trace", "not_found"),
+                ))
+                return await writer.drain()
+            # a full ring is a multi-MB payload: snapshot + serialize OFF
+            # the event loop so a mid-serve scrape never stalls live SSE
+            # streams or disconnect detection
+            body = await asyncio.to_thread(
+                lambda: json.dumps(tracer.chrome_trace()).encode())
+            writer.write(_http_response("200 OK", body))
+            return await writer.drain()
         if path == "/v1/completions":
             if method != "POST":
                 writer.write(_http_response(
@@ -196,6 +223,10 @@ class ServingServer:
         payload = {
             "status": "draining" if draining else "ok",
             "inflight": self.engine.inflight,
+            # saturation without a /metrics scrape: block-pool occupancy
+            # split by tier + scheduler queue depths (plain ints read off
+            # the live engine — GIL-consistent, no engine-thread handshake)
+            "pool": self.engine.engine.pool_stats(),
             "gauges": {
                 k: v for k, v in dict(self.engine.metrics.gauges).items()
                 if isinstance(v, (int, float))
@@ -240,6 +271,9 @@ class ServingServer:
             timeout_s = spec.get("timeout_s")
             if timeout_s is not None:
                 timeout_s = float(timeout_s)
+            trace = spec.get("trace")
+            if trace is not None:
+                trace = bool(trace)
             stream = bool(spec.get("stream", False))
         except (ValueError, TypeError) as e:
             writer.write(_http_response(
@@ -251,7 +285,7 @@ class ServingServer:
                 prompt, max_new_tokens=max_tokens, temperature=temperature,
                 eos_token_id=eos, timeout_s=timeout_s, top_k=top_k,
                 top_p=top_p, spec_decoding=spec_decoding,
-                num_spec_tokens=num_spec_tokens,
+                num_spec_tokens=num_spec_tokens, trace=trace,
             )
         except EngineOverloadedError as e:
             writer.write(_http_response(
@@ -391,6 +425,13 @@ def main(argv=None):
     p.add_argument("--num-spec-tokens", type=int, default=4,
                    help="drafted tokens per decode row when speculative "
                         "decoding is on (fixes the verify program width)")
+    p.add_argument("--trace", type=float, default=None, metavar="FRACTION",
+                   help="enable lifecycle/step tracing for this fraction "
+                        "of requests (1.0 = all; export at GET "
+                        "/debug/trace; same as PADDLE_TPU_TRACE)")
+    p.add_argument("--request-log", action="store_true",
+                   help="log one JSON summary line per finished/aborted "
+                        "request (same as PADDLE_TPU_REQUEST_LOG=1)")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -405,7 +446,12 @@ def main(argv=None):
         prefix_cache=False if args.no_prefix_cache else None,
         spec_decoding=True if args.spec_decode else None,
         num_spec_tokens=args.num_spec_tokens,
+        trace=args.trace, request_log=True if args.request_log else None,
     )
+    if args.request_log:
+        import logging
+
+        logging.basicConfig(level=logging.INFO, format="%(message)s")
 
     async def run():
         server = ServingServer(

@@ -1,0 +1,301 @@
+"""Per-request lifecycle tracing and engine step timeline (Perfetto export).
+
+The diagnostic substrate for the serving stack: when p95 TTFT spikes or
+speculative acceptance drops, aggregate Prometheus counters
+(serving/metrics.py) can say *that* it happened, but not *where request X
+spent its time* or *what the engine did on step N*. `EngineTracer` records
+exactly those two views as Chrome/Perfetto trace events:
+
+- a **per-request lifecycle span tree** — one track per in-flight request
+  carrying its ``enqueue`` instant, the ``queued`` span (arrival →
+  admission, tagged with the prefix-cache match length), a ``requeued``
+  span per preemption round-trip, one span per prefill chunk and per
+  decode/verify step the request rode on, the ``ttft`` span (arrival →
+  first token), block-pool instants (``alloc``, ``cow``,
+  ``spec_reserve``/``spec_reclaim``, ``preempt``), and the closing
+  ``request`` span (arrival → finish/abort) with the request's summary;
+- an **engine step timeline** — one ``step`` span per `LLMEngine.step()`
+  with phase children ``plan`` (scheduling), ``build`` (host batch
+  assembly), ``dispatch`` (device program launch), ``sync`` (host sync on
+  the sampled tokens), ``emit`` (token emission), tagged with the batch
+  composition (decode rows, prefill chunks, spec lanes), program kind
+  (mixed/decode/verify), and token counts. Pool evictions land as
+  instants on a ``block-pool`` track.
+
+**Tracing is compiled out by default**: a disabled engine holds
+``tracer = None`` and every hook site is a single ``if tracer is not
+None`` — no clocks read, no events built, output byte-identical to the
+untraced path (tests/test_serving_trace.py locks this). Enable with
+``PADDLE_TPU_TRACE=1`` (or a sampling fraction, e.g. ``0.1`` to trace one
+request in ten; step spans are always recorded while enabled) or
+``LLMEngine(trace=...)``; a single request can force itself in (or out)
+with ``trace=True``/``False`` regardless of the sampling decision.
+
+Memory is bounded by a **ring buffer** (``PADDLE_TPU_TRACE_BUF`` events,
+default 65536): a long-running engine overwrites its oldest events
+instead of growing. Request tracks come from a fixed pool of lanes, so
+track-name metadata stays O(lanes), not O(requests served).
+
+Export: `chrome_trace()` returns the standard trace-event JSON object
+(``{"traceEvents": [...]}``) — serve it from ``GET /debug/trace``
+(serving/server.py), `dump()` it to a file, and open it at
+https://ui.perfetto.dev. Device-side correlation: while tracing, every
+device dispatch is wrapped in a ``jax.profiler.TraceAnnotation`` named
+``paddle_tpu.step <id>`` carrying the SAME step id as the host ``step``
+span, so `profiler.xplane.engine_step_spans` / `join_engine_steps` can
+join host phases to device ops captured with `jax.profiler.trace`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+# process ids of the two fixed tracks groups
+PID_ENGINE = 1
+PID_REQUESTS = 2
+# tids inside PID_ENGINE
+TID_STEPS = 0
+TID_POOL = 1
+# request lanes: tids PID_REQUESTS/[_LANE_BASE, _LANE_BASE + _NUM_LANES).
+# Lanes are reused round-robin; concurrent requests can never collide as
+# long as max_batch + max_waiting < _NUM_LANES (every event still carries
+# its request_id in args, so even a collision is attributable).
+_LANE_BASE = 10
+_NUM_LANES = 256
+
+STEP_ANNOTATION_PREFIX = "paddle_tpu.step "
+
+
+def trace_sample_from_env(env="PADDLE_TPU_TRACE"):
+    """The PADDLE_TPU_TRACE knob as a sampling fraction: unset/falsy -> 0.0
+    (tracing off), truthy -> 1.0, a float string -> that fraction of
+    requests (clamped to [0, 1]; step spans are always on while > 0)."""
+    v = os.environ.get(env, "").strip().lower()
+    if v in ("", "0", "0.0", "false", "off", "no"):
+        return 0.0
+    try:
+        f = float(v)
+    except ValueError:
+        return 1.0
+    return min(max(f, 0.0), 1.0)
+
+
+def trace_capacity_from_env(env="PADDLE_TPU_TRACE_BUF", default=65536):
+    try:
+        cap = int(os.environ.get(env, "") or default)
+    except ValueError:
+        cap = default
+    return max(16, cap)
+
+
+class EngineTracer:
+    """Bounded trace-event recorder for one `LLMEngine`.
+
+    All timestamps come from ``time.monotonic()`` — the same clock
+    `Request.arrival_time` and ServingMetrics use, so TTFT/queue-wait
+    spans agree with the metric quantiles by construction. The engine
+    thread is the only writer; `chrome_trace()` may be called from any
+    thread (the HTTP event loop mid-serve) — a lock covers the ring
+    append and the export snapshot, because iterating a deque that
+    another thread is appending to raises RuntimeError.
+    """
+
+    def __init__(self, capacity=65536, sample=1.0):
+        self.capacity = int(capacity)
+        self.sample = float(sample)
+        self.events = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.epoch = time.monotonic()
+        self.dropped = 0          # events overwritten by the ring
+        self._step_id = 0
+        self._acc = 0.0           # deterministic sampling accumulator
+        self._lane_of = {}        # request_id -> tid (live requests only)
+        self._next_lane = 0
+        self._meta = [
+            self._meta_ev("process_name", PID_ENGINE, 0,
+                          {"name": "paddle-tpu-engine"}),
+            self._meta_ev("thread_name", PID_ENGINE, TID_STEPS,
+                          {"name": "engine-step"}),
+            self._meta_ev("thread_name", PID_ENGINE, TID_POOL,
+                          {"name": "block-pool"}),
+            self._meta_ev("process_name", PID_REQUESTS, 0,
+                          {"name": "requests"}),
+        ]
+        self._named_lanes = set()
+
+    # -- low-level event plumbing -----------------------------------------
+
+    @staticmethod
+    def _meta_ev(name, pid, tid, args):
+        return {"name": name, "ph": "M", "pid": pid, "tid": tid,
+                "ts": 0, "args": args}
+
+    def ts(self, t):
+        """monotonic seconds -> trace microseconds."""
+        return (t - self.epoch) * 1e6
+
+    def _push(self, ev):
+        with self._lock:
+            if len(self.events) == self.capacity:
+                self.dropped += 1
+            self.events.append(ev)
+
+    def complete(self, name, pid, tid, start, end, args=None):
+        """One 'X' (complete) span from monotonic `start` to `end`."""
+        ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
+              "ts": round(self.ts(start), 3),
+              "dur": round(max(end - start, 0.0) * 1e6, 3)}
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    def instant(self, name, pid, tid, t=None, args=None):
+        ev = {"name": name, "ph": "i", "s": "t", "pid": pid, "tid": tid,
+              "ts": round(self.ts(time.monotonic() if t is None else t), 3)}
+        if args:
+            ev["args"] = args
+        self._push(ev)
+
+    # -- request lifecycle --------------------------------------------------
+
+    def should_trace(self, req):
+        """Decide once per request at `add`: the per-request ``trace``
+        override wins; otherwise an error-diffusion accumulator admits
+        exactly ``sample`` of the request stream (deterministic — tests
+        and repeated captures see the same selection)."""
+        if req.trace is not None:
+            return bool(req.trace)
+        self._acc += self.sample
+        if self._acc >= 1.0:
+            self._acc -= 1.0
+            return True
+        return False
+
+    def _lane(self, req):
+        tid = self._lane_of.get(req.request_id)
+        if tid is None:
+            tid = _LANE_BASE + (self._next_lane % _NUM_LANES)
+            self._next_lane += 1
+            self._lane_of[req.request_id] = tid
+            if tid not in self._named_lanes:
+                self._named_lanes.add(tid)
+                self._meta.append(self._meta_ev(
+                    "thread_name", PID_REQUESTS, tid,
+                    {"name": f"req-lane-{tid - _LANE_BASE:03d}"}))
+        return tid
+
+    def begin_request(self, req):
+        self.instant("enqueue", PID_REQUESTS, self._lane(req),
+                     t=req.arrival_time,
+                     args={"request_id": req.request_id,
+                           "prompt_tokens": len(req.prompt_ids),
+                           "max_new_tokens": req.max_new_tokens})
+
+    def request_admitted(self, req, now):
+        """Close the wait span: ``queued`` for the first admission (from
+        arrival), ``requeued`` for a post-preemption re-admission (from
+        the preemption)."""
+        first = req.wait_since == req.arrival_time and not req.preemptions
+        self.complete("queued" if first else "requeued",
+                      PID_REQUESTS, self._lane(req), req.wait_since, now,
+                      args={"request_id": req.request_id,
+                            "cached_tokens": req.num_cached,
+                            "prefix_hit_tokens": req.prefix_hit_tokens,
+                            "preemptions": req.preemptions})
+
+    def request_instant(self, req, name, args=None):
+        a = {"request_id": req.request_id}
+        if args:
+            a.update(args)
+        self.instant(name, PID_REQUESTS, self._lane(req), args=a)
+
+    def row_span(self, req, name, start, end, args=None):
+        """One span for a step this request rode on (``prefill_chunk``,
+        ``decode``, or ``verify``), covering the step's device window."""
+        a = {"request_id": req.request_id}
+        if args:
+            a.update(args)
+        self.complete(name, PID_REQUESTS, self._lane(req), start, end, a)
+
+    def first_token(self, req, now):
+        self.complete("ttft", PID_REQUESTS, self._lane(req),
+                      req.arrival_time, now,
+                      args={"request_id": req.request_id})
+
+    def end_request(self, req, reason, now=None):
+        """The closing ``request`` span (arrival -> finish/abort) with the
+        whole lifecycle summary; frees the request's lane."""
+        now = time.monotonic() if now is None else now
+        self.complete(
+            "request", PID_REQUESTS, self._lane(req), req.arrival_time, now,
+            args={
+                "request_id": req.request_id,
+                "reason": reason,
+                "prompt_tokens": len(req.prompt_ids),
+                "output_tokens": len(req.output_ids),
+                "prefix_hit_tokens": req.prefix_hit_tokens,
+                "preemptions": req.preemptions,
+                "spec_accepted_tokens": req.spec_accepted,
+            })
+        self._lane_of.pop(req.request_id, None)
+
+    # -- engine step timeline ----------------------------------------------
+
+    def next_step_id(self):
+        sid = self._step_id
+        self._step_id += 1
+        return sid
+
+    def step_annotation(self, step_id):
+        """Name for the `jax.profiler.TraceAnnotation` wrapping this
+        step's device dispatch — the join key between this host trace and
+        an xplane device capture (profiler.xplane.engine_step_spans)."""
+        return f"{STEP_ANNOTATION_PREFIX}{step_id}"
+
+    def record_step(self, step_id, kind, phases, args):
+        """Emit the ``step`` span and its phase children on the engine
+        track. `phases` is {name: (start, end)} in monotonic seconds; the
+        step span covers min(start)..max(end)."""
+        s0 = min(t0 for t0, _ in phases.values())
+        s1 = max(t1 for _, t1 in phases.values())
+        a = {"step": step_id, "kind": kind}
+        a.update(args)
+        self.complete(f"step[{kind}]", PID_ENGINE, TID_STEPS, s0, s1, a)
+        for name in ("plan", "build", "dispatch", "sync", "emit"):
+            if name in phases:
+                t0, t1 = phases[name]
+                self.complete(name, PID_ENGINE, TID_STEPS, t0, t1,
+                              {"step": step_id})
+
+    def pool_instant(self, name, args=None):
+        self.instant(name, PID_ENGINE, TID_POOL, args=args)
+
+    # -- export -------------------------------------------------------------
+
+    def chrome_trace(self):
+        """The trace as a Chrome/Perfetto trace-event JSON object. Track
+        metadata is kept outside the ring, so lane names survive even
+        after the ring has overwritten the events that created them."""
+        with self._lock:
+            ring = list(self.events)
+        return {
+            "traceEvents": list(self._meta) + ring,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "paddle_tpu.serving.trace",
+                "sample": self.sample,
+                "capacity": self.capacity,
+                "dropped_events": self.dropped,
+            },
+        }
+
+    def dump(self, path):
+        """Write the Perfetto-loadable JSON to `path`; returns the event
+        count written."""
+        trace = self.chrome_trace()
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return len(trace["traceEvents"])

@@ -253,3 +253,103 @@ def test_schedule_analysis_on_real_cpu_capture():
         buf = _io.StringIO()
         xplane.print_schedule_analysis(td, file=buf)
         assert "util" in buf.getvalue()
+
+
+# -- serving-trace <-> device-capture join (observability issue) ------------
+
+def _annotated_capture(step_spans):
+    """Capture whose host plane carries `paddle_tpu.step <id>` annotation
+    events at [offset_ms, dur_ms] — what a jax.profiler trace of a
+    tracing-enabled serve contains."""
+    from paddle_tpu.profiler._xplane import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/host:CPU"
+    line = plane.lines.add()
+    line.name = "python"
+    line.timestamp_ns = 0
+    for mid, (sid, off_ms, dur_ms) in enumerate(step_spans, start=1):
+        plane.event_metadata[mid].id = mid
+        plane.event_metadata[mid].name = f"paddle_tpu.step {sid}"
+        ev = line.events.add()
+        ev.metadata_id = mid
+        ev.offset_ps = int(off_ms * 1e9)
+        ev.duration_ps = int(dur_ms * 1e9)
+    return xs
+
+
+def test_engine_step_spans_and_join():
+    """`engine_step_spans` maps annotation events to step ids;
+    `join_engine_steps` lines them up with the serving trace's host step
+    spans, leaving capture fields None where the capture has no data."""
+    from paddle_tpu.profiler import xplane
+
+    xs = _annotated_capture([(0, 0.0, 2.0), (1, 3.0, 1.5)])
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "cap.xplane.pb")
+        with open(path, "wb") as f:
+            f.write(xs.SerializeToString())
+        spans = xplane.engine_step_spans(path)
+        assert set(spans) == {0, 1}
+        assert spans[0]["dur_us"] == pytest.approx(2000.0)
+        assert spans[1]["start_us"] == pytest.approx(3000.0)
+        assert spans[1]["plane"] == "/host:CPU"
+
+        chrome = {"traceEvents": [
+            {"name": "step[decode]", "ph": "X", "pid": 1, "tid": 0,
+             "ts": 100.0, "dur": 1900.0, "args": {"step": 0,
+                                                  "kind": "decode"}},
+            {"name": "step[mixed]", "ph": "X", "pid": 1, "tid": 0,
+             "ts": 5000.0, "dur": 800.0, "args": {"step": 7,
+                                                  "kind": "mixed"}},
+            # phase children and request spans must NOT join
+            {"name": "dispatch", "ph": "X", "pid": 1, "tid": 0,
+             "ts": 150.0, "dur": 100.0, "args": {"step": 0}},
+        ]}
+        rows = xplane.join_engine_steps(chrome, path)
+    assert [r["step"] for r in rows] == [0, 7]
+    assert rows[0]["kind"] == "decode"
+    assert rows[0]["capture_dur_us"] == pytest.approx(2000.0)
+    assert rows[0]["capture_plane"] == "/host:CPU"
+    assert rows[1]["capture_dur_us"] is None  # step 7 not captured
+
+
+def test_join_on_real_traced_serve():
+    """End to end: a tracing-enabled engine served under
+    `jax.profiler.trace` stamps its step ids into the capture, and the
+    join recovers device/host rows for the steps the capture covered."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.profiler import xplane
+    from paddle_tpu.serving import LLMEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, attn_impl="xla",
+                    dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                       trace=1.0)
+    rs = np.random.RandomState(0)
+    # compile outside the capture so the trace records steady-state steps
+    engine.generate([rs.randint(0, 128, (9,)).tolist()], max_new_tokens=2)
+    with tempfile.TemporaryDirectory() as td:
+        with jax.profiler.trace(td):
+            engine.generate([rs.randint(0, 128, (7,)).tolist(),
+                             rs.randint(0, 128, (12,)).tolist()],
+                            max_new_tokens=4)
+        spans = xplane.engine_step_spans(td)
+        assert spans, "no step annotations reached the capture"
+        rows = xplane.join_engine_steps(engine.tracer.chrome_trace(), td)
+    joined = [r for r in rows if r["capture_dur_us"] is not None]
+    assert joined, "no host step span joined to the capture"
+    for r in joined:
+        assert r["step"] in spans
+        assert r["capture_dur_us"] > 0
+        # the annotation wraps only the dispatch, so it can never exceed
+        # the full host step span by more than measurement jitter
+        assert r["host_dur_us"] > 0

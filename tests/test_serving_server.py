@@ -371,3 +371,72 @@ def test_server_soak_mixed_traffic(model):
     assert engine.metrics.counters["backpressure_drops"] >= 1
     assert _idle(engine)
     assert engine._requests == {}
+
+
+def test_server_debug_trace_and_healthz_pool(model):
+    """Observability surface: /healthz carries the pool saturation gauges
+    (no /metrics scrape needed), and /debug/trace serves the Perfetto
+    trace when the engine traces — 404 with a hint when it does not."""
+    (p,) = _prompts((9,), seed=7)
+
+    async def main():
+        # tracing OFF (default engine): /debug/trace is a guided 404
+        engine_off, server_off = await _start_server(model)
+        t_status, t_body = await _http(server_off.port, "GET", "/debug/trace")
+        await server_off.shutdown(drain=True)
+
+        # tracing ON: serve one request, then export
+        engine = LLMEngine(model, block_size=8, max_batch=4,
+                           max_seq_len=64, trace=1.0)
+        server = ServingServer(engine, host="127.0.0.1", port=0)
+        await server.start()
+        status, _ = await _http(server.port, "POST", "/v1/completions",
+                                {"prompt": p, "max_tokens": 4})
+        d_status, d_body = await _http(server.port, "GET", "/debug/trace")
+        h_status, h_body = await _http(server.port, "GET", "/healthz")
+        await server.shutdown(drain=True)
+        return (t_status, t_body, status, d_status, json.loads(d_body),
+                h_status, json.loads(h_body), engine)
+
+    (t_status, t_body, status, d_status, trace, h_status, health,
+     engine) = asyncio.run(main())
+    assert t_status == 404 and b"PADDLE_TPU_TRACE" in t_body
+    assert status == 200 and d_status == 200
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"request", "ttft", "queued", "decode"} <= names
+    assert any(n.startswith("step[") for n in names)
+    assert trace["otherData"]["producer"] == "paddle_tpu.serving.trace"
+    # healthz saturation gauges: pool tiers + queue depths, all idle now
+    assert h_status == 200
+    pool = health["pool"]
+    assert pool["blocks_total"] == engine.pool.num_blocks - 1
+    assert pool["blocks_truly_free"] + pool["blocks_cached_free"] \
+        == pool["blocks_total"]
+    assert pool["blocks_allocated"] == 0
+    assert pool["requests_running"] == 0 and pool["requests_waiting"] == 0
+    assert _idle(engine)
+
+
+def test_server_per_request_trace_flag(model):
+    """A request body's "trace": true forces itself into a sampled trace
+    (sample fraction 0 of the stream would otherwise skip everyone)."""
+    p1, p2 = _prompts((6, 8), seed=8)
+
+    async def main():
+        engine = LLMEngine(model, block_size=8, max_batch=4,
+                           max_seq_len=64, trace=0.0001)
+        server = ServingServer(engine, host="127.0.0.1", port=0)
+        await server.start()
+        s1, _ = await _http(server.port, "POST", "/v1/completions",
+                            {"prompt": p1, "max_tokens": 3})
+        s2, _ = await _http(server.port, "POST", "/v1/completions",
+                            {"prompt": p2, "max_tokens": 3, "trace": True})
+        d_status, d_body = await _http(server.port, "GET", "/debug/trace")
+        await server.shutdown(drain=True)
+        return s1, s2, d_status, json.loads(d_body)
+
+    s1, s2, d_status, trace = asyncio.run(main())
+    assert s1 == s2 == 200 and d_status == 200
+    closed = [e for e in trace["traceEvents"] if e["name"] == "request"]
+    assert len(closed) == 1              # only the forced request traced
+    assert closed[0]["args"]["output_tokens"] == 3
