@@ -40,6 +40,11 @@ _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1140"))
 # set by main() when the backend probe fails: benches that then produce no
 # result report status "tpu_unreachable" instead of "bench_failed"
 _TPU_UNREACHABLE = False
+# the backend-probe outcome, stamped onto EVERY emitted JSON line so a
+# silent cpu/fast-tier fallback is visible in the BENCH_*.json trajectory
+# itself, not only in stderr (the r04/r05 lesson: two rounds recorded 0
+# tok/s before anyone saw the platform-init hang)
+_PROBE = {"backend": None, "fell_back": False, "reason": None}
 
 
 def _status(result, errors):
@@ -91,52 +96,41 @@ def _probe_backend(timeout_s=None):
                               timeout=timeout_s)
         ok = proc.returncode == 0
     except Exception:  # noqa: BLE001 — timeout or spawn failure
-        ok = False
+        proc, ok = None, False
     dt = time.monotonic() - t0
     if not ok:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ.setdefault("PADDLE_TPU_BENCH_FAST", "1")
-        return (f"backend probe failed/hung after {dt:.0f}s; "
-                "forcing JAX_PLATFORMS=cpu + FAST tier for all benches")
+        reason = (f"backend probe failed/hung after {dt:.0f}s; "
+                  "forcing JAX_PLATFORMS=cpu + FAST tier for all benches")
+        _PROBE.update(backend="cpu", fell_back=True, reason=reason)
+        return reason
+    # "1.0 tpu" -> the backend the children will actually run on
+    _PROBE["backend"] = (proc.stdout.split() or ["?"])[-1]
     _log(f"backend probe ok in {dt:.0f}s: {proc.stdout.strip()}")
     if dt > 60.0:
         os.environ.setdefault("PADDLE_TPU_BENCH_FAST", "1")
-        return f"slow backend probe ({dt:.0f}s); FAST tier enabled"
+        reason = f"slow backend probe ({dt:.0f}s); FAST tier enabled"
+        _PROBE["reason"] = reason
+        return reason
     return None
 
 
-# bf16 peak FLOP/s by TPU generation (public spec sheets)
-_PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5": 459e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-}
-
+# MFU accounting lives in paddle_tpu.profiler.flops now (lifted from here
+# in the observability PR so any run can compute it, not just benches);
+# these thin wrappers keep the bench call sites and import laziness — the
+# parent process must never import jax/paddle_tpu before the probe runs.
 
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in sorted(_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
-        if key in kind:
-            return val
-    return 197e12  # conservative default (v5e-class)
+    from paddle_tpu.profiler.flops import peak_flops
+
+    return peak_flops(device)
 
 
 def _train_flops_per_token(cfg) -> float:
-    """6*N for the matmuls (fwd+bwd) + causal attention score/value FLOPs.
+    from paddle_tpu.profiler.flops import gpt_train_flops_per_token
 
-    Counts USEFUL model FLOPs only — the fused CE head's backward logit
-    recompute (ops/fused_ce.py) is extra hardware work that buys HBM, so it
-    raises throughput but is excluded here; MFU stays honest."""
-    H, L, S, V = cfg.hidden_size, cfg.num_layers, cfg.max_seq_len, cfg.vocab_size
-    Ff = cfg.intermediate_size
-    n_matmul = L * (4 * H * H + 2 * H * Ff) + V * H  # qkv+proj + mlp + unembed
-    # causal attention: 2 matmuls of S*H per token fwd, x3 for train, /2 causal
-    attn = L * 2 * S * H * 3
-    return 6.0 * n_matmul + attn
+    return gpt_train_flops_per_token(cfg)
 
 
 def _log(msg):
@@ -619,8 +613,9 @@ def bench_resnet50(on_tpu, errors, deadline_s):
     if not sweep:
         return None
     best = max(sweep, key=sweep.get)
-    # ResNet-50 @224: ~4.1e9 fwd FLOPs/image (published op count), train ~3x
-    train_flops = 3 * 4.1e9 if on_tpu else 3 * 4.1e9 * (side / 224) ** 2
+    from paddle_tpu.profiler.flops import resnet50_train_flops_per_image
+
+    train_flops = resnet50_train_flops_per_image(side)
     peak = _peak_flops(jax.devices()[0])
     return {
         "samples_per_sec": round(sweep[best], 1),
@@ -839,6 +834,7 @@ def _emit(gpt, extras, errors):
         "unit": "tokens/sec",
         "vs_baseline": 1.0 if gpt else 0.0,
         "status": _status(gpt, errors),
+        "probe": dict(_PROBE),
     }
     if gpt:
         out["mfu"] = gpt["mfu"]
@@ -864,6 +860,7 @@ def _emit_model(name, r, unit, metric=None):
         "unit": unit,
         "vs_baseline": 1.0 if result else 0.0,
         "status": _status(result, errs),
+        "probe": dict(_PROBE),
     }
     if result:
         line.update(result)

@@ -39,9 +39,15 @@ def _host_check(name, value):
         isnan = np.isnan(arr)
         n_nan = int(isnan.sum())
         n_inf = int((~finite).sum()) - n_nan
+        # name WHERE it went wrong, not just that it did: the first bad
+        # element's index localizes a poisoned row/head/channel instantly
+        flat_idx = int(np.argmax(~finite.reshape(-1)))
+        idx = ([int(i) for i in np.unravel_index(flat_idx, arr.shape)]
+               if arr.ndim else [])
         raise RuntimeError(
             f"FLAGS_check_nan_inf: non-finite values in {name} "
-            f"(shape {list(value.shape)}: {n_nan} nan, {n_inf} inf)"
+            f"(shape {list(value.shape)}: {n_nan} nan, {n_inf} inf; "
+            f"first at index {idx})"
         )
 
 
@@ -57,21 +63,25 @@ def check_array(arr, name: str):
 
 
 def check_layer_outputs(layer, outputs):
-    """Post-forward hook body: guard every float Tensor/array output."""
+    """Post-forward hook body: guard every float Tensor/array output.
+
+    Each leaf is labeled with its PYTREE PATH inside the layer's output
+    (``Linear output[1]['attn']`` …), so a failure report names the first
+    non-finite leaf, not just the layer — for multi-output layers that is
+    the difference between a lead and a grep."""
     from .tensor import Tensor
 
     name = type(layer).__name__
     ln = getattr(layer, "_full_name", None) or getattr(layer, "_name", None)
     label = f"{name}({ln})" if ln else name
 
-    def visit(x):
-        if isinstance(x, Tensor):
-            check_array(x._array, f"{label} output")
-        elif isinstance(x, jax.Array):
-            check_array(x, f"{label} output")
-        return x
-
-    jax.tree_util.tree_map(
-        visit, outputs, is_leaf=lambda x: isinstance(x, Tensor)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(
+        outputs, is_leaf=lambda x: isinstance(x, Tensor)
     )
+    for path, x in leaves:
+        suffix = jax.tree_util.keystr(path) if path else ""
+        if isinstance(x, Tensor):
+            check_array(x._array, f"{label} output{suffix}")
+        elif isinstance(x, jax.Array):
+            check_array(x, f"{label} output{suffix}")
     return outputs

@@ -6,9 +6,12 @@ VisualDL:883).
 """
 from __future__ import annotations
 
+import math
 import numbers
 import os
 import time
+import warnings
+from collections import deque
 
 import numpy as np
 
@@ -72,6 +75,12 @@ class CallbackList:
     def on_batch_end(self, mode, step, logs=None):
         self._call(f"on_{mode}_batch_end", step, logs or {})
 
+    def on_interrupted(self, mode, logs=None):
+        """An exception is unwinding past the mode's loop: callbacks that
+        flipped process/model state on (`TrainMonitor`'s debug flags) get
+        one chance to restore it — `on_<mode>_end` will never run."""
+        self._call(f"on_{mode}_interrupted", logs or {})
+
 
 class Callback:
     def __init__(self):
@@ -88,6 +97,9 @@ class Callback:
         pass
 
     def on_train_end(self, logs=None):
+        pass
+
+    def on_train_interrupted(self, logs=None):
         pass
 
     def on_eval_begin(self, logs=None):
@@ -261,6 +273,166 @@ class VisualDL(Callback):
 
     def on_eval_end(self, logs=None):
         self._write("eval", logs)
+
+
+class TrainMonitor(Callback):
+    """Training-health watchdog: gradient global norm, loss-spike and
+    non-finite detection, and a **recompile sentinel**.
+
+    Entirely opt-in (pass it to `Model.fit(callbacks=[TrainMonitor()])`);
+    a fit without it runs the exact pre-monitor code path.
+
+    - ``grad_norm=True`` (default) asks the Model to compute the global
+      gradient norm INSIDE the compiled train step (one extra scalar
+      output, no second program) and surfaces it as ``logs["grad_norm"]``
+      for every batch — the first number to look at when loss jumps.
+    - **Non-finite detection**: a NaN/Inf loss or grad norm triggers
+      ``nan_action`` — ``"raise"`` (default; RuntimeError naming the step
+      and pointing at ``FLAGS_check_nan_inf`` for per-layer attribution),
+      ``"stop"`` (sets ``model.stop_training``), or ``"warn"``.
+      ``check_nan_inf=True`` additionally flips ``FLAGS_check_nan_inf`` on
+      for the duration of the fit, so the failure report names the
+      offending layer output/leaf (core/nan_inf.py) instead of this
+      monitor's step-level message. That mode hooks every layer forward —
+      debug runs only.
+    - **Loss-spike detection**: warns when a batch loss exceeds the recent
+      window's mean by ``spike_factor`` spreads (std, floored at 10% of
+      the mean so a flat-loss window still has a tolerance band).
+    - **Recompile sentinel**: watches `Model.jit_traces` (bumped at XLA
+      trace time inside the compiled step bodies, the training analogue of
+      the serving engine's ``jit_traces`` counter). After
+      ``warmup_steps`` batches of an epoch every further trace means the
+      step is being re-traced — varying batch shapes (use
+      ``drop_last``/padding), drifting dtypes, or a cache key bug — and
+      each retrace pays a full XLA compile. Warns with the trace/program
+      counts; `Model.jit_retraces` exposes the same signal to code.
+    """
+
+    def __init__(self, grad_norm=True, nan_action="raise",
+                 check_nan_inf=False, spike_window=50, spike_factor=4.0,
+                 warmup_steps=1, max_warnings=5):
+        super().__init__()
+        if nan_action not in ("raise", "stop", "warn"):
+            raise ValueError(
+                f"nan_action must be raise|stop|warn, got {nan_action!r}")
+        self.grad_norm = bool(grad_norm)
+        self.nan_action = nan_action
+        self.check_nan_inf = bool(check_nan_inf)
+        self.spike_window = int(spike_window)
+        self.spike_factor = float(spike_factor)
+        self.warmup_steps = int(warmup_steps)
+        self.max_warnings = int(max_warnings)
+        self._losses = deque(maxlen=self.spike_window)
+        self._trace_base = None
+        self._flag_was = None
+        # observable tallies (tests and operators read these)
+        self.nan_events = 0
+        self.spike_warnings = 0
+        self.retrace_warnings = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def on_train_begin(self, logs=None):
+        if self.grad_norm and self.model is not None:
+            self.model._monitor_grad_norm = True
+        if self.check_nan_inf:
+            from ..flags import get_flags, set_flags
+
+            self._flag_was = get_flags("FLAGS_check_nan_inf")[
+                "FLAGS_check_nan_inf"]
+            set_flags({"FLAGS_check_nan_inf": True})
+
+    def on_train_end(self, logs=None):
+        if self.grad_norm and self.model is not None:
+            self.model._monitor_grad_norm = False
+        if self.check_nan_inf and self._flag_was is not None:
+            from ..flags import set_flags
+
+            set_flags({"FLAGS_check_nan_inf": self._flag_was})
+            self._flag_was = None
+
+    # an exception (this monitor's own raise, a FLAGS_check_nan_inf layer
+    # guard, a KeyboardInterrupt) unwinds past fit without on_train_end —
+    # restore the debug switches there too, or they leak process-wide
+    on_train_interrupted = on_train_end
+
+    def on_epoch_begin(self, epoch, logs=None):
+        # re-baseline the sentinel: legitimate compiles between epochs
+        # (a first eval program, a resumed fit) are not retraces
+        self._trace_base = None
+
+    # -- per-batch checks ---------------------------------------------------
+
+    def _warn(self, kind, msg):
+        # per-kind caps: a noisy-loss run must not eat the recompile
+        # sentinel's budget (or vice versa) — both signals stay alive
+        if kind == "spike":
+            if self.spike_warnings >= self.max_warnings:
+                return
+            self.spike_warnings += 1
+        else:
+            if self.retrace_warnings >= self.max_warnings:
+                return
+            self.retrace_warnings += 1
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    def _nonfinite(self, step, name, value):
+        self.nan_events += 1
+        msg = (f"TrainMonitor: non-finite {name} ({value}) at train step "
+               f"{step}. Re-run with FLAGS_check_nan_inf=1 (or "
+               "TrainMonitor(check_nan_inf=True)) to name the layer "
+               "output that first went non-finite.")
+        if self.nan_action == "raise":
+            # fit's interrupt hook (on_train_interrupted) restores the
+            # debug switches as this unwinds
+            raise RuntimeError(msg)
+        if self.nan_action == "stop" and self.model is not None:
+            self.model.stop_training = True
+        if self.nan_events <= self.max_warnings:
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        flagged = False                      # one non-finite event per step
+        loss = logs.get("loss")
+        if loss is not None:
+            loss = float(np.asarray(loss).ravel()[0])
+            if not math.isfinite(loss):
+                self._nonfinite(step, "loss", loss)
+                flagged = True
+            else:
+                if len(self._losses) >= max(8, self.spike_window // 4):
+                    arr = np.asarray(self._losses, np.float64)
+                    mean = float(arr.mean())
+                    spread = max(float(arr.std()), 0.1 * abs(mean), 1e-8)
+                    if loss > mean + self.spike_factor * spread:
+                        self._warn("spike", (
+                            f"TrainMonitor: loss spike at step {step}: "
+                            f"{loss:.6g} vs recent mean {mean:.6g} "
+                            f"(+{(loss - mean) / spread:.1f} spreads over "
+                            f"{len(arr)} steps)"))
+                self._losses.append(loss)
+        gn = logs.get("grad_norm")
+        if not flagged and gn is not None and not math.isfinite(float(gn)):
+            self._nonfinite(step, "grad_norm", gn)
+        # recompile sentinel
+        model = self.model
+        traces = getattr(model, "jit_traces", None)
+        if traces is None:
+            return
+        if step < self.warmup_steps or self._trace_base is None:
+            self._trace_base = traces
+            return
+        if traces > self._trace_base:
+            self._warn("retrace", (
+                f"TrainMonitor recompile sentinel: {traces - self._trace_base}"
+                f" new XLA trace(s) at train step {step} after warmup "
+                f"({traces} total, {getattr(model, 'jit_retraces', '?')} "
+                "re-traces of existing programs) — every one pays a full "
+                "compile. Varying batch shapes (use drop_last or pad), "
+                "drifting dtypes, or per-step Python constants are the "
+                "usual causes."))
+            self._trace_base = traces
 
 
 class ReduceLROnPlateau(Callback):

@@ -11,8 +11,10 @@ Eager fallback (`compiled=False`) runs the tape for debugging.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
+import time
 import warnings
 
 import jax
@@ -30,6 +32,8 @@ from ..core.tensor import Tensor
 from ..io import DataLoader, Dataset, DistributedBatchSampler
 from ..metric import Metric
 from ..optimizer.lr import LRScheduler
+from ..profiler.timer import benchmark
+from ..profiler.tracing import train_tracer
 from . import callbacks as cbks_mod
 
 
@@ -173,6 +177,15 @@ class Model:
         self._compiled = True
         self._static_adapter = None
         self.mode = "train"
+        # observability (profiler/tracing.py + callbacks.TrainMonitor):
+        # all dormant — one pointer test per step — unless the process
+        # train tracer / a monitor turns them on
+        self._in_fit = False          # fit emits the train_step span itself
+        self._trace_phases = {}       # last step's {phase: (t0, t1)}
+        self._trace_sid = None        # last step's trace id, unclaimed
+        self._jit_traces = 0          # bumped at TRACE time in step bodies
+        self._monitor_grad_norm = False
+        self._last_grad_norm = None
 
     # ---- preparation -------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None, compiled=True):
@@ -184,6 +197,7 @@ class Model:
                 raise TypeError(f"metric must be paddle_tpu.metric.Metric, got {type(m)}")
         self._compiled = compiled
         self._compiled_steps = {}
+        self._jit_traces = 0
         # adapter selection (reference model.py:286): static mode active at
         # prepare() time routes batches through the captured-Program path
         from ..static.program import in_static_mode
@@ -222,12 +236,31 @@ class Model:
             return None
         return mesh
 
-    def _make_train_step(self, n_inputs, n_labels):
+    def _note_trace(self):
+        """Runs at XLA TRACE time only (a Python side effect inside the
+        step bodies, like the serving engine's ``jit_traces`` counter) —
+        the recompile sentinel's raw signal. Steady state means
+        `jit_traces == len(_compiled_steps)`; a surplus is a re-trace of
+        an existing program (an input's shape/dtype drifting per step)."""
+        self._jit_traces += 1
+
+    @property
+    def jit_traces(self):
+        return self._jit_traces
+
+    @property
+    def jit_retraces(self):
+        """Traces beyond one-per-compiled-program — 0 in steady state.
+        `callbacks.TrainMonitor` warns when this grows after warmup."""
+        return max(0, self._jit_traces - len(self._compiled_steps))
+
+    def _make_train_step(self, n_inputs, n_labels, with_grad_norm=False):
         net = self.network
         optimizer = self._optimizer
         mesh = self._dist_mesh()
 
         def step(params, buffers, opt_state, lr, key, *arrays):
+            self._note_trace()
             in_arrays = arrays[:n_inputs]
             lab_arrays = arrays[n_inputs:]
 
@@ -247,6 +280,14 @@ class Model:
             new_params, new_opt = optimizer.apply_gradients_arrays(
                 params, grads, opt_state, lr
             )
+            if with_grad_norm:
+                # global grad norm INSIDE the one compiled program (free
+                # relative to a step; requested by TrainMonitor(grad_norm))
+                gn = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)
+                ))
+                return loss, outs, new_buf, new_params, new_opt, gn
             return loss, outs, new_buf, new_params, new_opt
 
         if mesh is None:
@@ -266,6 +307,8 @@ class Model:
         in_sh = (pspecs, bspecs, ospecs, ns(P()), ns(P())) + batch_in
         # outputs (for metrics) take compiler-chosen shardings (None)
         out_sh = (ns(P()), None, bspecs, pspecs, ospecs)
+        if with_grad_norm:
+            out_sh = out_sh + (ns(P()),)
         from ..parallel.spmd import mesh_donate_argnums
 
         return jax.jit(
@@ -277,6 +320,7 @@ class Model:
         net = self.network
 
         def step(params, buffers, key, *arrays):
+            self._note_trace()
             in_arrays = arrays[:n_inputs]
             lab_arrays = arrays[n_inputs:]
             outs, _ = functional_call(
@@ -319,6 +363,8 @@ class Model:
             return self._static_adapter.train_batch(ins, labs)
         if not self._compiled:
             return self._train_batch_eager(ins, labs)
+        tr = train_tracer()
+        t_shard0 = time.monotonic() if tr is not None else 0.0
         params, buffers = state_dict_arrays(self.network)
         if self._opt_state is None:
             self._opt_state = self._optimizer.state_arrays_for(
@@ -341,13 +387,34 @@ class Model:
             sh = NamedSharding(mesh, P("dp"))
             ins = [jax.device_put(a, sh) for a in ins]
             labs = [jax.device_put(a, sh) for a in labs]
-        key = (self._shapes_key("train", ins + labs), id(mesh))
+        want_gn = self._monitor_grad_norm
+        key = (self._shapes_key("train", ins + labs), id(mesh), want_gn)
         if key not in self._compiled_steps:
-            self._compiled_steps[key] = self._make_train_step(len(ins), len(labs))
+            self._compiled_steps[key] = self._make_train_step(
+                len(ins), len(labs), with_grad_norm=want_gn
+            )
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
-        loss, outs, new_buf, new_params, new_opt = self._compiled_steps[key](
-            params, buffers, self._opt_state, lr, rng.next_key(), *ins, *labs
-        )
+        if tr is not None:
+            # the dispatch runs under the xplane join annotation so a
+            # jax.profiler capture of this fit joins back to the host
+            # train_step spans by step id (xplane.join_engine_steps)
+            sid = tr.next_step_id()
+            ann = jax.profiler.TraceAnnotation(tr.step_annotation(sid))
+        else:
+            sid, ann = None, contextlib.nullcontext()
+        t_disp0 = time.monotonic() if tr is not None else 0.0
+        with ann:
+            res = self._compiled_steps[key](
+                params, buffers, self._opt_state, lr, rng.next_key(),
+                *ins, *labs
+            )
+        if want_gn:
+            loss, outs, new_buf, new_params, new_opt, gn = res
+            self._last_grad_norm = gn
+        else:
+            loss, outs, new_buf, new_params, new_opt = res
+            self._last_grad_norm = None
+        t_sync0 = time.monotonic() if tr is not None else 0.0
         load_state_arrays(self.network, params=new_params, buffers=new_buf)
         self._opt_state = new_opt
         self._optimizer._step_count += 1
@@ -357,6 +424,19 @@ class Model:
         )
         metrics = self._update_metrics(outs, labs)
         loss_val = [float(np.asarray(loss))]
+        if tr is not None:
+            # fit wraps this step with the data/callback phases and emits
+            # the span itself; a standalone train_batch closes it here
+            self._trace_phases = {"shard": (t_shard0, t_disp0),
+                                  "dispatch": (t_disp0, t_sync0),
+                                  "sync": (t_sync0, time.monotonic())}
+            self._trace_sid = sid
+            if not self._in_fit:
+                tr.record_train_step(sid, self._trace_phases, {
+                    "batch_size": int(ins[0].shape[0]) if ins else 0,
+                    "loss": loss_val[0],
+                })
+                self._trace_sid = None
         if metrics:
             return loss_val, metrics
         return loss_val
@@ -380,6 +460,7 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
+        self._last_grad_norm = None
         ins = self._as_arrays(inputs)
         labs = self._as_arrays(labels)
         params, buffers = state_dict_arrays(self.network)
@@ -447,17 +528,24 @@ class Model:
             metrics=self._metrics_name(),
         )
         cbks.on_begin("train")
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(train_loader, cbks, "train", num_iters)
-            cbks.on_epoch_end(epoch, logs)
-            if do_eval and (epoch % eval_freq == 0 or epoch == epochs - 1):
-                eval_steps = self._len_or_none(eval_loader)
-                cbks.on_begin("eval", {"steps": eval_steps, "metrics": self._metrics_name()})
-                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
-                cbks.on_end("eval", eval_logs)
+        try:
+            for epoch in range(epochs):
+                if self.stop_training:
+                    break
+                cbks.on_epoch_begin(epoch)
+                logs = self._run_one_epoch(train_loader, cbks, "train", num_iters)
+                cbks.on_epoch_end(epoch, logs)
+                if do_eval and (epoch % eval_freq == 0 or epoch == epochs - 1):
+                    eval_steps = self._len_or_none(eval_loader)
+                    cbks.on_begin("eval", {"steps": eval_steps, "metrics": self._metrics_name()})
+                    eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                    cbks.on_end("eval", eval_logs)
+        except BaseException:
+            # on_train_end will never run: give callbacks that flipped
+            # process/model state on (TrainMonitor's debug switches) the
+            # chance to restore it before the exception leaves fit
+            cbks.on_interrupted("train")
+            raise
         cbks.on_end("train", logs)
         return self
 
@@ -505,23 +593,72 @@ class Model:
         for m in self._metrics:
             m.reset()
         logs = {}
-        for step, data in enumerate(loader):
-            if num_iters is not None and step >= num_iters:
-                break
-            cbks.on_batch_begin(mode, step, logs)
-            data = to_list(data)
-            n_in = len(self._inputs) or (len(data) - len(self._labels) if self._labels else len(data) - 1)
-            if n_in <= 0:
-                n_in = len(data) - 1 if len(data) > 1 else len(data)
-            ins, labs = data[:n_in], data[n_in:]
-            if mode == "train":
-                result = self.train_batch(ins, labs)
-                if isinstance(self._optimizer._learning_rate, LRScheduler):
-                    self._optimizer._learning_rate.step()
-            else:
-                result = self.eval_batch(ins, labs)
-            logs = self._merge_logs(result, metrics_names, step, len(to_list(ins)[0]) if ins else 0)
-            cbks.on_batch_end(mode, step, logs)
+        # train epochs drive the profiler.timer reader/step clocks
+        # (reference hapi behavior): benchmark().state() reports
+        # reader_cost/batch_cost/ips for TrainMonitor and operators, and
+        # the tracer's `data` phase is the same reader window
+        tr = train_tracer() if mode == "train" else None
+        bm = benchmark() if mode == "train" else None
+        if bm is not None:
+            bm.begin()
+        self._in_fit = True
+        try:
+            step = -1
+            it = iter(loader)
+            while True:
+                if bm is not None:
+                    bm.before_reader()
+                t_data0 = time.monotonic() if tr is not None else 0.0
+                try:
+                    data = next(it)
+                except StopIteration:
+                    break
+                if bm is not None:
+                    bm.after_reader()
+                t_data1 = time.monotonic() if tr is not None else 0.0
+                step += 1
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin(mode, step, logs)
+                data = to_list(data)
+                n_in = len(self._inputs) or (len(data) - len(self._labels) if self._labels else len(data) - 1)
+                if n_in <= 0:
+                    n_in = len(data) - 1 if len(data) > 1 else len(data)
+                ins, labs = data[:n_in], data[n_in:]
+                self._trace_sid = None
+                if mode == "train":
+                    result = self.train_batch(ins, labs)
+                    if isinstance(self._optimizer._learning_rate, LRScheduler):
+                        self._optimizer._learning_rate.step()
+                else:
+                    result = self.eval_batch(ins, labs)
+                t_cb0 = time.monotonic() if tr is not None else 0.0
+                batch_size = len(to_list(ins)[0]) if ins else 0
+                logs = self._merge_logs(result, metrics_names, step, batch_size)
+                cbks.on_batch_end(mode, step, logs)
+                if bm is not None:
+                    bm.step(num_samples=batch_size)
+                if tr is not None and self._trace_sid is not None:
+                    # one train_step span per fit step: the reader window,
+                    # the shard/dispatch/sync phases train_batch deposited,
+                    # and the callback tail (merge + logging + callbacks)
+                    phases = dict(self._trace_phases)
+                    phases["data"] = (t_data0, t_data1)
+                    phases["callback"] = (t_cb0, time.monotonic())
+                    tr.record_train_step(self._trace_sid, phases, {
+                        "batch": step,
+                        "batch_size": batch_size,
+                        "loss": logs.get("loss"),
+                    })
+                    self._trace_sid = None
+                if mode == "train" and self.stop_training:
+                    # a callback (TrainMonitor nan_action="stop",
+                    # EarlyStopping) asked mid-epoch: don't run the rest
+                    # of the epoch on state it already condemned. Train
+                    # only — an eval epoch must see every sample
+                    break
+        finally:
+            self._in_fit = False
         self._reset_nothing = None
         return logs
 
@@ -533,6 +670,10 @@ class Model:
         elif isinstance(result, list) and self._loss is not None:
             # train/eval path without metrics: the list is the loss values
             logs["loss"] = result[0]
+        if self._last_grad_norm is not None:
+            # computed in-program when TrainMonitor(grad_norm=True) asked;
+            # the host value is free here (the loss sync already ran)
+            logs["grad_norm"] = float(np.asarray(self._last_grad_norm))
         for m in self._metrics:
             for name, val in zip(to_list(m.name()), to_list(m.accumulate())):
                 logs[name] = val

@@ -19,6 +19,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.pipeline import gpipe, make_pipeline_loss, stack_stage_params
 from ..parallel.spmd import mesh_donate_argnums as _mesh_donate
+from ..profiler.tracing import InstrumentedStep
 
 
 def _init_block(key, H, F, n_heads):
@@ -228,4 +229,9 @@ def make_pipelined_gpt(cfg, mesh, n_microbatches, schedule="gpipe"):
         return loss, new_p
 
     params = jax.device_put(params, pspecs)
-    return params, train_step
+    # InstrumentedStep: per-call train_step span while the process train
+    # tracer is on, transparent otherwise — jit's .lower/.trace still
+    # reach the compiled function (test_pipeline_schedules does AOT
+    # memory analysis on it)
+    return params, InstrumentedStep(
+        train_step, {"source": "gpt_pipeline", "schedule": schedule})

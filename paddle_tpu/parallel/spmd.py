@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import rng
 from ..core.functional import functional_call, state_dict_arrays
 from ..core.tensor import Tensor
+from ..profiler.tracing import InstrumentedStep
 
 
 def mesh_donate_argnums(argnums):
@@ -296,7 +297,12 @@ class ShardedTrainStep:
 
     def __call__(self, params, buffers, opt_state, lr, key, *batch):
         if self._compiled is None:
-            self._compiled = self._build(len(batch))
+            # InstrumentedStep: one train_step span (dispatch only — the
+            # caller owns the host sync) per call under the xplane join
+            # annotation while the process train tracer is on; a pointer
+            # test otherwise
+            self._compiled = InstrumentedStep(
+                self._build(len(batch)), {"source": "ShardedTrainStep"})
         return self._compiled(params, buffers, opt_state, lr, key, *batch)
 
 
@@ -420,8 +426,10 @@ class LocalSGDTrainStep:
 
     def __call__(self, params, buffers, opt_state, count, lr, key, *batch):
         if self._compiled is None:
-            self._compiled = self._build(len(batch))
-        return self._compiled(params, buffers, opt_state, count, lr, key, *batch)
+            self._compiled = InstrumentedStep(
+                self._build(len(batch)), {"source": "LocalSGDTrainStep"})
+        return self._compiled(params, buffers, opt_state, count, lr, key,
+                              *batch)
 
 
 def shard_params_to_mesh(model, mesh, zero_stage=0):

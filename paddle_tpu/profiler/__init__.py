@@ -1,3 +1,4 @@
+from . import flops, tracing  # noqa: F401
 from .profiler import (  # noqa: F401
     Profiler,
     ProfilerState,
@@ -7,3 +8,11 @@ from .profiler import (  # noqa: F401
     make_scheduler,
 )
 from .timer import benchmark  # noqa: F401
+from .tracing import (  # noqa: F401
+    Tracer,
+    TrainTracer,
+    disable_train_tracing,
+    enable_train_tracing,
+    reset_train_tracing,
+    train_tracer,
+)

@@ -258,15 +258,19 @@ def engine_step_spans(logdir_or_file):
 
 
 def join_engine_steps(chrome_trace, logdir_or_file):
-    """Join a serving trace (`EngineTracer.chrome_trace()` dict, or a path
-    to its dumped JSON) to a device capture by step id.
+    """Join a host trace (`EngineTracer`/`TrainTracer` ``chrome_trace()``
+    dict, or a path to its dumped JSON) to a device capture by step id.
 
-    Returns one record per host ``step`` span, sorted by step id:
+    Accepts the serving step timeline's ``step[kind]`` spans AND the
+    training stack's ``train_step`` spans (profiler/tracing.py) — both
+    wrap their device dispatch in the same ``paddle_tpu.step <id>``
+    annotation. Returns one record per host span, sorted by step id:
     ``{"step", "kind", "host_ts_us", "host_dur_us", "capture_dur_us",
-    "capture_plane"}`` — capture fields are None for steps the capture
-    did not cover (the two recorders have independent lifetimes). The
-    two clocks are unrelated, so only DURATIONS are comparable across
-    the join, never absolute timestamps."""
+    "capture_plane"}`` — ``kind`` is None for training spans; capture
+    fields are None for steps the capture did not cover (the two
+    recorders have independent lifetimes). The two clocks are unrelated,
+    so only DURATIONS are comparable across the join, never absolute
+    timestamps."""
     import json as _json
 
     if isinstance(chrome_trace, str):
@@ -276,8 +280,9 @@ def join_engine_steps(chrome_trace, logdir_or_file):
     rows = []
     for ev in chrome_trace.get("traceEvents", ()):
         args = ev.get("args") or {}
+        name = ev.get("name", "")
         if ev.get("ph") != "X" or "step" not in args \
-                or not ev.get("name", "").startswith("step["):
+                or not (name.startswith("step[") or name == "train_step"):
             continue
         sid = args["step"]
         d = device.get(sid)
@@ -304,3 +309,44 @@ def print_summary(logdir_or_file, device_only=True, top=20, file=None):
               f"(lines: {', '.join(entry['lines'])})", file=f)
         for name, ms in entry["by_category"]:
             print(f"  {ms:10.3f} ms  {name[:100]}", file=f)
+
+
+def main(argv=None):
+    """``python -m paddle_tpu.profiler.xplane <logdir-or-file>`` — render
+    the per-op-category busy-time summary and the executor-schedule
+    analysis for a capture, straight from the shell (the functions have
+    existed since round 1; this is their entry point)."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.profiler.xplane",
+        description="Summarize a jax.profiler xplane capture: per-category "
+                    "op busy time (print_summary) + device busy/idle/gap "
+                    "schedule analysis (print_schedule_analysis).",
+    )
+    p.add_argument("logdir_or_file",
+                   help="a profiler logdir (globbed for **/*.xplane.pb) "
+                        "or one .xplane.pb capture file")
+    p.add_argument("--top", type=int, default=20,
+                   help="op/category rows per plane (default 20)")
+    p.add_argument("--top-gaps", type=int, default=10,
+                   help="largest idle gaps per plane (default 10)")
+    p.add_argument("--host", action="store_true",
+                   help="include host planes in the op summary "
+                        "(device_only=False; CPU captures need this)")
+    args = p.parse_args(argv)
+    if not _capture_paths(args.logdir_or_file):
+        print(f"no *.xplane.pb captures under {args.logdir_or_file}",
+              file=sys.stderr)
+        return 1
+    print_summary(args.logdir_or_file, device_only=not args.host,
+                  top=args.top)
+    print_schedule_analysis(args.logdir_or_file, top_gaps=args.top_gaps)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -63,6 +63,7 @@ import json
 import logging
 import os
 import time
+import warnings
 from collections import namedtuple
 
 import numpy as np
@@ -153,8 +154,9 @@ class LLMEngine:
         # is always recorded while the tracer exists. When off, tracer is
         # None and every hook site below is a single pointer test — the
         # untraced serve is byte-identical to the pre-trace engine.
-        from .trace import (EngineTracer, trace_capacity_from_env,
-                            trace_sample_from_env)
+        from ..profiler.tracing import (trace_capacity_from_env,
+                                        trace_sample_from_env)
+        from .trace import EngineTracer
 
         if trace is None:
             sample = trace_sample_from_env()
@@ -190,6 +192,7 @@ class LLMEngine:
         self._requests = {}
         self._step_fns = {}
         self._phases = {}   # current step's {phase: (t0, t1)} when tracing
+        self._retrace_warned = False
         self._key = jax.random.PRNGKey(seed)
 
     # -- request lifecycle -------------------------------------------------
@@ -455,6 +458,23 @@ class LLMEngine:
         self.metrics.set_gauge("num_running", len(self.scheduler.running))
         self.metrics.set_gauge("num_waiting", len(self.scheduler.waiting))
         c = self.metrics.counters
+        # recompile sentinel: steady state means jit_traces == compiled
+        # programs (each of the at-most-3 programs traces exactly once).
+        # A surplus trace is a RE-trace of an existing program — some
+        # input's shape/dtype is drifting per step, and every retrace
+        # pays a full XLA compile on the serving hot path.
+        retraces = int(c.get("jit_traces", 0)) - len(self._step_fns)
+        self.metrics.set_gauge("jit_retraces", max(retraces, 0))
+        if retraces > 0 and not self._retrace_warned:
+            self._retrace_warned = True
+            warnings.warn(
+                f"LLMEngine recompile sentinel: {retraces} re-trace(s) of "
+                f"already-compiled step programs ({len(self._step_fns)} "
+                f"programs, {int(c['jit_traces'])} traces) — a step input's "
+                "shape or dtype is varying between steps; steady-state "
+                "serving should compile each program exactly once",
+                RuntimeWarning, stacklevel=2,
+            )
         n_steps = (c.get("mixed_steps", 0) + c.get("decode_steps", 0)
                    + c.get("verify_steps", 0))
         if n_steps:

@@ -86,6 +86,17 @@ _HELP = {
     "spec_drafted_rows": "Verify rows that carried a draft",
     "spec_acceptance_rate": "Cumulative accepted/proposed draft ratio",
     "spec_mean_accepted_len": "Accepted draft tokens per drafted row",
+    "jit_retraces": "Re-traces of already-compiled step programs "
+                    "(recompile sentinel; 0 in steady state)",
+    "pool_blocks_total": "Usable KV blocks in the pool (excludes the "
+                         "null block)",
+    "pool_blocks_truly_free": "KV blocks free and holding no cached "
+                              "prefix",
+    "pool_blocks_cached_free": "Refcount-0 KV blocks parked in the "
+                               "cached-free LRU tier (still matchable)",
+    "pool_blocks_allocated": "KV blocks held by live sequences",
+    "pool_requests_running": "Sequences in the running batch (pool view)",
+    "pool_requests_waiting": "Requests waiting for a lane (pool view)",
     "backpressure_drops": "Streams switched to catch-up mode (consumer "
                           "lagged)",
     "client_disconnects": "Requests aborted because the client went away",

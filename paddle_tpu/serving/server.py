@@ -181,8 +181,16 @@ class ServingServer:
         if path == "/healthz":
             return await self._healthz(writer)
         if path == "/metrics":
+            # pool-saturation gauges (the /healthz split: truly-free vs
+            # cached-free vs allocated blocks, running/waiting) refresh
+            # from the live engine at scrape time so dashboards never need
+            # to scrape a non-Prometheus endpoint — plain int reads,
+            # GIL-consistent, no engine-thread handshake
+            m = self.engine.metrics
+            for k, v in self.engine.engine.pool_stats().items():
+                m.set_gauge(f"pool_{k}", v)
             writer.write(_http_response(
-                "200 OK", self.engine.metrics.prometheus_text(),
+                "200 OK", m.prometheus_text(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             ))
             return await writer.drain()

@@ -22,19 +22,14 @@ exactly those two views as Chrome/Perfetto trace events:
   (mixed/decode/verify), and token counts. Pool evictions land as
   instants on a ``block-pool`` track.
 
-**Tracing is compiled out by default**: a disabled engine holds
-``tracer = None`` and every hook site is a single ``if tracer is not
-None`` — no clocks read, no events built, output byte-identical to the
-untraced path (tests/test_serving_trace.py locks this). Enable with
-``PADDLE_TPU_TRACE=1`` (or a sampling fraction, e.g. ``0.1`` to trace one
-request in ten; step spans are always recorded while enabled) or
-``LLMEngine(trace=...)``; a single request can force itself in (or out)
-with ``trace=True``/``False`` regardless of the sampling decision.
-
-Memory is bounded by a **ring buffer** (``PADDLE_TPU_TRACE_BUF`` events,
-default 65536): a long-running engine overwrites its oldest events
-instead of growing. Request tracks come from a fixed pool of lanes, so
-track-name metadata stays O(lanes), not O(requests served).
+The ring buffer, clocks, export, and the xplane join annotation are the
+shared recorder in `paddle_tpu.profiler.tracing` (`Tracer`), which the
+training stack's `TrainTracer` builds on too — this module adds only the
+serving-specific tracks and span vocabulary. The env knobs
+(``PADDLE_TPU_TRACE`` as an on/off switch or request sampling fraction,
+``PADDLE_TPU_TRACE_BUF`` as the ring bound) and the one-pointer-test
+off-by-default discipline are shared verbatim; see the base module's
+docstring for both.
 
 Export: `chrome_trace()` returns the standard trace-event JSON object
 (``{"traceEvents": [...]}``) — serve it from ``GET /debug/trace``
@@ -47,11 +42,14 @@ join host phases to device ops captured with `jax.profiler.trace`.
 """
 from __future__ import annotations
 
-import json
-import os
-import threading
 import time
-from collections import deque
+
+from ..profiler.tracing import (  # noqa: F401  (re-exported API)
+    STEP_ANNOTATION_PREFIX,
+    Tracer,
+    trace_capacity_from_env,
+    trace_sample_from_env,
+)
 
 # process ids of the two fixed tracks groups
 PID_ENGINE = 1
@@ -66,51 +64,24 @@ TID_POOL = 1
 _LANE_BASE = 10
 _NUM_LANES = 256
 
-STEP_ANNOTATION_PREFIX = "paddle_tpu.step "
+_STEP_PHASES = ("plan", "build", "dispatch", "sync", "emit")
 
 
-def trace_sample_from_env(env="PADDLE_TPU_TRACE"):
-    """The PADDLE_TPU_TRACE knob as a sampling fraction: unset/falsy -> 0.0
-    (tracing off), truthy -> 1.0, a float string -> that fraction of
-    requests (clamped to [0, 1]; step spans are always on while > 0)."""
-    v = os.environ.get(env, "").strip().lower()
-    if v in ("", "0", "0.0", "false", "off", "no"):
-        return 0.0
-    try:
-        f = float(v)
-    except ValueError:
-        return 1.0
-    return min(max(f, 0.0), 1.0)
-
-
-def trace_capacity_from_env(env="PADDLE_TPU_TRACE_BUF", default=65536):
-    try:
-        cap = int(os.environ.get(env, "") or default)
-    except ValueError:
-        cap = default
-    return max(16, cap)
-
-
-class EngineTracer:
+class EngineTracer(Tracer):
     """Bounded trace-event recorder for one `LLMEngine`.
 
     All timestamps come from ``time.monotonic()`` — the same clock
     `Request.arrival_time` and ServingMetrics use, so TTFT/queue-wait
     spans agree with the metric quantiles by construction. The engine
     thread is the only writer; `chrome_trace()` may be called from any
-    thread (the HTTP event loop mid-serve) — a lock covers the ring
-    append and the export snapshot, because iterating a deque that
-    another thread is appending to raises RuntimeError.
+    thread (the HTTP event loop mid-serve) — the base class's lock covers
+    the ring append and the export snapshot.
     """
 
+    producer = "paddle_tpu.serving.trace"
+
     def __init__(self, capacity=65536, sample=1.0):
-        self.capacity = int(capacity)
-        self.sample = float(sample)
-        self.events = deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
-        self.epoch = time.monotonic()
-        self.dropped = 0          # events overwritten by the ring
-        self._step_id = 0
+        super().__init__(capacity=capacity, sample=sample)
         self._acc = 0.0           # deterministic sampling accumulator
         self._lane_of = {}        # request_id -> tid (live requests only)
         self._next_lane = 0
@@ -125,39 +96,6 @@ class EngineTracer:
                           {"name": "requests"}),
         ]
         self._named_lanes = set()
-
-    # -- low-level event plumbing -----------------------------------------
-
-    @staticmethod
-    def _meta_ev(name, pid, tid, args):
-        return {"name": name, "ph": "M", "pid": pid, "tid": tid,
-                "ts": 0, "args": args}
-
-    def ts(self, t):
-        """monotonic seconds -> trace microseconds."""
-        return (t - self.epoch) * 1e6
-
-    def _push(self, ev):
-        with self._lock:
-            if len(self.events) == self.capacity:
-                self.dropped += 1
-            self.events.append(ev)
-
-    def complete(self, name, pid, tid, start, end, args=None):
-        """One 'X' (complete) span from monotonic `start` to `end`."""
-        ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
-              "ts": round(self.ts(start), 3),
-              "dur": round(max(end - start, 0.0) * 1e6, 3)}
-        if args:
-            ev["args"] = args
-        self._push(ev)
-
-    def instant(self, name, pid, tid, t=None, args=None):
-        ev = {"name": name, "ph": "i", "s": "t", "pid": pid, "tid": tid,
-              "ts": round(self.ts(time.monotonic() if t is None else t), 3)}
-        if args:
-            ev["args"] = args
-        self._push(ev)
 
     # -- request lifecycle --------------------------------------------------
 
@@ -244,58 +182,14 @@ class EngineTracer:
 
     # -- engine step timeline ----------------------------------------------
 
-    def next_step_id(self):
-        sid = self._step_id
-        self._step_id += 1
-        return sid
-
-    def step_annotation(self, step_id):
-        """Name for the `jax.profiler.TraceAnnotation` wrapping this
-        step's device dispatch — the join key between this host trace and
-        an xplane device capture (profiler.xplane.engine_step_spans)."""
-        return f"{STEP_ANNOTATION_PREFIX}{step_id}"
-
     def record_step(self, step_id, kind, phases, args):
         """Emit the ``step`` span and its phase children on the engine
         track. `phases` is {name: (start, end)} in monotonic seconds; the
         step span covers min(start)..max(end)."""
-        s0 = min(t0 for t0, _ in phases.values())
-        s1 = max(t1 for _, t1 in phases.values())
-        a = {"step": step_id, "kind": kind}
+        a = {"kind": kind}
         a.update(args)
-        self.complete(f"step[{kind}]", PID_ENGINE, TID_STEPS, s0, s1, a)
-        for name in ("plan", "build", "dispatch", "sync", "emit"):
-            if name in phases:
-                t0, t1 = phases[name]
-                self.complete(name, PID_ENGINE, TID_STEPS, t0, t1,
-                              {"step": step_id})
+        self.phased_span(f"step[{kind}]", PID_ENGINE, TID_STEPS, step_id,
+                         phases, _STEP_PHASES, a)
 
     def pool_instant(self, name, args=None):
         self.instant(name, PID_ENGINE, TID_POOL, args=args)
-
-    # -- export -------------------------------------------------------------
-
-    def chrome_trace(self):
-        """The trace as a Chrome/Perfetto trace-event JSON object. Track
-        metadata is kept outside the ring, so lane names survive even
-        after the ring has overwritten the events that created them."""
-        with self._lock:
-            ring = list(self.events)
-        return {
-            "traceEvents": list(self._meta) + ring,
-            "displayTimeUnit": "ms",
-            "otherData": {
-                "producer": "paddle_tpu.serving.trace",
-                "sample": self.sample,
-                "capacity": self.capacity,
-                "dropped_events": self.dropped,
-            },
-        }
-
-    def dump(self, path):
-        """Write the Perfetto-loadable JSON to `path`; returns the event
-        count written."""
-        trace = self.chrome_trace()
-        with open(path, "w") as f:
-            json.dump(trace, f)
-        return len(trace["traceEvents"])

@@ -64,3 +64,37 @@ def test_flag_off_no_check():
     x = paddle.to_tensor(np.ones((1, 4), np.float32))
     y = net(x)  # no raise
     assert np.isnan(y.numpy()).all()
+
+
+class _MultiOut(nn.Layer):
+    """Layer with a structured output: only one leaf is poisoned."""
+
+    def forward(self, x):
+        return x, {"aux": x + 1.0, "bad": x * float("nan")}
+
+
+def test_failure_names_first_nonfinite_leaf_path(nan_flag):
+    """Observability-issue satellite: the report must NAME the offending
+    leaf (pytree path inside the layer's output), not just say
+    'non-finite detected' — for multi-output layers that is the
+    difference between a lead and a grep."""
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    with pytest.raises(RuntimeError) as ei:
+        _MultiOut()(x)
+    msg = str(ei.value)
+    assert "_MultiOut" in msg
+    assert "[1]['bad']" in msg            # the pytree path of the bad leaf
+    assert "'aux'" not in msg             # the clean leaves are not blamed
+
+
+def test_failure_names_first_bad_index(nan_flag):
+    """... and the first non-finite ELEMENT's index, localizing a
+    poisoned row/channel."""
+    net = nn.Linear(4, 4)
+    net.weight.set_value(np.zeros((4, 4), np.float32))
+    b = np.zeros((4,), np.float32)
+    b[3] = np.inf                         # one poisoned output channel
+    net.bias.set_value(b)
+    x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    with pytest.raises(RuntimeError, match=r"first at index \[0, 3\]"):
+        net(x)

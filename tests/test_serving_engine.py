@@ -321,3 +321,50 @@ def test_scheduler_pool_too_small_fails_loudly():
     sched.add(Request([1] * 12, max_new_tokens=1))  # needs 3 blocks, pool has 2
     with pytest.raises(ValueError, match="KV blocks"):
         sched.schedule()
+
+
+def test_recompile_sentinel_zero_retraces_steady_state(model):
+    """The exactly-3-programs invariant, locked from the sentinel's side:
+    after one warmup wave has compiled the mixed, decode, AND verify
+    programs, an arbitrary steady-state serve (varied prompt lengths,
+    sampling knobs, cache hits) must run with ZERO further XLA traces —
+    `jit_traces` stays equal to the compiled-program count, the
+    `jit_retraces` gauge stays 0, and the sentinel never warns."""
+    import warnings
+
+    engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                       spec_decoding=True, num_spec_tokens=3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")       # any sentinel warning fails
+        # warmup: a repetitive prompt drives mixed + decode + verify
+        engine.generate([[7] * 24], max_new_tokens=6)
+        assert len(engine._step_fns) == 3
+        warm = engine.metrics.counters["jit_traces"]
+        assert warm == 3                     # one trace per program, ever
+        rs = np.random.RandomState(1)
+        for round_ in range(3):
+            prompts = [rs.randint(0, 128, (n,)).tolist()
+                       for n in (5, 17, 9)]
+            engine.generate(prompts[:2], max_new_tokens=8)
+            engine.generate([prompts[2]], max_new_tokens=4,
+                            temperature=0.8, top_k=5)
+    assert engine.metrics.counters["jit_traces"] == warm  # 0 retraces
+    assert engine.metrics.gauges["jit_retraces"] == 0
+
+
+def test_recompile_sentinel_warns_on_surplus_trace(model):
+    """A trace beyond one-per-program is exactly what the sentinel must
+    catch: simulate one (the counter is the engine's own trace-time
+    signal) and the next step warns once, sets the gauge, and never
+    spams."""
+    import warnings
+
+    engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64)
+    engine.generate(_prompts((9,)), max_new_tokens=2)
+    engine.metrics.inc("jit_traces")         # a phantom re-trace
+    with pytest.warns(RuntimeWarning, match="recompile sentinel"):
+        engine.generate(_prompts((7,), seed=1), max_new_tokens=2)
+    assert engine.metrics.gauges["jit_retraces"] == 1
+    with warnings.catch_warnings():          # warns once, never spams
+        warnings.simplefilter("error")
+        engine.generate(_prompts((5,), seed=2), max_new_tokens=2)

@@ -440,3 +440,38 @@ def test_server_per_request_trace_flag(model):
     closed = [e for e in trace["traceEvents"] if e["name"] == "request"]
     assert len(closed) == 1              # only the forced request traced
     assert closed[0]["args"]["output_tokens"] == 3
+
+
+def test_metrics_exposes_pool_saturation_gauges(model):
+    """Observability satellite: the /healthz pool split (truly-free vs
+    cached-free vs allocated blocks, running/waiting) must ALSO land on
+    Prometheus /metrics — with HELP/TYPE — so dashboards never scrape a
+    non-Prometheus endpoint. The gauges refresh at scrape time and agree
+    with /healthz's live numbers on an idle engine."""
+    async def run():
+        engine, server = await _start_server(model)
+        try:
+            await server.engine.submit(
+                _prompts((9,))[0], max_new_tokens=4).collect()
+            mstatus, mbody = await _http(server.port, "GET", "/metrics")
+            hstatus, hbody = await _http(server.port, "GET", "/healthz")
+            return engine, mstatus, mbody.decode(), json.loads(hbody)
+        finally:
+            await server.shutdown()
+
+    engine, mstatus, metrics, health = asyncio.run(run())
+    assert mstatus == 200
+    gauges = {}
+    for line in metrics.splitlines():
+        if line.startswith("paddle_tpu_serving_pool_"):
+            name, val = line.rsplit(" ", 1)
+            gauges[name] = float(val)
+    want = {f"paddle_tpu_serving_pool_{k}": float(v)
+            for k, v in health["pool"].items()}
+    assert gauges == want                      # same live numbers
+    assert gauges["paddle_tpu_serving_pool_blocks_total"] > 0
+    assert gauges["paddle_tpu_serving_pool_blocks_allocated"] == 0  # idle
+    for fam in ("pool_blocks_truly_free", "pool_blocks_cached_free",
+                "pool_requests_running", "pool_requests_waiting"):
+        assert f"# HELP paddle_tpu_serving_{fam} " in metrics
+        assert f"# TYPE paddle_tpu_serving_{fam} gauge" in metrics
