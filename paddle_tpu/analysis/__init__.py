@@ -1,0 +1,35 @@
+"""jaxlint: a jit-hygiene static analyzer for this codebase.
+
+Every rule encodes a bug class this repo has shipped, debugged, and
+postmortemed (CHANGES.md PRs 1, 3, 5, 6) — the analyzer turns those
+postmortems into machine-checked invariants, run as a tier-1 CI gate
+(tests/test_lint_codebase.py).
+
+Usage:
+
+    python -m paddle_tpu.analysis [paths...]    # or: paddle-tpu-lint
+    from paddle_tpu.analysis import lint_paths, lint_source
+
+Rules (suppress inline with ``# jaxlint: disable=JLxxx -- reason``):
+
+- JL001 donation-aliasing     zero-copy jnp.asarray into donated state
+- JL002 repr-keyed-cache      repr/str/f-string cache keys constant-bake
+- JL003 host-callback-in-jit  device->host syncs traced into programs
+- JL004 ungated-donation      donate_argnums outside mesh_donate_argnums
+- JL005 lock-discipline       guarded state touched outside its lock
+- JL006 retrace-hazard        per-call jit rebuilds / unhashable statics
+- JL007 async-hygiene         blocking calls on the event loop
+
+Pure stdlib ``ast`` — importing this package pulls in no jax/numpy.
+"""
+from .core import (  # noqa: F401
+    Finding,
+    Report,
+    Rule,
+    all_rules,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = ["Finding", "Report", "Rule", "all_rules", "lint_paths",
+           "lint_source"]

@@ -1,0 +1,90 @@
+"""jaxlint CLI: ``python -m paddle_tpu.analysis`` / ``paddle-tpu-lint``.
+
+Exit codes: 0 clean, 1 unsuppressed findings or unparseable files,
+2 usage errors. ``--json`` emits the machine-readable report (schema
+canary in tests/test_analysis_rules.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import all_rules, lint_paths
+
+
+def default_target():
+    """The installed paddle_tpu package root (lint the whole tree when no
+    path is given)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _split_ids(value):
+    return [s.strip() for s in value.split(",") if s.strip()]
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu-lint",
+        description="jit-hygiene static analyzer (jaxlint) for the "
+                    "paddle_tpu codebase",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "installed paddle_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the JSON report instead of text")
+    ap.add_argument("--select", type=_split_ids, default=None,
+                    metavar="IDS", help="only run these rule ids "
+                    "(comma-separated, e.g. JL001,JL004)")
+    ap.add_argument("--ignore", type=_split_ids, default=None,
+                    metavar="IDS", help="skip these rule ids")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings (text mode; the "
+                         "JSON report always carries them)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id} {rule.name}")
+            doc = " ".join((rule.__doc__ or "").split())
+            if doc:
+                print(f"    {doc}")
+            if rule.incident:
+                print(f"    incident: {rule.incident}")
+        return 0
+    paths = args.paths or [default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"paddle-tpu-lint: no such path: {p}", file=sys.stderr)
+            return 2
+    # default sweep reports paths as paddle_tpu/... regardless of cwd
+    rel_to = os.path.dirname(default_target()) if not args.paths else None
+    report = lint_paths(paths, select=args.select, ignore=args.ignore,
+                        rel_to=rel_to)
+    if args.as_json:
+        json.dump(report.to_json(), sys.stdout, indent=2)
+        print()
+        return 0 if report.ok else 1
+    for f in report.findings:
+        if f.suppressed and not args.show_suppressed:
+            continue
+        print(f.format())
+    for path, msg in report.errors:
+        print(f"{path}: error: {msg}")
+    n = len(report.unsuppressed)
+    print(f"jaxlint: {report.files} files, {n} finding(s), "
+          f"{len(report.suppressed)} suppressed, "
+          f"{len(report.errors)} error(s) "
+          f"[{report.duration_s:.2f}s]")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

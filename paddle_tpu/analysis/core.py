@@ -1,0 +1,362 @@
+"""jaxlint core: rule framework, suppression handling, file runner.
+
+The analyzer is pure-stdlib ``ast`` — no jax import, no third-party
+dependency — so it can run as a CI gate before the heavyweight runtime
+even installs. Each rule codifies one bug class this repo has actually
+shipped and debugged (see README "Static analysis" for the catalog and
+the motivating postmortems); the rule docstrings carry the incident.
+
+Suppressions
+------------
+A finding can be accepted-as-is with an inline comment naming the rule:
+
+    self._arr = jnp.asarray(buf)  # jaxlint: disable=JL001 -- why it is ok
+
+- trailing on the flagged line: suppresses that line;
+- on its own line: suppresses the next source line (for long statements);
+- ``# jaxlint: disable-file=JL003`` anywhere: suppresses the whole file;
+- ``disable=all`` suppresses every rule.
+
+Text after ``--`` is the justification and is carried into the JSON
+output; the codebase gate (tests/test_lint_codebase.py) accepts
+suppressed findings, so a suppression is a reviewed, documented waiver —
+not a silent one.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import time
+import tokenize
+
+# ---------------------------------------------------------------------------
+# findings + rules
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str           # "JL001"
+    name: str           # "donation-aliasing"
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: str | None = None
+
+    def format(self):
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.name}: {self.message}{tag}")
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """One checked invariant. Subclasses set `id`/`name`/`incident` and
+    implement `check(module) -> iterable[Finding]`; `incident` names the
+    historical bug the rule encodes (shown by ``--list-rules``)."""
+
+    id = "JL000"
+    name = "abstract"
+    incident = ""
+
+    def check(self, module):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def finding(self, module, node, message):
+        return Finding(
+            rule=self.id, name=self.name, path=module.path,
+            line=getattr(node, "lineno", 1), col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding one instance of the rule to the registry."""
+    inst = cls()
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+def all_rules():
+    # import for side effect: rule modules self-register
+    from . import rules  # noqa: F401
+
+    return [RULES[k] for k in sorted(RULES)]
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable|disable-file)=([A-Za-z0-9_,\s]*?)"
+    r"(?:\s+--\s*(.*))?\s*$"
+)
+
+
+def _parse_suppressions(src):
+    """(line -> (ids, justification), file_ids, file_justifications).
+
+    Comments are read with `tokenize` so strings that merely contain the
+    marker never suppress anything. A standalone comment line applies to
+    the next source line; a trailing comment applies to its own line.
+    """
+    line_map = {}
+    file_ids = {}
+    standalone = []  # (lineno, ids, justification) pending next code line
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return line_map, file_ids
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            kind, raw_ids, just = m.group(1), m.group(2), m.group(3)
+            ids = {s.strip().upper() for s in raw_ids.split(",") if s.strip()}
+            if not ids:
+                continue
+            if kind == "disable-file":
+                for i in ids:
+                    file_ids[i] = just
+            elif tok.line[: tok.start[1]].strip() == "":
+                standalone.append((tok.start[0], ids, just))
+            else:
+                cur = line_map.setdefault(tok.start[0], ({}, ))[0]
+                for i in ids:
+                    cur[i] = just
+        elif tok.type not in (
+            tokenize.NL, tokenize.NEWLINE, tokenize.INDENT, tokenize.DEDENT,
+            tokenize.ENCODING, tokenize.ENDMARKER, tokenize.COMMENT,
+        ):
+            # first token of real code: attach pending standalone comments
+            # to this line. Decorator lines keep the comment pending too —
+            # findings on decorated defs anchor at the `def` line, so a
+            # comment above `@jax.jit` must reach it
+            for _, ids, just in standalone:
+                cur = line_map.setdefault(tok.start[0], ({}, ))[0]
+                for i in ids:
+                    cur[i] = just
+            if not tok.line.lstrip().startswith("@"):
+                standalone = []
+    return line_map, file_ids
+
+
+# ---------------------------------------------------------------------------
+# module model shared by rules
+
+
+def set_parents(tree):
+    """Link parents and return every node in the tree (one walk serves
+    both: the rules iterate the cached list instead of re-walking)."""
+    nodes = [tree]
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            child._jaxlint_parent = node
+            nodes.append(child)
+            stack.append(child)
+    return nodes
+
+
+def parent(node):
+    return getattr(node, "_jaxlint_parent", None)
+
+
+def ancestors(node):
+    n = parent(node)
+    while n is not None:
+        yield n
+        n = parent(n)
+
+
+def collect_aliases(nodes):
+    """Local name -> dotted module path, from import statements.
+
+    `import jax.numpy as jnp` maps jnp -> jax.numpy; `from jax import
+    numpy as jnp` the same; relative imports keep their leading dots so
+    suffix matching still works.
+    """
+    aliases = {}
+    for node in nodes:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    aliases[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            mod = "." * node.level + (node.module or "")
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{mod}.{a.name}" if mod else a.name
+    return aliases
+
+
+def qualname(node, aliases):
+    """Dotted name of a Name/Attribute chain with import aliases resolved,
+    or None for anything that is not a plain dotted reference."""
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Attribute):
+        base = qualname(node.value, aliases)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def qn_matches(qn, *names):
+    """True when `qn` equals one of `names` or ends with `.name` (covers
+    relative imports and re-exports)."""
+    if qn is None:
+        return False
+    return any(qn == n or qn.endswith("." + n) for n in names)
+
+
+class Module:
+    """One parsed file plus everything the rules share: parent links,
+    import aliases, suppression maps."""
+
+    def __init__(self, path, src, display_path=None):
+        self.path = display_path or path
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        self.nodes = set_parents(self.tree)   # every node, parent-linked
+        self.aliases = collect_aliases(self.nodes)
+        self._line_suppress, self._file_suppress = _parse_suppressions(src)
+
+    def qualname(self, node):
+        return qualname(node, self.aliases)
+
+    def apply_suppressions(self, finding):
+        """Mark `finding` suppressed (with its justification) when a
+        matching comment covers its line or the file."""
+        for ids in (self._file_suppress,):
+            for key in (finding.rule, "ALL"):
+                if key in ids:
+                    finding.suppressed = True
+                    finding.justification = ids[key]
+                    return finding
+        entry = self._line_suppress.get(finding.line)
+        if entry:
+            ids = entry[0]
+            for key in (finding.rule, "ALL"):
+                if key in ids:
+                    finding.suppressed = True
+                    finding.justification = ids[key]
+                    return finding
+        return finding
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+@dataclasses.dataclass
+class Report:
+    findings: list
+    errors: list           # [(path, message)] — unparseable files
+    files: int = 0
+    duration_s: float = 0.0
+
+    @property
+    def unsuppressed(self):
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self):
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def ok(self):
+        return not self.unsuppressed and not self.errors
+
+    def to_json(self):
+        return {
+            "version": 1,
+            "tool": "jaxlint",
+            "findings": [f.to_json() for f in self.findings],
+            "errors": [{"path": p, "message": m} for p, m in self.errors],
+            "summary": {
+                "files": self.files,
+                "findings": len(self.unsuppressed),
+                "suppressed": len(self.suppressed),
+                "errors": len(self.errors),
+                "duration_s": round(self.duration_s, 3),
+            },
+        }
+
+
+def _select_rules(select=None, ignore=None):
+    rules = all_rules()
+    if select:
+        sel = {s.upper() for s in select}
+        rules = [r for r in rules if r.id in sel]
+    if ignore:
+        ign = {s.upper() for s in ignore}
+        rules = [r for r in rules if r.id not in ign]
+    return rules
+
+
+def lint_source(src, path="<string>", select=None, ignore=None):
+    """Lint one source string; returns a Report (never raises on bad
+    source — a syntax error becomes a Report error entry)."""
+    t0 = time.perf_counter()
+    findings, errors = [], []
+    try:
+        mod = Module(path, src)
+    except (SyntaxError, ValueError) as e:
+        return Report([], [(path, f"parse error: {e}")], files=1,
+                      duration_s=time.perf_counter() - t0)
+    for rule in _select_rules(select, ignore):
+        for f in rule.check(mod):
+            findings.append(mod.apply_suppressions(f))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(findings, errors, files=1,
+                  duration_s=time.perf_counter() - t0)
+
+
+def iter_python_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def lint_paths(paths, select=None, ignore=None, rel_to=None):
+    """Lint files/directories; returns one merged Report. `rel_to` makes
+    reported paths relative (stable CI output)."""
+    t0 = time.perf_counter()
+    findings, errors = [], []
+    files = 0
+    for path in iter_python_files(paths):
+        files += 1
+        display = os.path.relpath(path, rel_to) if rel_to else path
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            errors.append((display, f"read error: {e}"))
+            continue
+        rep = lint_source(src, path=display, select=select, ignore=ignore)
+        findings.extend(rep.findings)
+        errors.extend(rep.errors)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(findings, errors, files=files,
+                  duration_s=time.perf_counter() - t0)

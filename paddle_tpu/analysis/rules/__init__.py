@@ -1,0 +1,2 @@
+"""Rule modules self-register on import (see core.register)."""
+from . import caching, concurrency, donation, jit_hygiene  # noqa: F401

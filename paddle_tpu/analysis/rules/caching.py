@@ -1,0 +1,117 @@
+"""JL002: repr/str/f-string-derived cache keys in compiled-callable
+caches — the constant-baking bug class."""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule, parent, register
+
+# identifiers that mark a cache/key-building context
+_KEY_NAME = re.compile(r"key|cache|sig", re.IGNORECASE)
+# assignment targets use an exact form: plenty of host-side code builds
+# string registry keys (store paths, npz entry names) in variables named
+# `key` — only worth flagging where compiled callables exist at all
+_KEY_TARGET = re.compile(r"^(key|sig)$|_(key|sig)$", re.IGNORECASE)
+_APPENDERS = ("append", "add", "setdefault", "insert")
+
+
+def _name_hint(node):
+    """Best-effort identifier text for a receiver/target expression."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+_RAW_VALUE = (ast.Name, ast.Attribute, ast.Subscript)
+
+
+def _is_reprlike(node):
+    """repr(x)/str(x) of a plain name/attribute/subscript, or an
+    f-string interpolating one. str(np.dtype(x)) and friends are exempt
+    — a canonicalizing call is a deliberate key, a raw object repr is
+    not."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("repr", "str") and len(node.args) == 1:
+            return isinstance(node.args[0], _RAW_VALUE)
+    if isinstance(node, ast.JoinedStr):
+        return any(
+            isinstance(v, ast.FormattedValue)
+            and isinstance(v.value, _RAW_VALUE)
+            for v in node.values
+        )
+    return False
+
+
+def _key_context(node):
+    """Climb at most a few expression levels: is this repr-like value
+    (part of) a cache subscript key, an append onto a key accumulator, or
+    a tuple/list bound to a key-named variable? Returns a description or
+    None."""
+    cur = node
+    for _ in range(6):
+        p = parent(cur)
+        if p is None or isinstance(p, ast.stmt) and not isinstance(
+                p, (ast.Assign, ast.AnnAssign)):
+            return None
+        if isinstance(p, ast.Subscript) and p.slice is cur or (
+                isinstance(p, ast.Subscript)
+                and isinstance(p.slice, ast.Tuple) and cur in p.slice.elts):
+            if _KEY_NAME.search(_name_hint(p.value)):
+                return f"used as a key into '{_name_hint(p.value)}'"
+        if (isinstance(p, ast.Call) and isinstance(p.func, ast.Attribute)
+                and p.func.attr in _APPENDERS and cur in p.args
+                and _KEY_NAME.search(_name_hint(p.func.value))):
+            return (f"appended to key accumulator "
+                    f"'{_name_hint(p.func.value)}'")
+        if isinstance(p, (ast.Assign, ast.AnnAssign)):
+            targets = p.targets if isinstance(p, ast.Assign) else [p.target]
+            for t in targets:
+                if _KEY_TARGET.search(_name_hint(t)):
+                    return f"assigned into key variable '{_name_hint(t)}'"
+            return None
+        if not isinstance(p, (ast.Tuple, ast.List, ast.Subscript, ast.Call,
+                              ast.BinOp)):
+            return None
+        cur = p
+    return None
+
+
+@register
+class ReprKeyedCache(Rule):
+    """A repr()/str()/f-string of a raw value used as (part of) a cache
+    key. repr() truncates large arrays, so two different jax.Arrays can
+    collide on one key — and whatever was traced first gets silently
+    replayed (the value is BAKED into the compiled program as a
+    constant). Key arrays by (shape, dtype) and pass them as runtime
+    arguments instead."""
+
+    id = "JL002"
+    name = "repr-keyed-cache"
+    incident = ("PR 2 review -> PR 3 fix: to_static keyed raw jax.Array "
+                "kwargs by repr(), constant-baking the first call's "
+                "values into the compiled entry for every later "
+                "same-shape call")
+
+    def check(self, module):
+        # constant-baking needs compiled callables: modules that never
+        # import jax cannot cache a traced program, and their string keys
+        # (store paths, npz entry names) are fine
+        if not any(v == "jax" or v.startswith("jax.")
+                   for v in module.aliases.values()):
+            return
+        for node in module.nodes:
+            if not _is_reprlike(node):
+                continue
+            ctx = _key_context(node)
+            if ctx is None:
+                continue
+            yield self.finding(
+                module, node,
+                f"repr/str-derived value {ctx}: repr of an array "
+                "truncates (cache-key collision) and the traced value is "
+                "baked in as a constant — key arrays by (shape, dtype) "
+                "and feed them as runtime args",
+            )

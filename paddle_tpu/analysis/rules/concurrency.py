@@ -1,0 +1,307 @@
+"""Thread/async discipline: JL005 (lock "guarded-by" inference) and
+JL007 (blocking calls on the event loop)."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, ancestors, qn_matches, register
+
+_LOCK_TYPES = ("threading.Lock", "threading.RLock")
+_MUTATORS = ("append", "appendleft", "add", "insert", "extend", "remove",
+             "discard", "pop", "popleft", "popitem", "clear", "update",
+             "setdefault", "move_to_end", "rotate")
+_ITER_WRAPPERS = ("list", "tuple", "sorted", "set", "sum", "max", "min",
+                  "frozenset")
+
+
+def _self_attr(node):
+    """'attr' when node is `self.attr`, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _under_lock(node, lock_attr):
+    """True when `node` sits inside `with self.<lock_attr>:` (possibly
+    among other context managers)."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.With, ast.AsyncWith)):
+            for item in a.items:
+                if _self_attr(item.context_expr) == lock_attr:
+                    return True
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+    return False
+
+
+def _attr_writes(node):
+    """(attr, node) pairs for mutations of self.<attr> rooted at `node`:
+    assignment/augassign/del to the attr or through a subscript on it,
+    and mutating method calls."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        elif isinstance(n, ast.Delete):
+            targets = list(n.targets)
+        else:
+            targets = []
+        # work on a local stack: extending the node's own targets list
+        # would mutate the shared parsed tree (and duplicate findings on
+        # the next walk)
+        while targets:
+            t = targets.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+                continue
+            attr = _self_attr(t)
+            if attr is None and isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+            if attr is not None:
+                yield attr, t
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _MUTATORS):
+            attr = _self_attr(n.func.value)
+            if attr is not None:
+                yield attr, n
+
+
+def _attr_iterations(node):
+    """(attr, node) pairs where self.<attr> (or its .values()/.items()/
+    .keys() view) is iterated: for loops, comprehensions, list()/sorted()
+    and friends."""
+    def _iter_attr(expr):
+        attr = _self_attr(expr)
+        if attr is None and (isinstance(expr, ast.Call)
+                             and isinstance(expr.func, ast.Attribute)
+                             and expr.func.attr in ("values", "items",
+                                                    "keys")):
+            attr = _self_attr(expr.func.value)
+        return attr
+
+    for n in ast.walk(node):
+        iters = []
+        if isinstance(n, (ast.For, ast.AsyncFor)):
+            iters = [n.iter]
+        elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            iters = [g.iter for g in n.generators]
+        elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+              and n.func.id in _ITER_WRAPPERS and n.args):
+            iters = [n.args[0]]
+        for it in iters:
+            attr = _iter_attr(it)
+            if attr is not None:
+                yield attr, n
+
+
+@register
+class LockDiscipline(Rule):
+    """Attributes written under `with self._lock` form that lock's
+    guarded-by set; iterating or mutating them anywhere outside the lock
+    races the writers. Private helpers whose every intra-class call site
+    is under the lock inherit its protection."""
+
+    id = "JL005"
+    name = "lock-discipline"
+    incident = ("PR 6: /debug/trace iterated the tracer's shared event "
+                "deque while the engine thread appended — deque "
+                "iteration during concurrent append raises "
+                "RuntimeError mid-scrape")
+
+    def check(self, module):
+        for cls in module.nodes:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            yield from self._check_class(module, cls)
+
+    def _check_class(self, module, cls):
+        methods = [m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # lock attributes assigned anywhere in the class
+        locks = set()
+        for m in methods:
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                    if qn_matches(module.qualname(n.value.func),
+                                  *_LOCK_TYPES, "Lock", "RLock"):
+                        for t in n.targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                locks.add(attr)
+        if not locks:
+            return
+        for lock in sorted(locks):
+            yield from self._check_lock(module, cls, methods, lock)
+
+    def _check_lock(self, module, cls, methods, lock):
+        # guarded-by inference: attrs mutated under the lock (anywhere)
+        guarded = set()
+        for m in methods:
+            for attr, node in _attr_writes(m):
+                if attr != lock and _under_lock(node, lock):
+                    guarded.add(attr)
+        if not guarded:
+            return
+        # private helpers whose every intra-class call site is under the
+        # lock (directly, or inside another such helper) inherit it
+        call_sites = {m.name: [] for m in methods}
+        for m in methods:
+            for n in ast.walk(m):
+                if (isinstance(n, ast.Call)
+                        and _self_attr(n.func) in call_sites):
+                    call_sites[_self_attr(n.func)].append((m, n))
+        lock_held = set()
+        changed = True
+        while changed:
+            changed = False
+            for m in methods:
+                if m.name in lock_held or not m.name.startswith("_"):
+                    continue
+                sites = call_sites.get(m.name, [])
+                if sites and all(
+                        _under_lock(site, lock)
+                        or (caller.name in lock_held)
+                        for caller, site in sites):
+                    lock_held.add(m.name)
+                    changed = True
+        for m in methods:
+            if m.name == "__init__" or m.name in lock_held:
+                continue
+            hits = [(a, n, "mutates") for a, n in _attr_writes(m)]
+            hits += [(a, n, "iterates") for a, n in _attr_iterations(m)]
+            for attr, node, verb in hits:
+                if attr in guarded and not _under_lock(node, lock):
+                    yield self.finding(
+                        module, node,
+                        f"{cls.name}.{m.name} {verb} self.{attr} outside "
+                        f"'with self.{lock}' but self.{attr} is written "
+                        "under that lock elsewhere — concurrent "
+                        "iteration/mutation races the locked writers "
+                        "(deque iteration during append raises)",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# JL007 async hygiene
+
+_BLOCKING_QN = (
+    "time.sleep", "os.system", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "socket.create_connection", "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.request",
+)
+_TYPED_BLOCKING = {
+    # self-attr type (by constructor qualname) -> blocking methods
+    "queue.Queue": ("get", "put", "join"),
+    "queue.SimpleQueue": ("get", "put"),
+    "threading.Thread": ("join",),
+    "threading.Event": ("wait",),
+    "threading.Condition": ("wait", "wait_for"),
+    "threading.Lock": ("acquire",),
+    "threading.RLock": ("acquire",),
+    "threading.Semaphore": ("acquire",),
+}
+
+
+def _class_attr_types(module, cls):
+    """self.<attr> -> (constructor qualname, ctor-had-args), for attrs
+    assigned a known blocking type anywhere in the class. Matching is
+    EXACT on the alias-resolved qualname: asyncio.Queue/asyncio.Event are
+    loop-native and must not match queue.Queue/threading.Event."""
+    types = {}
+    for n in ast.walk(cls):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            qn = module.qualname(n.value.func)
+            if qn in _TYPED_BLOCKING:
+                for t in n.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        types[attr] = (qn, _queue_is_bounded(n.value))
+    return types
+
+
+def _queue_is_bounded(call):
+    """stdlib queue semantics: no maxsize, or a literal maxsize <= 0,
+    means unbounded (put never blocks)."""
+    arg = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            arg = kw.value
+    if arg is None:
+        return False
+    if (isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float))
+            and arg.value <= 0):
+        return False
+    return True
+
+
+def _own_statements(fn):
+    """Statements of `fn` excluding nested function bodies."""
+    stack = list(fn.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                stack.append(child)
+
+
+@register
+class AsyncHygiene(Rule):
+    """Blocking calls inside `async def` stall the entire event loop —
+    every connected client, not just this coroutine. Use the asyncio
+    equivalent or push the call through run_in_executor."""
+
+    id = "JL007"
+    name = "async-hygiene"
+    incident = ("serving/frontend.py + server.py host all streams on one "
+                "event loop; one synchronous sleep/join/get freezes "
+                "every SSE stream and health check at once")
+
+    def check(self, module):
+        # self-attr types per enclosing class
+        class_types = {}
+        for cls in module.nodes:
+            if isinstance(cls, ast.ClassDef):
+                class_types[cls] = _class_attr_types(module, cls)
+        for fn in module.nodes:
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            owner = next((a for a in ancestors(fn)
+                          if isinstance(a, ast.ClassDef)), None)
+            types = class_types.get(owner, {})
+            for n in _own_statements(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                qn = module.qualname(n.func)
+                if qn_matches(qn, *_BLOCKING_QN):
+                    yield self.finding(
+                        module, n,
+                        f"blocking call {qn} inside 'async def "
+                        f"{fn.name}' stalls the whole event loop — use "
+                        "the asyncio equivalent (asyncio.sleep, "
+                        "run_in_executor, streams)",
+                    )
+                    continue
+                if isinstance(n.func, ast.Attribute):
+                    attr = _self_attr(n.func.value)
+                    tname, bounded = types.get(attr, (None, False))
+                    if tname and n.func.attr in _TYPED_BLOCKING[tname]:
+                        if (n.func.attr == "put"
+                                and tname.startswith("queue.")
+                                and not bounded):
+                            continue  # unbounded queue: put never blocks
+                        # a timeout= bounds the stall but still freezes
+                        # the loop for its duration — flagged either way
+                        yield self.finding(
+                            module, n,
+                            f"self.{attr} is a {tname}; "
+                            f".{n.func.attr}() blocks the event loop "
+                            f"inside 'async def {fn.name}' — hand it to "
+                            "run_in_executor or use an asyncio "
+                            "primitive",
+                        )

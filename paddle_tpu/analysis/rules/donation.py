@@ -1,0 +1,133 @@
+"""Donation-safety rules: JL001 (aliasing at ownership boundaries) and
+JL004 (donation outside the backend gate)."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, ancestors, qn_matches, register
+
+_ASARRAY = ("jax.numpy.asarray", "jnp.asarray")
+_GATE = ("mesh_donate_argnums",)
+
+# method names that hand a caller-owned buffer to long-lived tensor state
+_OWNERSHIP_METHODS = ("set_value", "copy_")
+_OWNERSHIP_PREFIXES = ("set_", "from_")
+
+
+def _value_positions(node):
+    """Sub-expressions of an assignment RHS (or return value) that become
+    the stored value itself: the root, conditional branches, tuple/list
+    elements, and the receiver of astype/reshape-style chains. Arguments
+    of unrelated calls are NOT value positions — jnp.asarray on a fresh
+    index list passed INTO a jit is not an ownership transfer."""
+    out = []
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        if isinstance(n, ast.IfExp):
+            stack.extend((n.body, n.orelse))
+        elif isinstance(n, (ast.Tuple, ast.List)):
+            stack.extend(n.elts)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            # e.g. jnp.asarray(v).astype(dt) — the receiver is the value
+            stack.append(n.func.value)
+        elif isinstance(n, ast.NamedExpr):
+            stack.append(n.value)
+    return out
+
+
+def _is_self_attr_target(target):
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return any(_is_self_attr_target(t) for t in target.elts)
+    return (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self")
+
+
+def _enclosing_function(node):
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+@register
+class DonationAliasing(Rule):
+    """`jnp.asarray` of a caller-supplied buffer stored as owned tensor
+    state. On the CPU backend `asarray` of an aligned numpy array is
+    ZERO-COPY: when the stored array later flows into a `donate_argnums`
+    jit, XLA frees memory numpy still owns — nondeterministic heap
+    corruption. Use copying `jnp.array` at ownership boundaries."""
+
+    id = "JL001"
+    name = "donation-aliasing"
+    incident = ("PR 1: Tensor.set_value built state with jnp.asarray; "
+                "hapi's donating train step freed a numpy-owned buffer "
+                "after Model.load (heap corruption, nondeterministic "
+                "whole-suite crashes)")
+
+    def check(self, module):
+        for node in module.nodes:
+            roots = []
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if node.value is not None and any(
+                        _is_self_attr_target(t) for t in targets):
+                    roots.append(node.value)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                fn = _enclosing_function(node)
+                if fn is not None and (
+                        fn.name in _OWNERSHIP_METHODS
+                        or fn.name.startswith(_OWNERSHIP_PREFIXES)):
+                    roots.append(node.value)
+            for root in roots:
+                for expr in _value_positions(root):
+                    if (isinstance(expr, ast.Call)
+                            and qn_matches(module.qualname(expr.func),
+                                           *_ASARRAY)):
+                        yield self.finding(
+                            module, expr,
+                            "jnp.asarray result stored as owned tensor "
+                            "state can zero-copy-alias a caller's numpy "
+                            "buffer; a later donate_argnums jit would free "
+                            "memory it does not own — use copying "
+                            "jnp.array here",
+                        )
+
+
+@register
+class UngatedDonation(Rule):
+    """`donate_argnums=`/`donate_argnames=` passed directly instead of
+    through `parallel.spmd.mesh_donate_argnums`. The XLA-CPU
+    host-platform mesh miscompiles donation of sharded buffers (silent
+    loss drift, then a segfault); the gate turns donation off exactly
+    there and keeps it on real accelerators. Single-device jits may
+    suppress with a justification."""
+
+    id = "JL004"
+    name = "ungated-donation"
+    incident = ("PR 3: donate_argnums on the fake-device CPU mesh "
+                "(xla_force_host_platform_device_count) aliased outputs "
+                "to freed inputs — losses drifted from step 2, segfault "
+                "by step 4 (test_distributed_spmd zs=2)")
+
+    def check(self, module):
+        for node in module.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg not in ("donate_argnums", "donate_argnames"):
+                    continue
+                v = kw.value
+                if (isinstance(v, ast.Call)
+                        and qn_matches(module.qualname(v.func), *_GATE)):
+                    continue
+                yield self.finding(
+                    module, v,
+                    f"{kw.arg} passed directly — route it through "
+                    "spmd.mesh_donate_argnums so the host-platform-mesh "
+                    "donation miscompile cannot reach a sharded jit (or "
+                    "suppress with the reason this jit is single-device)",
+                )

@@ -1,0 +1,439 @@
+"""Compiled-program hygiene: JL003 (host callbacks / device->host syncs
+reachable from jitted functions) and JL006 (retrace hazards).
+
+Both rules share a view of which functions are "jit roots": decorated
+with `jax.jit`/`to_static`/`jax.pmap`, or passed (by name, lambda, or a
+conditional expression over names) to `jax.jit` / `pl.pallas_call`.
+JL003 then walks the module-local call graph from those roots.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, ancestors, parent, qn_matches, register
+
+# bare `jit`/`pmap` are deliberately absent: a suffix match on them
+# would claim any method named .jit (aliased imports still resolve to
+# the dotted forms below)
+_JIT_WRAPPERS = ("jax.jit", "pjit", "jax.pmap", "to_static",
+                 "pallas_call")
+
+# direct device->host syncs / side effects that must not be traced into a
+# compiled program (jax.pure_callback/io_callback are the sanctioned
+# escape hatches and are not flagged here — their cost is the runtime
+# warning's job, see utils/custom_op.py). Matched EXACTLY on the
+# alias-resolved qualname: jax.numpy.asarray is a device op and must not
+# match numpy.asarray.
+_HOST_CALL_QN = frozenset((
+    "numpy.asarray", "numpy.array",
+    "jax.device_get", "time.time", "time.sleep", "time.monotonic",
+    "time.perf_counter", "time.process_time",
+))
+_HOST_ATTR_CALLS = ("item", "numpy", "tolist")
+_SYNCING_BUILTINS = ("float", "int")
+
+
+def _decorator_is_jit(dec, module):
+    if isinstance(dec, ast.Call):
+        qn = module.qualname(dec.func)
+        if qn_matches(qn, "functools.partial", "partial") and dec.args:
+            return qn_matches(module.qualname(dec.args[0]), *_JIT_WRAPPERS)
+        return qn_matches(qn, *_JIT_WRAPPERS)
+    return qn_matches(module.qualname(dec), *_JIT_WRAPPERS)
+
+
+def _fn_arg_targets(node):
+    """Names / lambdas a jit-wrapper call compiles: its first positional
+    argument, looking through conditional expressions (the engine picks
+    `verify if kind == "verify" else step` at jit time)."""
+    if not node.args:
+        return []
+    out, stack = [], [node.args[0]]
+    while stack:
+        a = stack.pop()
+        if isinstance(a, ast.IfExp):
+            stack.extend((a.body, a.orelse))
+        elif isinstance(a, (ast.Name, ast.Lambda)):
+            out.append(a)
+    return out
+
+
+def _is_method(node):
+    """Class-body methods are never the referent of a bare name — a
+    `jax.jit(step)` call site cannot mean `SomeClass.step`."""
+    return isinstance(
+        getattr(node, "_jaxlint_parent", None), ast.ClassDef)
+
+
+def _module_index(module):
+    idx = getattr(module, "_jaxlint_jit_index", None)
+    if idx is None:
+        idx = module._jaxlint_jit_index = _ModuleIndex(module)
+    return idx
+
+
+class _ModuleIndex:
+    """Function defs by name + the set of jit-root functions/lambdas.
+    Built once per module and shared by JL003/JL006."""
+
+    def __init__(self, module):
+        self.module = module
+        self.defs = {}
+        for node in module.nodes:
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and not _is_method(node)):
+                self.defs.setdefault(node.name, []).append(node)
+        self.roots = []          # (fn_node, how) — FunctionDef or Lambda
+        self.jit_calls = []      # ast.Call nodes of jit wrappers
+        for node in module.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _decorator_is_jit(dec, module):
+                        self.roots.append((node, "decorated"))
+                        break
+            elif isinstance(node, ast.Call) and qn_matches(
+                    module.qualname(node.func), *_JIT_WRAPPERS):
+                self.jit_calls.append(node)
+                for tgt in _fn_arg_targets(node):
+                    if isinstance(tgt, ast.Lambda):
+                        self.roots.append((tgt, "wrapped"))
+                    else:
+                        for d in self.defs.get(tgt.id, ()):
+                            self.roots.append((d, "wrapped"))
+
+    def reachable(self):
+        """Function/lambda nodes reachable from the jit roots through
+        module-local calls-by-name (bounded BFS)."""
+        seen, queue = [], [fn for fn, _ in self.roots]
+        ids = set()
+        while queue:
+            fn = queue.pop()
+            if id(fn) in ids:
+                continue
+            ids.add(id(fn))
+            seen.append(fn)
+            for call in self._body_calls(fn):
+                if isinstance(call.func, ast.Name):
+                    for d in self.defs.get(call.func.id, ()):
+                        if id(d) not in ids and len(ids) < 512:
+                            queue.append(d)
+        return seen
+
+    @staticmethod
+    def _own_body(fn):
+        """Nodes of `fn`'s body excluding nested function/lambda bodies
+        (those are separate graph nodes, reached only if called)."""
+        body = fn.body if isinstance(body := fn.body, list) else [body]
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            yield n
+            for child in ast.iter_child_nodes(n):
+                if not isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+                    stack.append(child)
+
+    @classmethod
+    def _body_calls(cls, fn):
+        for n in cls._own_body(fn):
+            if isinstance(n, ast.Call):
+                yield n
+
+
+@register
+class HostCallbackInJit(Rule):
+    """Host work traced into a compiled program: every execution then
+    pays a device->host round trip (or replays a trace-time side effect
+    exactly once, at trace time — not per step)."""
+
+    id = "JL003"
+    name = "host-callback-in-jit"
+    incident = ("PR 5: host-callback custom ops traced into jit/static "
+                "programs serialized a device->host round trip against "
+                "every compiled step; only a runtime warning existed "
+                "(utils/custom_op.py) until this rule")
+
+    def check(self, module):
+        index = _module_index(module)
+        reported = set()
+        for fn in index.reachable():
+            for n in index._own_body(fn):
+                if not isinstance(n, ast.Call) or id(n) in reported:
+                    continue
+                msg = None
+                qn = module.qualname(n.func)
+                if (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _HOST_ATTR_CALLS
+                        and not n.args):
+                    msg = (f".{n.func.attr}() forces a device->host sync")
+                elif qn in _HOST_CALL_QN:
+                    msg = (f"{qn} is host-side work")
+                elif qn == "print":
+                    msg = ("print() is a trace-time side effect (runs "
+                           "once at trace, never per step) — use "
+                           "jax.debug.print")
+                elif (qn in _SYNCING_BUILTINS and n.args
+                      and not isinstance(n.args[0], ast.Constant)):
+                    msg = (f"{qn}() on a traced value forces a "
+                           "device->host sync")
+                if msg is None:
+                    continue
+                reported.add(id(n))
+                yield self.finding(
+                    module, n,
+                    f"reachable from a jitted function: {msg}; every "
+                    "execution of the compiled program pays for it — "
+                    "keep host work outside jit or use the sanctioned "
+                    "callback APIs",
+                )
+
+
+def _enclosing_function(node):
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+def _loop_ancestor(node):
+    """Nearest For/While between `node` and its enclosing function (or
+    module) — a jit created there is a fresh compiled callable per
+    iteration."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+            return a
+    return None
+
+
+def _climb_value_context(node):
+    """Follow `node` upward through value positions (tuple/list elements,
+    call arguments, conditional branches) to the statement consuming it.
+    Returns ("assign", stmt) / ("return", stmt) / ("call", call) /
+    (None, None). "call" means the jit call ITSELF is invoked in place
+    (`jax.jit(f)(x)`); a jit result passed as an argument to another
+    function (`jax.export.export(jax.jit(fn))(...)`, wrapper classes) is
+    that function's business and not flagged."""
+    cur = node
+    for hop in range(8):
+        p = parent(cur)
+        if p is None:
+            return None, None
+        if isinstance(p, ast.Call) and cur is p.func:
+            return ("call", p) if hop == 0 else (None, None)
+        if isinstance(p, (ast.Tuple, ast.List, ast.IfExp, ast.Call,
+                          ast.Starred, ast.keyword)):
+            cur = p
+            continue
+        if isinstance(p, ast.Assign):
+            return "assign", p
+        if isinstance(p, (ast.Return, ast.Yield, ast.YieldFrom)):
+            return "return", p
+        return None, None
+    return None, None
+
+
+def _is_cached_target(t):
+    """A store that outlives the call: subscript (cache dict) or
+    attribute (self./module state)."""
+    if isinstance(t, (ast.Tuple, ast.List)):
+        return any(_is_cached_target(e) for e in t.elts)
+    return isinstance(t, (ast.Subscript, ast.Attribute, ast.Starred))
+
+
+def _names_escaping(node, aliases):
+    """Alias names that ESCAPE through `node`: referenced anywhere except
+    as the function being called. `return jf` escapes the callable (the
+    caller owns the cache now); `return jf(x)` only escapes the result —
+    the callable dies with this frame."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in aliases:
+            p = parent(n)
+            if isinstance(p, ast.Call) and p.func is n:
+                continue
+            out.add(n.id)
+    return out
+
+
+def _alias_fate(fn, names):
+    """Follow simple local aliases of `names` inside `fn`; returns
+    (stored, called) — whether any alias escapes into attribute/subscript
+    state, a return, a global/nonlocal, or is only invoked locally."""
+    aliases = set(names)
+    for _ in range(3):  # small fixpoint for name-to-name chains
+        grew = False
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Assign) and isinstance(n.value, ast.Name)
+                    and n.value.id in aliases):
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id not in aliases:
+                        aliases.add(t.id)
+                        grew = True
+        if not grew:
+            break
+    stored = called = False
+    # a nested def capturing an alias gives the jitted callable closure
+    # lifetime (the standard build-and-return-step pattern) — that is a
+    # cache, not a per-call rebuild
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn:
+            if any(isinstance(x, ast.Name) and x.id in aliases
+                   for x in ast.walk(n)):
+                stored = True
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            if _names_escaping(n.value, aliases) and any(
+                    _is_cached_target(t) for t in n.targets):
+                stored = True
+        elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+            if n.value is not None and _names_escaping(n.value, aliases):
+                stored = True
+        elif isinstance(n, (ast.Global, ast.Nonlocal)):
+            if set(n.names) & aliases:
+                stored = True
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+            if n.func.id in aliases:
+                called = True
+    return stored, called
+
+
+def _static_positions(call):
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return kw.arg, [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant):
+                        out.append(e.value)
+                return kw.arg, out
+            return kw.arg, []
+    return None, []
+
+
+_ARRAY_BUILDERS = ("jax.numpy.array", "jax.numpy.asarray", "numpy.array",
+                   "numpy.asarray", "jax.numpy.zeros", "jax.numpy.ones",
+                   "jax.numpy.arange")
+
+
+@register
+class RetraceHazard(Rule):
+    """`jax.jit` wrapped where a fresh compiled callable is built per
+    call/iteration (silent recompilation on every step), or static
+    arguments that can never hit the jit cache."""
+
+    id = "JL006"
+    name = "retrace-hazard"
+    incident = ("PR 7's recompile sentinel catches these at runtime "
+                "(Model.jit_retraces / the engine's phantom-trace "
+                "warning); this rule catches them before they run")
+
+    def check(self, module):
+        index = _module_index(module)
+        handled = set()
+        # decorated defs nested inside functions/loops
+        for fn, how in index.roots:
+            if how != "decorated" or id(fn) in handled:
+                continue
+            handled.add(id(fn))
+            loop = _loop_ancestor(fn)
+            if loop is not None:
+                yield self.finding(
+                    module, fn,
+                    f"function '{fn.name}' is jit-decorated inside a "
+                    "loop — each iteration builds a fresh compiled "
+                    "callable (full retrace per pass); hoist the jit out "
+                    "of the loop",
+                )
+                continue
+            outer = _enclosing_function(fn)
+            if outer is not None:
+                stored, called = _alias_fate(outer, {fn.name})
+                if called and not stored:
+                    yield self.finding(
+                        module, fn,
+                        f"jit-decorated '{fn.name}' is rebuilt and "
+                        f"called on every invocation of "
+                        f"'{outer.name}' without being cached — each "
+                        "call retraces and recompiles",
+                    )
+        for call in index.jit_calls:
+            # pallas_call-and-invoke is the normal kernel idiom (it runs
+            # inside an outer traced program); only jit-like wrappers
+            # carry the per-call recompile hazard
+            if qn_matches(module.qualname(call.func), "pallas_call"):
+                continue
+            loop = _loop_ancestor(call)
+            if loop is not None:
+                yield self.finding(
+                    module, call,
+                    "jax.jit called inside a loop — a fresh compiled "
+                    "callable (and a full retrace) per iteration; build "
+                    "it once outside",
+                )
+                continue
+            ctx, node = _climb_value_context(call)
+            if ctx == "call":
+                yield self.finding(
+                    module, call,
+                    "jit-wrap-and-call in one expression: the wrapper "
+                    "(and its trace cache) is discarded after this call, "
+                    "so every execution recompiles — cache the jitted "
+                    "callable",
+                )
+                continue
+            outer = _enclosing_function(call)
+            names = set()
+            if ctx == "assign":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                if not names and any(_is_cached_target(t)
+                                     for t in node.targets):
+                    pass  # stored straight into cache state
+                elif names and outer is not None:
+                    stored, called = _alias_fate(outer, names)
+                    if called and not stored:
+                        yield self.finding(
+                            module, call,
+                            "jitted callable bound to a local, called, "
+                            "and dropped — it is rebuilt (and retraced) "
+                            "on every call of "
+                            f"'{outer.name}'; cache it on self or at "
+                            "module scope",
+                        )
+                        continue
+            # unhashable / array-valued static args at local call sites
+            kw_name, positions = _static_positions(call)
+            if kw_name == "static_argnums" and positions and names and outer:
+                for site in ast.walk(outer):
+                    if (isinstance(site, ast.Call)
+                            and isinstance(site.func, ast.Name)
+                            and site.func.id in names):
+                        for pos in positions:
+                            if not isinstance(pos, int):
+                                continue
+                            if pos >= len(site.args):
+                                continue
+                            a = site.args[pos]
+                            bad = None
+                            if isinstance(a, (ast.List, ast.Dict, ast.Set)):
+                                bad = "an unhashable literal"
+                            elif isinstance(a, ast.Call) and qn_matches(
+                                    module.qualname(a.func),
+                                    *_ARRAY_BUILDERS):
+                                bad = "an array"
+                            if bad:
+                                yield self.finding(
+                                    module, a,
+                                    f"static_argnums position {pos} "
+                                    f"receives {bad} — static args must "
+                                    "be hashable constants (arrays as "
+                                    "static args retrace per call or "
+                                    "raise); pass it as a traced arg or "
+                                    "convert to a tuple",
+                                )

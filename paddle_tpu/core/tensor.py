@@ -182,7 +182,10 @@ class Tensor:
         if value is None:
             self._grad = None
         else:
-            self._grad = value._array if isinstance(value, Tensor) else jnp.asarray(value)
+            # jnp.array (not asarray): same ownership boundary as
+            # set_value — a zero-copied numpy buffer stored as grad state
+            # would be freed by a donating optimizer step (JL001)
+            self._grad = value._array if isinstance(value, Tensor) else jnp.array(value)
 
     def _accumulate_grad(self, ct):
         ct = ct.astype(self._array.dtype) if ct.dtype != self._array.dtype else ct

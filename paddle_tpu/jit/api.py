@@ -103,6 +103,7 @@ class StaticFunction:
             if isinstance(a, Tensor):
                 key.append((tuple(a.shape), str(np.dtype(a.dtype))))
             else:
+                # jaxlint: disable=JL002 -- non-Tensor positional args are hashable Python scalars/tuples by contract; Tensor/ndarray args take the (shape, dtype) branch above
                 key.append(repr(a))
         # kwargs are baked into the compiled entry at trace time, so they
         # MUST be part of the cache key — a changed kwarg is a new program.
@@ -128,6 +129,7 @@ class StaticFunction:
                         "compiled program as constants. Pass each Tensor as "
                         "its own keyword or positional argument."
                     )
+                # jaxlint: disable=JL002 -- only plain Python values reach here: Tensor/ndarray kwargs took the (shape, dtype) branch, Tensor-in-container kwargs raised above
                 key.append((k, repr(v)))
         layer = self._layer
         if isinstance(layer, Layer):

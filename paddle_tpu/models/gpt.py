@@ -310,6 +310,7 @@ class GPT(nn.Layer):
             def sample(logits_last, key):
                 lg = logits_last.astype(jnp.float32) / max(temperature, 1e-6)
                 if top_k is not None:
+                    # jaxlint: disable=JL003 -- top_k is a static Python int from the cache sig (closure constant), evaluated once at trace time, never a traced value
                     kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
                     lg = jnp.where(lg < kth, -jnp.inf, lg)
                 if temperature == 0.0:
@@ -331,7 +332,9 @@ class GPT(nn.Layer):
                 return sample(logits[:, -1], key), caches
 
             self._decode_fns[sig] = (
+                # jaxlint: disable=JL004 -- single-device decode jit donating its own KV caches (unsharded); gating would copy the cache per step on CPU
                 jax.jit(prefill, donate_argnums=(3,)),
+                # jaxlint: disable=JL004 -- same: unsharded cache donation, not the mesh miscompile class
                 jax.jit(step, donate_argnums=(3,)),
             )
         prefill, step = self._decode_fns[sig]

@@ -311,6 +311,7 @@ class Lars(Optimizer):
                 new_s["master_weight"] = new_p.astype(jnp.float32)
             return new_p.astype(param.dtype), new_s
 
+        # jaxlint: disable=JL004 -- LARS eager update jit: single device, unsharded buffers (same contract as Optimizer._jitted_update)
         jf = jax.jit(f, donate_argnums=(0, 3))
         self._jit_cache[bool(apply_wd)] = jf
         return jf

@@ -54,6 +54,7 @@ def _zero_shard_spec(shape, mesh: Mesh):
         return None
     deg = mesh.shape["sharding"]
     dim = _largest_divisible_dim(tuple(shape), deg)
+    # jaxlint: disable=JL003 -- shape is static metadata (a concrete tuple) even when called from inside a traced step; this runs once at trace time
     if dim is None or int(np.prod(shape)) < deg * 128:
         return None
     spec = [None] * len(shape)

@@ -55,7 +55,7 @@ def learn_rounding(w, scales, apply_fn, calib_inputs, targets, w_qmax,
         return mse + lam * round_reg
 
     @jax.jit
-    def step(alpha, m, v, t, x, y, beta):
+    def step(alpha, m, v, t, x, y, beta):  # jaxlint: disable=JL006 -- one compile per learn_rounding call (per layer, shapes differ anyway), amortized over the iters loop below
         g = jax.grad(loss_fn)(alpha, x, y, beta)
         m = 0.9 * m + 0.1 * g
         v = 0.999 * v + 0.001 * g * g

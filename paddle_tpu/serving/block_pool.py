@@ -325,6 +325,7 @@ class BlockPool:
                 return (k.at[:, :, d].set(k[:, :, s]),
                         v.at[:, :, d].set(v[:, :, s]))
 
+            # jaxlint: disable=JL004 -- COW scatter donates the single-device KV arenas in place; gating would materialize a full arena copy per COW on CPU (see docstring)
             self._copy_fn = jax.jit(_copy, donate_argnums=(0, 1))
         self.k, self.v = self._copy_fn(
             self.k, self.v, jnp.asarray(src, jnp.int32),

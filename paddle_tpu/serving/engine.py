@@ -355,6 +355,7 @@ class LLMEngine:
             return accept, out_tok, state.k, state.v
 
         fn = jax.jit(verify if kind == "verify" else step,
+                     # jaxlint: disable=JL004 -- serving step donates the single-device KV arenas (unsharded); gating would copy the whole arena every step on CPU
                      donate_argnums=(2, 3))
         self._step_fns[(B, S, kind)] = fn
         return fn

@@ -181,7 +181,11 @@ class AsyncLLMEngine:
                 await self._stopped.wait()
         else:
             await self._stopped.wait()
-        self._thread.join(timeout=5.0)
+        # Thread.join blocks; _stopped was set by the engine thread's last
+        # act, so this is near-instant — but a hung thread must stall an
+        # executor worker, never the event loop (JL007)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._thread.join, 5.0)
 
     # -- request API (event-loop thread) -----------------------------------
 

@@ -89,7 +89,10 @@ def _warn_if_traced(name, x):
         "trip (sync + host copy + C call) at this call site — it will "
         "not fuse with surrounding device ops. Keep it outside hot "
         "compiled loops, or port the kernel to Pallas (ops/pallas/) to "
-        "run it on-device.",
+        "run it on-device. This is the JL003 host-callback-in-jit class: "
+        "the static analyzer flags the same pattern at build time (see "
+        "README 'Static analysis' or `python -m paddle_tpu.analysis "
+        "--list-rules`).",
         stacklevel=4,
     )
 

@@ -1,0 +1,559 @@
+"""jaxlint rule fixtures: >=2 violating + >=1 clean snippet per rule,
+suppression-comment handling, the JSON schema canary, and a self-check
+that the analyzer parses the whole paddle_tpu tree without crashing."""
+import json
+import os
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import all_rules, lint_paths, lint_source
+
+PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu")
+
+
+def run(src, select=None):
+    rep = lint_source(textwrap.dedent(src), path="fixture.py", select=select)
+    assert not rep.errors, rep.errors
+    return rep
+
+
+def rule_ids(rep):
+    return [f.rule for f in rep.unsuppressed]
+
+
+def test_registry_has_all_seven_rules():
+    assert [r.id for r in all_rules()] == [
+        "JL001", "JL002", "JL003", "JL004", "JL005", "JL006", "JL007"]
+    for r in all_rules():
+        assert r.incident, f"{r.id} must name its historical incident"
+
+
+# ---------------------------------------------------------------------------
+# JL001 donation-aliasing
+
+
+def test_jl001_flags_asarray_into_self_state():
+    rep = run("""
+        import jax.numpy as jnp
+        class Tensor:
+            def set_value(self, value):
+                self._array = jnp.asarray(value)
+    """)
+    assert rule_ids(rep) == ["JL001"]
+
+
+def test_jl001_flags_conditional_branch_and_set_method_return():
+    rep = run("""
+        import jax.numpy as jnp
+        class Tensor:
+            def __init__(self, value):
+                self._array = value._array if hasattr(value, "_array") else jnp.asarray(value)
+            def set_weights(self, w):
+                return jnp.asarray(w)
+    """)
+    assert rule_ids(rep) == ["JL001", "JL001"]
+
+
+def test_jl001_clean_copying_array_and_argument_position():
+    # copying jnp.array is the fix; jnp.asarray of a fresh index list
+    # passed INTO a call is not an ownership transfer
+    rep = run("""
+        import numpy as np
+        import jax.numpy as jnp
+        class Tensor:
+            def set_value(self, value):
+                self._array = jnp.array(np.asarray(value))
+            def copy_blocks(self, src, dst):
+                self.k, self.v = self._copy_fn(
+                    self.k, self.v, jnp.asarray(src, jnp.int32),
+                    jnp.asarray(dst, jnp.int32))
+    """)
+    assert rule_ids(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# JL002 repr-keyed-cache
+
+
+def test_jl002_flags_repr_append_to_key_accumulator():
+    rep = run("""
+        import jax
+        def make_key(args):
+            key = []
+            for a in args:
+                key.append(repr(a))
+            return tuple(key)
+    """)
+    assert rule_ids(rep) == ["JL002"]
+
+
+def test_jl002_flags_fstring_cache_subscript():
+    rep = run("""
+        import jax
+        class StaticFn:
+            def __call__(self, x):
+                self._cache[f"{x}"] = jax.jit(lambda v: v)
+    """)
+    assert rule_ids(rep) == ["JL002"]
+
+
+def test_jl002_clean_shape_dtype_keys_and_jaxless_modules():
+    # canonicalizing calls (str(np.dtype(...))) are deliberate keys
+    rep = run("""
+        import jax
+        import numpy as np
+        def make_key(tensors):
+            key = []
+            for t in tensors:
+                key.append((tuple(t.shape), str(np.dtype(t.dtype))))
+            return tuple(key)
+    """)
+    assert rule_ids(rep) == []
+    # without jax there is nothing to constant-bake: string registry keys
+    # in host-side modules are fine
+    rep = run("""
+        def endpoint(job_id, r):
+            key = f"elastic/{job_id}/endpoint/{r}"
+            return key
+    """)
+    assert rule_ids(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# JL003 host-callback-in-jit
+
+
+def test_jl003_flags_item_in_decorated_jit():
+    rep = run("""
+        import jax
+        @jax.jit
+        def step(x):
+            s = x.sum().item()
+            return x * s
+    """)
+    assert rule_ids(rep) == ["JL003"]
+
+
+def test_jl003_flags_transitive_host_call_through_helper():
+    rep = run("""
+        import jax
+        import time
+        def helper(x):
+            t = time.time()
+            return x + t
+        def step(x):
+            return helper(x) * 2
+        compiled = jax.jit(step)
+    """)
+    assert rule_ids(rep) == ["JL003"]
+
+
+def test_jl003_flags_print_and_float_sync():
+    rep = run("""
+        import jax
+        @jax.jit
+        def step(x):
+            print(x)
+            return x * float(x[0])
+    """)
+    assert sorted(rule_ids(rep)) == ["JL003", "JL003"]
+
+
+def test_jl003_clean_outside_jit_and_device_ops_inside():
+    rep = run("""
+        import jax
+        import jax.numpy as jnp
+        import time
+        @jax.jit
+        def step(x):
+            return jnp.asarray(x) * 2   # device op, not numpy.asarray
+        def host_loop(x):
+            t = time.time()             # not reachable from any jit
+            print(t)
+            return float(x)
+    """)
+    assert rule_ids(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# JL004 ungated-donation
+
+
+def test_jl004_flags_direct_donate_argnums_and_argnames():
+    rep = run("""
+        import jax
+        def build(f):
+            a = jax.jit(f, donate_argnums=(0, 1))
+            b = jax.jit(f, donate_argnames=("params",))
+            return a, b
+    """)
+    assert rule_ids(rep) == ["JL004", "JL004"]
+
+
+def test_jl004_clean_through_gate():
+    rep = run("""
+        import jax
+        from paddle_tpu.parallel.spmd import mesh_donate_argnums
+        def build(f):
+            return jax.jit(f, donate_argnums=mesh_donate_argnums((0, 2)))
+    """)
+    assert rule_ids(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# JL005 lock-discipline
+
+
+_LOCKED_CLASS = """
+    import threading
+    class Ring:
+        def __init__(self):
+            self.events = []
+            self.dropped = 0
+            self._lock = threading.Lock()
+        def push(self, ev):
+            with self._lock:
+                self.events.append(ev)
+                self.dropped += 1
+"""
+
+
+def test_jl005_flags_iteration_outside_lock():
+    rep = run(_LOCKED_CLASS + """
+        def export(self):
+            return list(self.events)
+    """)
+    assert rule_ids(rep) == ["JL005"]
+
+
+def test_jl005_flags_mutation_outside_lock():
+    rep = run(_LOCKED_CLASS + """
+        def clear(self):
+            self.events.clear()
+    """)
+    assert rule_ids(rep) == ["JL005"]
+
+
+def test_jl005_clean_under_lock_and_lock_held_helpers():
+    # private helpers called only from under the lock inherit it
+    rep = run(_LOCKED_CLASS + """
+        def export(self):
+            with self._lock:
+                return list(self.events)
+        def drain(self):
+            with self._lock:
+                self._evict()
+        def _evict(self):
+            while self.events:
+                self.events.pop()
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_jl005_public_method_does_not_inherit_lock():
+    # a PUBLIC method reachable from outside must take the lock itself,
+    # even if some internal caller holds it
+    rep = run(_LOCKED_CLASS + """
+        def drain(self):
+            with self._lock:
+                self.evict()
+        def evict(self):
+            self.events.pop()
+    """)
+    assert rule_ids(rep) == ["JL005"]
+
+
+# ---------------------------------------------------------------------------
+# JL006 retrace-hazard
+
+
+def test_jl006_flags_jit_in_loop_and_immediate_call():
+    rep = run("""
+        import jax
+        def sweep(fs, x):
+            outs = []
+            for f in fs:
+                outs.append(jax.jit(f)(x))
+            return outs, jax.jit(fs[0])(x)
+    """)
+    assert rule_ids(rep) == ["JL006", "JL006"]
+
+
+def test_jl006_flags_uncached_per_call_rebuild():
+    rep = run("""
+        import jax
+        class Runner:
+            def run(self, x):
+                def step(v):
+                    return v * 2
+                jstep = jax.jit(step)
+                return jstep(x)
+    """)
+    assert rule_ids(rep) == ["JL006"]
+
+
+def test_jl006_flags_unhashable_static_arg():
+    rep = run("""
+        import jax
+        def build(f, x):
+            g = jax.jit(f, static_argnums=(0,))
+            return g([1, 2, 3], x)
+    """)
+    assert rule_ids(rep) == ["JL006"]
+
+
+def test_jl006_clean_cached_returned_export_and_pallas():
+    rep = run("""
+        import jax
+        from jax.experimental import pallas as pl
+        class Engine:
+            def _get_fn(self, f, sig):
+                fn = jax.jit(f)
+                self._cache[sig] = fn
+                return fn
+        def build(f):
+            return jax.jit(f)
+        def export_artifact(f, avals):
+            return jax.export.export(jax.jit(f))(*avals)
+        def kernel_call(kern, x, shape):
+            return pl.pallas_call(kern, out_shape=shape)(x)
+        def make_step(f):
+            jf = jax.jit(f)
+            def step(x):
+                return jf(x)      # closure capture IS the cache
+            return step
+    """)
+    assert rule_ids(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# JL007 async-hygiene
+
+
+def test_jl007_flags_time_sleep_in_async_def():
+    rep = run("""
+        import time
+        async def handler(req):
+            time.sleep(0.1)
+            return req
+    """)
+    assert rule_ids(rep) == ["JL007"]
+
+
+def test_jl007_flags_typed_blocking_attrs():
+    rep = run("""
+        import queue
+        import threading
+        class Frontend:
+            def __init__(self):
+                self._cmds = queue.Queue(8)
+                self._thread = threading.Thread(target=self._loop)
+            async def shutdown(self):
+                self._cmds.get()
+                self._thread.join(timeout=5.0)
+    """)
+    assert rule_ids(rep) == ["JL007", "JL007"]
+
+
+def test_jl007_clean_asyncio_types_unbounded_put_and_sync_defs():
+    rep = run("""
+        import asyncio
+        import queue
+        import time
+        class Frontend:
+            def __init__(self):
+                self._cmds = queue.Queue()      # unbounded: put never blocks
+                self.queue = asyncio.Queue(8)   # loop-native
+                self.wake = asyncio.Event()
+            async def stream(self):
+                self._cmds.put("cmd")
+                item = await self.queue.get()
+                await self.wake.wait()
+                await asyncio.sleep(0.1)
+                return item
+            def engine_loop(self):
+                time.sleep(0.1)                 # worker thread: fine
+                return self._cmds.get(timeout=1.0)
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_jl005_tuple_unpacking_write_reports_each_attr_exactly_once():
+    # regression: _attr_writes must expand tuple targets on a local
+    # stack — extending the AST node's own list duplicated findings on
+    # the next walk (guarded-by inference walks before the hits pass)
+    rep = run("""
+        import threading
+        class Pair:
+            def __init__(self):
+                self.a = 0
+                self.b = 0
+                self._lock = threading.Lock()
+            def set_locked(self, x, y):
+                with self._lock:
+                    self.a, self.b = x, y
+            def set_racy(self, x, y):
+                self.a, self.b = x, y
+    """)
+    assert rule_ids(rep) == ["JL005", "JL005"]
+
+
+def test_jl007_literal_zero_maxsize_is_unbounded():
+    rep = run("""
+        import queue
+        class F:
+            def __init__(self):
+                self.q = queue.Queue(maxsize=0)   # stdlib: unbounded
+            async def push(self, x):
+                self.q.put(x)
+    """)
+    assert rule_ids(rep) == []
+    rep = run("""
+        import queue
+        class F:
+            def __init__(self):
+                self.q = queue.Queue(8)
+            async def push(self, x):
+                self.q.put(x)
+    """)
+    assert rule_ids(rep) == ["JL007"]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+
+_VIOLATION = """
+    import jax.numpy as jnp
+    class T:
+        def set_value(self, v):
+            self._a = jnp.asarray(v){trailing}
+"""
+
+
+def test_suppression_trailing_comment():
+    rep = run(_VIOLATION.format(
+        trailing="  # jaxlint: disable=JL001 -- caller guarantees a copy"))
+    assert rule_ids(rep) == []
+    assert [f.rule for f in rep.suppressed] == ["JL001"]
+    assert rep.suppressed[0].justification == "caller guarantees a copy"
+
+
+def test_suppression_standalone_applies_to_next_line():
+    rep = run("""
+        import jax.numpy as jnp
+        class T:
+            def set_value(self, v):
+                # jaxlint: disable=JL001 -- reviewed: v is always freshly allocated
+                self._a = jnp.asarray(v)
+    """)
+    assert rule_ids(rep) == []
+    assert len(rep.suppressed) == 1
+
+
+def test_suppression_standalone_carries_over_decorator_lines():
+    # JL006's decorated-def findings anchor at the `def` line; a comment
+    # placed above the decorator must still reach it
+    rep = run("""
+        import jax
+        def learn(x):
+            # jaxlint: disable=JL006 -- one compile per call is intended
+            @jax.jit
+            def step(v):
+                return v * 2
+            for _ in range(3):
+                x = step(x)
+            return x
+    """)
+    assert rule_ids(rep) == []
+    assert [f.rule for f in rep.suppressed] == ["JL006"]
+
+
+def test_suppression_wrong_id_does_not_apply():
+    rep = run(_VIOLATION.format(trailing="  # jaxlint: disable=JL004"))
+    assert rule_ids(rep) == ["JL001"]
+    assert rep.suppressed == []
+
+
+def test_suppression_all_and_file_level():
+    rep = run(_VIOLATION.format(trailing="  # jaxlint: disable=all"))
+    assert rule_ids(rep) == []
+    rep = run("# jaxlint: disable-file=JL001 -- fixture corpus\n"
+              + textwrap.dedent(_VIOLATION.format(trailing="")))
+    assert rule_ids(rep) == []
+    assert rep.suppressed[0].justification == "fixture corpus"
+
+
+def test_suppression_marker_inside_string_is_inert():
+    rep = run(_VIOLATION.format(trailing="") + """
+        MARKER = "# jaxlint: disable-file=JL001"
+    """)
+    assert rule_ids(rep) == ["JL001"]
+
+
+# ---------------------------------------------------------------------------
+# JSON schema canary + self-checks
+
+
+def test_json_report_schema_canary():
+    rep = run(_VIOLATION.format(trailing=""))
+    doc = json.loads(json.dumps(rep.to_json()))  # must be JSON-serializable
+    assert doc["version"] == 1
+    assert doc["tool"] == "jaxlint"
+    assert set(doc["summary"]) == {
+        "files", "findings", "suppressed", "errors", "duration_s"}
+    assert doc["summary"]["findings"] == 1
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "name", "path", "line", "col", "message",
+                      "suppressed", "justification"}
+    assert f["rule"] == "JL001"
+    assert f["name"] == "donation-aliasing"
+    assert f["path"] == "fixture.py"
+    assert f["line"] > 0 and f["col"] >= 0
+    assert f["suppressed"] is False
+
+
+def test_syntax_error_becomes_report_error_not_crash():
+    rep = lint_source("def broken(:\n", path="bad.py")
+    assert rep.findings == []
+    assert len(rep.errors) == 1
+    assert "parse error" in rep.errors[0][1]
+    assert not rep.ok
+
+
+def test_analyzer_parses_entire_package_without_crashing():
+    rep = lint_paths([PKG_DIR])
+    assert rep.files > 150
+    assert rep.errors == [], rep.errors
+
+
+def test_cli_exit_codes_and_list_rules(tmp_path, capsys):
+    from paddle_tpu.analysis.cli import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("JL001", "JL007"):
+        assert rid in out
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def b(f):\n"
+                   "    return jax.jit(f, donate_argnums=(0,))\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert main([str(good)]) == 0
+    assert main([str(bad)]) == 1
+    capsys.readouterr()
+    assert main(["--json", str(bad)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["findings"] == 1
+    assert main([str(tmp_path / "missing.py")]) == 2
+
+
+def test_select_and_ignore_filters():
+    src = _VIOLATION.format(trailing="")
+    assert rule_ids(run(src, select=["JL004"])) == []
+    assert rule_ids(run(src, select=["JL001"])) == ["JL001"]
+    rep = lint_source(textwrap.dedent(src), ignore=["JL001"])
+    assert rule_ids(rep) == []

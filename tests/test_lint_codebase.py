@@ -1,0 +1,84 @@
+"""Tier-1 CI gate: the whole paddle_tpu tree must be jaxlint-clean.
+
+Every finding is either fixed or carries an inline
+``# jaxlint: disable=JLxxx -- reason`` waiver; reintroducing any of the
+historical bug patterns (zero-copy asarray into donated state, ungated
+donate_argnums, repr cache keys, ...) turns this test red.
+"""
+import os
+import time
+
+from paddle_tpu.analysis import lint_paths, lint_source
+
+PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu")
+
+
+def _sweep():
+    return lint_paths([PKG_DIR], rel_to=os.path.dirname(PKG_DIR))
+
+
+def test_codebase_is_lint_clean():
+    t0 = time.perf_counter()
+    rep = _sweep()
+    elapsed = time.perf_counter() - t0
+    assert rep.errors == [], rep.errors
+    assert rep.unsuppressed == [], (
+        "jaxlint findings (fix them or add a justified "
+        "'# jaxlint: disable=JLxxx -- reason' waiver):\n"
+        + "\n".join(f.format() for f in rep.unsuppressed))
+    # the gate must stay cheap enough to run in tier-1 forever
+    assert elapsed < 10.0, f"lint sweep took {elapsed:.1f}s (budget 10s)"
+
+
+def test_every_waiver_carries_a_justification():
+    rep = _sweep()
+    undocumented = [f for f in rep.suppressed if not f.justification]
+    assert undocumented == [], (
+        "suppressions without a ' -- reason' justification:\n"
+        + "\n".join(f.format() for f in undocumented))
+
+
+def test_gate_trips_on_reseeded_historical_bugs():
+    """Seeding any one postmortemed pattern must produce a finding — the
+    exact regression the gate exists to catch."""
+    seeded = {
+        # PR 1 heap corruption: zero-copy asarray into donated state
+        "JL001": """
+import jax.numpy as jnp
+class Tensor:
+    def set_value(self, value):
+        self._array = jnp.asarray(value)
+""",
+        # PR 3 constant-baking: repr-keyed compiled-callable cache
+        "JL002": """
+import jax
+def _key(args):
+    key = []
+    key.append(repr(args[0]))
+    return tuple(key)
+""",
+        # PR 3 mesh miscompile: donation without the backend gate
+        "JL004": """
+import jax
+def build(step):
+    return jax.jit(step, donate_argnums=(0, 2))
+""",
+        # PR 6 ring-buffer race: guarded deque iterated outside the lock
+        "JL005": """
+import threading
+class Tracer:
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+    def push(self, ev):
+        with self._lock:
+            self.events.append(ev)
+    def chrome_trace(self):
+        return list(self.events)
+""",
+    }
+    for rule_id, src in seeded.items():
+        rep = lint_source(src, path=f"seeded_{rule_id}.py")
+        assert [f.rule for f in rep.unsuppressed] == [rule_id], (
+            rule_id, [f.format() for f in rep.findings])
