@@ -33,7 +33,12 @@ Quickstart::
 The async serving frontend (`AsyncLLMEngine` in frontend.py) runs the step
 loop in a background thread and fans tokens out to per-request asyncio
 streams with admission control, deadlines, cancellation, and graceful
-drain; `ServingServer` (server.py, stdlib-only) exposes it over HTTP:
+drain — and runs every step under the fault-tolerance layer
+(supervisor.py): poison-request isolation by bisection, a stuck-step
+watchdog, crash-safe thread exit, and non-finite containment, all
+testable on demand via deterministic fault injection (faults.py,
+``PADDLE_TPU_FAULTS``). See README "Failure model".
+`ServingServer` (server.py, stdlib-only) exposes it over HTTP:
 OpenAI-style `/v1/completions` with SSE streaming, `/healthz` (with pool
 saturation gauges), and a Prometheus `/metrics` endpoint. Observability
 (serving/trace.py, ``PADDLE_TPU_TRACE``): a ring-buffered per-request
@@ -42,6 +47,7 @@ lifecycle + engine-step tracer exporting Perfetto-loadable JSON at
 ``PADDLE_TPU_REQUEST_LOG=1`` adds one JSON summary log line per request.
 See README "Observability".
 """
+from . import faults  # noqa: F401
 from .block_pool import (  # noqa: F401
     BlockPool,
     PagedState,
@@ -49,6 +55,7 @@ from .block_pool import (  # noqa: F401
     paged_attention,
 )
 from .engine import LLMEngine, StepOutput  # noqa: F401
+from .faults import FaultInjected, FaultPlan, FaultPoint  # noqa: F401
 from .frontend import (  # noqa: F401
     AsyncLLMEngine,
     EngineClosedError,
@@ -59,4 +66,9 @@ from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .server import ServingServer  # noqa: F401
 from .spec import NgramDrafter, apply_top_k_top_p  # noqa: F401
+from .supervisor import (  # noqa: F401
+    EngineHealth,
+    EngineSupervisor,
+    StepWatchdog,
+)
 from .trace import EngineTracer  # noqa: F401

@@ -42,6 +42,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from . import faults
+
 
 def chain_block_hashes(token_ids, block_size):
     """Chained content digests of each FULL block of `token_ids`.
@@ -217,6 +219,14 @@ class BlockPool:
         the request to truly-free blocks (speculative-decoding
         reservations: a drafted token that MIGHT be rejected must never
         push a cached prefix out of the index)."""
+        if faults._PLAN is not None:
+            fp = faults._PLAN.match("alloc_fail")
+            if fp is not None:
+                # report the pool as dry: callers defer/preempt exactly as
+                # under real block pressure
+                if self.tracer is not None:
+                    self.tracer.pool_instant("fault[alloc_fail]", {"n": n})
+                return None
         if n > (self.num_free if evict else len(self._free)):
             return None
         out = []

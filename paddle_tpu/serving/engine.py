@@ -44,6 +44,16 @@ tier. A cache-hit serve is token-for-token identical to a cold serve
 (tests/test_prefix_cache.py): reused blocks hold exactly the K/V a replay
 would recompute, and writes into shared blocks copy-on-write first.
 
+**Fault tolerance**: the step programs report per-row logit finiteness,
+and a NaN/Inf row is aborted with ``error:nonfinite_logits`` (its blocks
+never published to the prefix cache) instead of sampling garbage —
+reported in ``step_faults``. ``step(only=...)`` restricts one step to a
+set of request ids: the supervision layer (serving/supervisor.py) uses it
+to bisect a raising step down to the one poisoned request, re-queueing
+everyone else via ``requeue`` (preempt-by-recompute). Deterministic fault
+injection (serving/faults.py, ``PADDLE_TPU_FAULTS``) is compiled into the
+step/alloc hot paths as one-pointer-test hook sites, off by default.
+
 **Observability** (serving/trace.py, off by default): ``trace=...`` or
 ``PADDLE_TPU_TRACE=1`` (or a sampling fraction) turns on the
 ring-buffered lifecycle/step tracer — per-request span trees and a
@@ -69,9 +79,11 @@ from collections import namedtuple
 import numpy as np
 
 from ..core.functional import functional_call, state_dict_arrays
+from . import faults
 from .block_pool import BlockPool, PagedState, chain_block_hashes
+from .faults import FaultInjected
 from .metrics import ServingMetrics
-from .scheduler import Request, Scheduler
+from .scheduler import WAITING, Request, Scheduler
 
 _request_log = logging.getLogger("paddle_tpu.serving.request")
 
@@ -194,6 +206,15 @@ class LLMEngine:
         self._phases = {}   # current step's {phase: (t0, t1)} when tracing
         self._retrace_warned = False
         self._key = jax.random.PRNGKey(seed)
+        # fault injection (serving/faults.py): arm the PADDLE_TPU_FAULTS
+        # plan if one is configured; with no plan every hook site below is
+        # a single module-attribute pointer test (same discipline as the
+        # tracer — the disabled path is free)
+        faults.maybe_install_from_env()
+        # supervision surface (serving/supervisor.py reads these):
+        self.step_count = 0      # planned steps run (bisection probes too)
+        self.last_planned = []   # request ids of the most recent plan
+        self.step_faults = []    # (rid, detail) rows contained this step
 
     # -- request lifecycle -------------------------------------------------
 
@@ -227,7 +248,9 @@ class LLMEngine:
         at its worst case than the pool owns — without this check such a
         request is accepted, becomes the oldest running sequence, and the
         scheduler's no-livelock error then kills the whole serve instead
-        of the one offender."""
+        of the one offender. Returns the request's worst-case KV block
+        need (the frontend's ``max_kv_commit_blocks`` gate reuses it —
+        ONE definition of worst case)."""
         if req.num_tokens + req.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"request {req.request_id}: prompt {req.num_tokens} + "
@@ -242,6 +265,7 @@ class LLMEngine:
                 f"but the pool only has {self.pool.num_blocks - 1} usable "
                 "— raise num_blocks or shorten the request"
             )
+        return need
 
     def add(self, req):
         """Enqueue a pre-built Request (the async frontend constructs and
@@ -265,21 +289,51 @@ class LLMEngine:
             tr.begin_request(req)
         return req.request_id
 
-    def abort(self, request_id):
+    def abort(self, request_id, reason="aborted"):
         """Cancel a request in any live state (queued, mid-prefill,
         decoding, or preempted awaiting re-admission): the scheduler drops
         it from its queues, its KV blocks return to the pool, and its host
         record is released. The request object itself stays valid — already
-        emitted `output_ids` remain readable by whoever holds it. Returns
-        True if a live request was aborted, False if the id is unknown or
-        the request already finished."""
+        emitted `output_ids` remain readable by whoever holds it. `reason`
+        labels the terminal trace span / request-log line (the supervisor
+        passes ``error:<ExceptionClass>`` for poison-isolated requests).
+        Returns True if a live request was aborted, False if the id is
+        unknown or the request already finished."""
         req = self._requests.get(request_id)
         if req is None or req.finished:
             return False
         self.scheduler.abort(req)
         del self._requests[request_id]
-        self._finalize(req, "aborted")
+        self._finalize(req, reason)
         return True
+
+    def requeue(self, request_id):
+        """Re-queue a live request by preempt-by-recompute: its KV blocks
+        return to the pool and the request re-enters the waiting queue to
+        replay from scratch (arrival order preserved). The supervisor's
+        poison-isolation path uses this on every row of a failed step —
+        the engine holds no partial step state, so recompute is the one
+        correctness-preserving way to retire a step that may never have
+        reached the device. Returns True if the request is (now) queued,
+        False for unknown/finished ids."""
+        req = self._requests.get(request_id)
+        if req is None or req.finished:
+            return False
+        if req.state == WAITING:
+            return True          # already queued (e.g. a prior probe)
+        return self.scheduler.preempt(req)
+
+    def live_requests(self):
+        """Ids of requests not yet finished or aborted, in no particular
+        order (the supervisor's abort-everything fallback set)."""
+        return [rid for rid, r in self._requests.items() if not r.finished]
+
+    def peek_request(self, request_id):
+        """The request record (live OR finished-but-unreleased), else
+        None — unlike `get_request` this never raises. The frontend's
+        post-recovery reconciliation uses it to find requests that
+        finished inside a step whose emission was lost."""
+        return self._requests.get(request_id)
 
     def has_unfinished(self):
         return self.scheduler.has_unfinished()
@@ -336,12 +390,19 @@ class LLMEngine:
                                     block_tables, slots, offs, qpos,
                                     q_start, kv_live)
             lg = logits[jnp.arange(ids.shape[0]), last_idx].astype(jnp.float32)
+            # non-finite containment (the TrainMonitor discipline applied
+            # to serving): a NaN/Inf in the sampled-position logits means
+            # this row's forward is numerically poisoned — report it per
+            # row so the host aborts the one request instead of sampling
+            # garbage. One reduction over [B, vocab]; padding lanes are
+            # never inspected on the host side.
+            row_ok = jnp.isfinite(lg).all(axis=-1)
             greedy = jnp.argmax(lg, axis=-1)
             scaled = lg / jnp.maximum(temps[:, None], 1e-6)
             scaled = apply_top_k_top_p(scaled, top_ks, top_ps)
             sampled = jax.random.categorical(key, scaled, axis=-1)
             tok = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
-            return tok, state.k, state.v
+            return tok, row_ok, state.k, state.v
 
         def verify(params, buffers, k_arena, v_arena, ids, block_tables,
                    slots, offs, qpos, q_start, kv_live, spec_lens, temps,
@@ -349,10 +410,18 @@ class LLMEngine:
             logits, state = forward(params, buffers, k_arena, v_arena, ids,
                                     block_tables, slots, offs, qpos,
                                     q_start, kv_live)
+            # non-finite containment over the row's LIVE positions only
+            # (the pending token + its drafted candidates); padded tail
+            # positions attend through the null block and are never
+            # sampled, so their logits must not poison the row
+            S = ids.shape[1]
+            live = jnp.arange(S)[None, :] <= spec_lens[:, None]
+            pos_ok = jnp.isfinite(logits.astype(jnp.float32)).all(axis=-1)
+            row_ok = jnp.where(live, pos_ok, True).all(axis=-1)
             accept, out_tok = spec_accept_arrays(
                 logits, ids, spec_lens, temps, top_ks, top_ps, key
             )
-            return accept, out_tok, state.k, state.v
+            return accept, out_tok, row_ok, state.k, state.v
 
         fn = jax.jit(verify if kind == "verify" else step,
                      # jaxlint: disable=JL004 -- serving step donates the single-device KV arenas (unsharded); gating would copy the whole arena every step on CPU
@@ -390,8 +459,8 @@ class LLMEngine:
             jnp.asarray(top_ks), jnp.asarray(top_ps), sub,
         )
         with self._annotation(step_id):
-            tok, self.pool.k, self.pool.v = fn(*args)
-        return tok
+            tok, row_ok, self.pool.k, self.pool.v = fn(*args)
+        return tok, row_ok
 
     def _run_verify(self, fn, ids, tables, slots, offs, qpos, q_start,
                     kv_live, spec_lens, temps, top_ks, top_ps, step_id=0):
@@ -408,19 +477,103 @@ class LLMEngine:
             sub,
         )
         with self._annotation(step_id):
-            accept, out_tok, self.pool.k, self.pool.v = fn(*args)
-        return accept, out_tok
+            accept, out_tok, row_ok, self.pool.k, self.pool.v = fn(*args)
+        return accept, out_tok, row_ok
+
+    # -- fault hooks (serving/faults.py; armed plans only) -----------------
+
+    def _fire_step_faults(self):
+        """Evaluate the step-scoped fault points against this step's plan.
+        Only reached when a FaultPlan is installed (the caller's one
+        pointer test); order is degrade -> hang -> raise so a combined
+        plan slows/wedges the step before failing it."""
+        plan = faults._PLAN
+        tr = self.tracer
+        fp = plan.match("slow_step_ms", step=self.step_count,
+                        request_ids=self.last_planned)
+        if fp is not None:
+            if tr is not None:
+                tr.supervisor_instant("fault[slow_step_ms]",
+                                      {"step": self.step_count, "ms": fp.ms})
+            time.sleep((fp.ms or 0.0) / 1e3)
+        fp = plan.match("step_hang", step=self.step_count,
+                        request_ids=self.last_planned)
+        if fp is not None:
+            if tr is not None:
+                tr.supervisor_instant("fault[step_hang]",
+                                      {"step": self.step_count})
+            plan.hang(fp)
+        fp = plan.match("step_raise", step=self.step_count,
+                        request_ids=self.last_planned)
+        if fp is not None:
+            if tr is not None:
+                tr.supervisor_instant("fault[step_raise]",
+                                      {"step": self.step_count})
+            raise FaultInjected(
+                "step_raise",
+                None if fp.exc is None
+                else f"injected step fault ({fp.exc})",
+            )
+
+    def _corrupt_row_ok(self, rows, row_ok):
+        """``step_nonfinite_logits``: report the matched rows' logits as
+        non-finite, driving the containment path below exactly as a real
+        numerically-poisoned forward would. Only reached when a plan is
+        installed."""
+        plan = faults._PLAN
+        # np.asarray of a device array is typically a read-only view
+        row_ok = np.array(row_ok)
+        for i, row in enumerate(rows):
+            fp = plan.match("step_nonfinite_logits", step=self.step_count,
+                            request_ids=(row.req.request_id,))
+            if fp is not None:
+                if self.tracer is not None:
+                    self.tracer.supervisor_instant(
+                        "fault[step_nonfinite_logits]",
+                        {"step": self.step_count,
+                         "request_id": row.req.request_id})
+                row_ok[i] = False
+        return row_ok
+
+    def _poison(self, req, detail):
+        """Contain one numerically-poisoned row: abort ONLY this request
+        with a structured error reason, never publishing the blocks its
+        own prefill wrote (their KV is suspect; blocks matched FROM the
+        cache at admission are republished — other holders vouch for
+        them). The supervisor relays ``step_faults`` to the frontend so
+        the consumer sees a terminal ``error`` event."""
+        req.block_hashes = req.block_hashes[:req.num_matched_blocks]
+        self.metrics.inc("nonfinite_rows")
+        self.step_faults.append((req.request_id, detail))
+        self.abort(req.request_id, reason=f"error:{detail}")
 
     # -- one engine step ---------------------------------------------------
 
-    def step(self):
+    def step(self, only=None):
         """Run one mixed (or pure-decode) step; returns [StepOutput] for
-        every request that produced a token this step."""
+        every request that produced a token this step. ``only`` restricts
+        the plan (admission included) to that set of request ids — the
+        supervisor's bisection probes use it to step half the suspects of
+        a failed batch while everyone else holds still. Rows the engine
+        had to contain this step (non-finite logits) emit no StepOutput;
+        they are aborted internally and reported in ``self.step_faults``
+        as ``(request_id, detail)`` pairs."""
         tr = self.tracer
         t_plan0 = time.monotonic() if tr is not None else 0.0
-        rows = self.scheduler.schedule()
+        self.step_faults = []
+        # cleared BEFORE planning: if schedule() itself raises (config
+        # error, injected alloc pressure) the supervisor must not recover
+        # against the PREVIOUS step's plan — an empty plan routes the
+        # failure to the unattributable path instead of re-queueing and
+        # catch-up-flipping bystanders
+        self.last_planned = []
+        rows = self.scheduler.schedule(only=only)
         if not rows:
             return []
+        self.step_count += 1
+        self.last_planned = [row.req.request_id for row in rows]
+        if faults._PLAN is not None:
+            self._fire_step_faults()
         # the dominant all-decode steps run at width 1; a decode step where
         # the drafter proposed candidates runs at the fixed verify width;
         # any step carrying a prefill chunk runs at the fixed chunk width —
@@ -558,15 +711,23 @@ class LLMEngine:
             self._fill_row(a, i, req, start, count, S)
         fn = self._get_step_fn(self.max_batch, S)
         t_disp = time.monotonic() if tr is not None else 0.0
-        tok_dev = self._run_step(
+        tok_dev, ok_dev = self._run_step(
             fn, a["ids"], a["tables"], a["slots"], a["offs"],
             a["qpos"], a["q_start"], a["kv_live"], last_idx,
             a["temps"], a["top_ks"], a["top_ps"], step_id=step_id)
         t_sync = time.monotonic() if tr is not None else 0.0
         tok = np.asarray(tok_dev)  # host sync: the step lands here
+        row_ok = np.asarray(ok_dev)
+        if faults._PLAN is not None:
+            row_ok = self._corrupt_row_ok(rows, row_ok)
         t_emit = time.monotonic() if tr is not None else 0.0
         outs = []
         for i, row in enumerate(rows):
+            if not row_ok[i]:
+                # NaN/Inf logits: abort this row only — its KV and token
+                # are garbage; everyone else's step output is unaffected
+                self._poison(row.req, "nonfinite_logits")
+                continue
             row.req.num_cached += row.count
             if row.emit:
                 outs.append(self._emit(row.req, int(tok[i])))
@@ -614,17 +775,23 @@ class LLMEngine:
             self._fill_row(a, i, req, start, w, S)
         fn = self._get_step_fn(self.max_batch, S, kind="verify")
         t_disp = time.monotonic() if tr is not None else 0.0
-        accept, out_tok = self._run_verify(
+        accept, out_tok, ok_dev = self._run_verify(
             fn, a["ids"], a["tables"], a["slots"], a["offs"], a["qpos"],
             a["q_start"], a["kv_live"], spec_lens, a["temps"], a["top_ks"],
             a["top_ps"], step_id=step_id,
         )
         t_sync = time.monotonic() if tr is not None else 0.0
         accept, out_tok = np.asarray(accept), np.asarray(out_tok)
+        row_ok = np.asarray(ok_dev)
+        if faults._PLAN is not None:
+            row_ok = self._corrupt_row_ok(rows, row_ok)
         t_emit = time.monotonic() if tr is not None else 0.0
         outs = []
         for i, row in enumerate(rows):
             req, k = row.req, len(row.draft)
+            if not row_ok[i]:
+                self._poison(req, "nonfinite_logits")
+                continue
             if not row.emit:
                 req.num_cached += 1
                 if tr is not None and req.traced:

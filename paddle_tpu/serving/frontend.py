@@ -31,24 +31,64 @@ Robustness contract (tested in tests/test_serving_frontend.py):
   scheduler in any state and returns its blocks to the pool;
 - **graceful drain** — `shutdown(drain=True)` stops admitting, lets
   in-flight requests finish (or hard-aborts them after ``timeout_s``),
-  then exits the engine thread.
+  then exits the engine thread;
+- **fault tolerance** (serving/supervisor.py, tests/test_serving_chaos.py)
+  — every `eng.step()` runs under `EngineSupervisor`: a raising step is
+  bisected down to the one poisoned request (everyone else recomputes and
+  completes token-identically), non-finite logits abort only their row,
+  an exception escaping the loop itself runs the crash-safe exit
+  (``try/finally``: every live stream gets a terminal ``error`` event,
+  the engine marks unhealthy, later `submit` fails fast), a dead engine
+  thread is detected AT `submit` (`EngineClosedError(reason=
+  "engine_dead")` — never an enqueue into a queue nobody drains), and an
+  optional `StepWatchdog` (``watchdog_step_timeout_s``) turns a stuck
+  device step into a 503 ``/healthz`` + structured stream errors instead
+  of silence.
 """
 from __future__ import annotations
 
 import asyncio
+import logging
 import queue
 import threading
 import time
 
+from . import faults
+from .faults import FaultInjected
+from .supervisor import EngineHealth, EngineSupervisor, StepWatchdog
+
+_log = logging.getLogger("paddle_tpu.serving.frontend")
+
 _END = "__end__"
+# no-op queue sentinel: flipping a stream into catch-up mode must WAKE a
+# consumer already parked on queue.get() (the organic overflow flip in
+# _push_token can never race a parked consumer — the queue is full there
+# — but the post-recovery catchup flip can)
+_SYNC = "__sync__"
 
 
 class EngineOverloadedError(RuntimeError):
-    """The bounded wait queue is full — retry later (HTTP 429)."""
+    """Admission rejected on a FULL resource — retry later (HTTP 429).
+    ``reason`` says which resource: ``queue_full`` (the bounded wait
+    queue) or ``kv_capacity`` (the worst-case KV commitment gate);
+    ``retry_after_s`` feeds the Retry-After header."""
+
+    def __init__(self, message, reason="queue_full", retry_after_s=1.0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class EngineClosedError(RuntimeError):
-    """The engine is draining or stopped — no new admissions (HTTP 503)."""
+    """No new admissions (HTTP 503). ``reason`` distinguishes the LB
+    action: ``draining`` (planned — come back after the deploy),
+    ``unhealthy`` (watchdog/supervisor tripped — pull the replica), or
+    ``engine_dead`` (the engine thread is gone — pull the replica)."""
+
+    def __init__(self, message, reason="draining", retry_after_s=None):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 class RequestStream:
@@ -80,6 +120,8 @@ class RequestStream:
                 item = await self.queue.get()
                 if item is _END:
                     return
+                if item is _SYNC:
+                    continue      # re-check overflow at the loop top
                 self.consumed += 1
                 yield item
                 continue
@@ -89,7 +131,9 @@ class RequestStream:
                 item = self.queue.get_nowait()
             except asyncio.QueueEmpty:
                 item = None
-            if item is not None:
+            if item is _SYNC:
+                item = None       # the flip sentinel is always last in
+            if item is not None:  # the queue — fall through to catch-up
                 if item is _END:
                     return
                 self.consumed += 1
@@ -122,13 +166,42 @@ class RequestStream:
 
 class AsyncLLMEngine:
     def __init__(self, engine, max_waiting=64, stream_queue_size=64,
-                 default_timeout_s=None, idle_poll_s=0.02):
+                 default_timeout_s=None, idle_poll_s=0.02,
+                 max_step_retries=3, watchdog_step_timeout_s=None,
+                 watchdog_poll_s=None, max_kv_commit_blocks=None,
+                 hard_stop_timeout_s=30.0):
         self.engine = engine
         self.metrics = engine.metrics
         self.max_waiting = int(max_waiting)
         self.stream_queue_size = max(1, int(stream_queue_size))
         self.default_timeout_s = default_timeout_s
         self._idle_poll_s = float(idle_poll_s)
+        # failure supervision (serving/supervisor.py): poison-step
+        # bisection + health; the watchdog thread only exists when a
+        # step timeout is configured
+        self.health = EngineHealth()
+        self._sup = EngineSupervisor(
+            engine, max_step_retries=max_step_retries, health=self.health)
+        self.watchdog_step_timeout_s = watchdog_step_timeout_s
+        self._watchdog = (
+            None if watchdog_step_timeout_s is None
+            else StepWatchdog(self._sup, watchdog_step_timeout_s,
+                              poll_s=watchdog_poll_s,
+                              on_trip=self._on_watchdog_trip)
+        )
+        # optional worst-case KV admission gate: total blocks the admitted
+        # in-flight set could need at its longest. None = off (the
+        # scheduler's preempt-by-recompute handles oversubscription); set
+        # it to bound recompute thrash and surface 429 kv_capacity early.
+        self.max_kv_commit_blocks = (
+            None if max_kv_commit_blocks is None
+            else int(max_kv_commit_blocks))
+        self._kv_committed = 0
+        self._kv_need = {}                # rid -> committed blocks
+        # last-resort window for declaring the engine thread wedged at
+        # shutdown; generous because one legitimate step can run long
+        # (e.g. the first step's XLA compile)
+        self.hard_stop_timeout_s = float(hard_stop_timeout_s)
         self._cmds = queue.Queue()
         self._streams = {}                # rid -> RequestStream (loop side)
         self._inflight = 0
@@ -145,10 +218,13 @@ class AsyncLLMEngine:
             return self
         self._loop = asyncio.get_running_loop()
         self._stopped = asyncio.Event()
+        self.metrics.set_gauge("engine_unhealthy", 0.0)
         self._thread = threading.Thread(
             target=self._engine_loop, name="paddle-tpu-engine", daemon=True
         )
         self._thread.start()
+        if self._watchdog is not None:
+            self._watchdog.start()
         return self
 
     @property
@@ -168,24 +244,57 @@ class AsyncLLMEngine:
     async def shutdown(self, drain=True, timeout_s=30.0):
         """Graceful drain: stop admitting, finish (or, past ``timeout_s``,
         abort) in-flight requests, then join the engine thread. With
-        ``drain=False`` everything in flight is aborted immediately."""
+        ``drain=False`` everything in flight is aborted immediately. A
+        WEDGED engine thread (stuck device step — watchdog territory)
+        cannot be joined: past ``hard_stop_timeout_s`` of no progress the
+        loop-side state is cleaned up anyway (streams terminated, callers
+        released) and the daemon thread is left to the OS."""
         self._closed = True
         if self._thread is None:
             return
         self._cmds.put(("stop", bool(drain)))
-        if drain and timeout_s is not None:
-            try:
-                await asyncio.wait_for(self._stopped.wait(), timeout_s)
-            except asyncio.TimeoutError:
-                self._cmds.put(("stop", False))
-                await self._stopped.wait()
-        else:
-            await self._stopped.wait()
+        stopped = await self._await_stopped(
+            timeout_s if drain else self.hard_stop_timeout_s)
+        if not stopped:
+            self._cmds.put(("stop", False))
+            stopped = await self._await_stopped(self.hard_stop_timeout_s)
+        while not stopped:
+            # slow is not wedged: as long as steps keep FINISHING the
+            # thread is alive and will reach the hard-stop command —
+            # keep waiting. Only a thread with no step progress for a
+            # full window is declared wedged.
+            if (time.monotonic() - self._sup.last_step_finished
+                    >= self.hard_stop_timeout_s):
+                break
+            stopped = await self._await_stopped(self.hard_stop_timeout_s)
+        if self._watchdog is not None:
+            self._watchdog.request_stop()
+        if not stopped:
+            # the engine thread is not draining its command queue and has
+            # made no step progress — it is stuck inside a step (or dead
+            # in a way the crash handler could not reach). Do its
+            # loop-side last rites ourselves so no consumer or caller
+            # waits on a thread we cannot kill.
+            self.health.mark_unhealthy("engine_thread_wedged")
+            self.metrics.set_gauge("engine_unhealthy", 1.0)
+            self._fail_all_streams(
+                "error", "engine thread wedged during shutdown")
+            self._stopped.set()
+            return
         # Thread.join blocks; _stopped was set by the engine thread's last
         # act, so this is near-instant — but a hung thread must stall an
         # executor worker, never the event loop (JL007)
         await asyncio.get_running_loop().run_in_executor(
             None, self._thread.join, 5.0)
+
+    async def _await_stopped(self, timeout_s):
+        """True once the engine thread signalled `_stopped` (bounded by
+        `timeout_s`; None waits forever)."""
+        try:
+            await asyncio.wait_for(self._stopped.wait(), timeout_s)
+            return True
+        except asyncio.TimeoutError:
+            return False
 
     # -- request API (event-loop thread) -----------------------------------
 
@@ -203,24 +312,58 @@ class AsyncLLMEngine:
         engine's lifecycle tracer regardless of its sampling fraction."""
         from .scheduler import Request
 
+        if not self.health.healthy:
+            raise EngineClosedError(
+                f"engine unhealthy: {self.health.reason}",
+                reason="unhealthy", retry_after_s=None,
+            )
         if self._closed:
-            raise EngineClosedError("engine is draining; not admitting")
+            raise EngineClosedError(
+                "engine is draining; not admitting",
+                reason="draining", retry_after_s=5.0,
+            )
         if self._thread is None:
             raise RuntimeError("AsyncLLMEngine.start() has not been awaited")
+        if not self._thread.is_alive():
+            # a dead engine thread that slipped past the crash handler
+            # (e.g. interpreter teardown): fail fast, never enqueue into
+            # a command queue nobody drains
+            raise EngineClosedError(
+                "engine thread is dead; not admitting",
+                reason="engine_dead", retry_after_s=None,
+            )
         limit = self.engine.max_batch + self.max_waiting
         if self._inflight >= limit:
             self.metrics.inc("requests_rejected")
             raise EngineOverloadedError(
                 f"{self._inflight} requests in flight (limit {limit}: "
                 f"max_batch {self.engine.max_batch} + max_waiting "
-                f"{self.max_waiting})"
+                f"{self.max_waiting})",
+                reason="queue_full", retry_after_s=1.0,
             )
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=request_id, top_k=top_k, top_p=top_p,
                       spec_decoding=spec_decoding,
                       num_spec_tokens=num_spec_tokens, trace=trace)
-        self.engine.validate(req)
+        worst_case_blocks = self.engine.validate(req)
+        need = 0
+        if self.max_kv_commit_blocks is not None:
+            # worst-case KV commitment: admitting past the gate would let
+            # the in-flight set oversubscribe KV so far that the scheduler
+            # thrashes preempt-by-recompute — reject with the reason
+            # (kv_capacity, not queue_full) so clients back off correctly.
+            # Checked BEFORE the prompt is hashed: a rejected retry storm
+            # must not pay O(prompt) hashing on the event-loop thread
+            need = worst_case_blocks
+            if self._kv_committed + need > self.max_kv_commit_blocks:
+                self.metrics.inc("requests_rejected")
+                raise EngineOverloadedError(
+                    f"worst-case KV commitment {self._kv_committed} + "
+                    f"{need} blocks exceeds max_kv_commit_blocks "
+                    f"{self.max_kv_commit_blocks}",
+                    reason="kv_capacity", retry_after_s=1.0,
+                )
         if self.engine.prefix_cache:
             # chain the prompt's block hashes HERE, off the engine thread:
             # engine.add skips recomputing them, so a long prompt's hashing
@@ -234,6 +377,9 @@ class AsyncLLMEngine:
             raise ValueError(f"duplicate request id {req.request_id}")
         st = RequestStream(req.request_id, req, self.stream_queue_size)
         self._streams[req.request_id] = st
+        if need:
+            self._kv_committed += need
+            self._kv_need[req.request_id] = need
         self._inflight += 1
         self.metrics.set_gauge("frontend_inflight", self._inflight)
         if timeout_s is None:
@@ -256,8 +402,30 @@ class AsyncLLMEngine:
     def _dispatch(self, events):
         for ev in events:
             kind, rid = ev[0], ev[1]
+            if kind == "fail_all":
+                # watchdog trip / engine-thread death: every live stream
+                # gets ONE terminal error event instead of silence
+                _, _, reason, detail = ev
+                self._fail_all_streams(reason, detail)
+                continue
             st = self._streams.get(rid)
             if st is None:
+                continue
+            if kind == "catchup":
+                # post-recovery re-sync: a step that raised mid-emission
+                # may have appended tokens (even finished the request)
+                # without the queue pushes ever happening — flip the
+                # stream into the lossless catch-up mode, which reads
+                # the authoritative output_ids by index. The sentinel
+                # wakes a consumer already parked on queue.get(); if the
+                # queue is full the consumer is behind and will see the
+                # flip before it can park again.
+                st.overflow = True
+                try:
+                    st.queue.put_nowait(_SYNC)
+                except asyncio.QueueFull:
+                    pass
+                st.wake.set()
                 continue
             if kind == "tok":
                 _, _, tok, reason = ev
@@ -291,8 +459,18 @@ class AsyncLLMEngine:
         st.wake.set()
         st.done.set()
         del self._streams[st.request_id]
+        self._kv_committed -= self._kv_need.pop(st.request_id, 0)
         self._inflight -= 1
         self.metrics.set_gauge("frontend_inflight", self._inflight)
+
+    def _fail_all_streams(self, reason, detail):
+        """Terminate every live stream with `reason`/`detail` (loop
+        thread). Used by the crash-safe engine-thread exit and the
+        watchdog trip — the single-terminal-event invariant holds because
+        `_finish_stream` is idempotent per stream."""
+        for st in list(self._streams.values()):
+            st.error = detail
+            self._finish_stream(st, reason)
 
     def _on_stopped(self):
         # hard-stop/drain already finished every stream; anything left
@@ -307,15 +485,83 @@ class AsyncLLMEngine:
         except RuntimeError:
             pass  # event loop already closed (interpreter teardown)
 
+    # -- watchdog trip (watchdog thread) -----------------------------------
+
+    def _on_watchdog_trip(self, stuck_for_s):
+        """The engine thread has been inside one step for longer than
+        ``watchdog_step_timeout_s``. It cannot be killed; what can be done
+        is drain the blast radius: health goes unhealthy (503 /healthz →
+        the LB pulls this replica), admission closes, and every in-flight
+        consumer gets a structured terminal error instead of silence."""
+        self._sup.on_watchdog_trip(stuck_for_s)   # health + metrics + trace
+        self._closed = True
+        self._to_loop([(
+            "fail_all", None, "error",
+            f"step_stuck: engine step has been running for "
+            f"{stuck_for_s:.1f}s (watchdog_step_timeout_s="
+            f"{self.watchdog_step_timeout_s})")])
+
     # -- engine thread -----------------------------------------------------
 
     def _engine_loop(self):
+        """Engine-thread main: the crash-safe shell around the real loop.
+        NOTHING may escape without the epilogue running — an exception
+        that skipped `_on_stopped` would leave every pending consumer
+        parked on a queue nobody will ever fill."""
+        try:
+            self._run_engine_loop()
+        except BaseException as e:  # noqa: BLE001 — thread epilogue:
+            # fan a terminal error to every live stream, mark the engine
+            # unhealthy/closed, and fail fast on later submits
+            self._closed = True
+            self.health.mark_unhealthy(
+                "engine_thread_died", error=f"{type(e).__name__}: {e}")
+            self.metrics.inc("engine_thread_deaths")
+            self.metrics.set_gauge("engine_unhealthy", 1.0)
+            _log.exception("engine thread died")
+            try:
+                # this thread owns the engine and is about to stop being
+                # able to: return every KV block while it still can
+                for rid in self.engine.live_requests():
+                    self.engine.abort(rid, reason="error:engine_thread_died")
+            except Exception:  # noqa: BLE001 — best-effort last rites on
+                pass               # state the escaping exception may have
+            self._to_loop([(       # already corrupted
+                "fail_all", None, "error",
+                f"engine thread died: {type(e).__name__}: {e}")])
+        finally:
+            self._closed = True
+            if self._watchdog is not None:
+                self._watchdog.request_stop()
+            try:
+                self._loop.call_soon_threadsafe(self._on_stopped)
+            except RuntimeError:
+                pass
+
+    def _run_engine_loop(self):
         eng = self.engine
         deadlines = {}   # rid -> monotonic deadline
         live = set()     # rids this thread admitted and not yet retired
         draining = False
         stop = False
+
+        def retire(rid, req, last_token):
+            """Natural completion: drop loop bookkeeping, release the
+            engine record, return the finish reason (the ONE stop-vs-
+            length derivation)."""
+            live.discard(rid)
+            deadlines.pop(rid, None)
+            eng.release(rid)
+            return ("stop"
+                    if req.eos_token_id is not None
+                    and last_token == req.eos_token_id
+                    else "length")
+
         while not stop:
+            if faults._PLAN is not None:
+                fp = faults._PLAN.match("thread_die")
+                if fp is not None:
+                    raise FaultInjected("thread_die")
             # drain commands; park on the queue (poll interval) when idle
             cmds = []
             try:
@@ -375,38 +621,57 @@ class AsyncLLMEngine:
                         self.metrics.inc("requests_timeout")
                         events.append(("finish", rid, "timeout", None))
             if not stop and eng.has_unfinished():
-                try:
-                    outs = eng.step()
-                except Exception as e:  # noqa: BLE001 — a poisoned step
-                    # must not kill serving: fail in-flight work loudly and
-                    # keep accepting (the engine holds no partial step
-                    # state; aborts below return every KV block)
-                    self.metrics.inc("engine_step_errors")
+                # supervised step: a raising step is bisected down to the
+                # one poisoned request (everyone else recomputes), rows
+                # with non-finite logits are contained per-row, and only
+                # max_step_retries consecutive unattributable failures
+                # fall back to failing everything (supervisor.py)
+                outs, failures = self._sup.step()
+                for rid, detail in failures:
+                    live.discard(rid)
+                    deadlines.pop(rid, None)
+                    events.append(("finish", rid, "error", detail))
+                # a recovery means the failed step's emission was lost:
+                # re-sync every touched stream from output_ids (lossless
+                # catch-up), and requests that FINISHED inside that step
+                # get the terminal event its emit loop never dispatched
+                for rid in self._sup.last_touched:
+                    if rid not in live:
+                        continue
+                    req = eng.peek_request(rid)
+                    if req is None:
+                        continue       # aborted: covered by failures
+                    events.append(("catchup", rid))
+                    if req.finished:
+                        reason = retire(
+                            rid, req,
+                            req.output_ids[-1] if req.output_ids else None)
+                        events.append(("finish", rid, reason, None))
+                if self._watchdog is not None and self._watchdog.tripped:
+                    # the stuck step finally returned, but its consumers
+                    # were already failed over — retire the orphaned
+                    # requests so the pool drains to idle (the engine
+                    # stays unhealthy/closed; the LB pulled the replica)
                     for rid in list(live):
-                        eng.abort(rid)
-                        events.append(("finish", rid, "error", str(e)))
+                        if eng.abort(rid, reason="error:step_stuck"):
+                            events.append((
+                                "finish", rid, "error",
+                                "step_stuck: aborted after watchdog trip"))
                     live.clear()
                     deadlines.clear()
-                    outs = []
                 for o in outs:
                     reason = None
                     if o.finished:
-                        req = eng.get_request(o.request_id)
-                        reason = (
-                            "stop"
-                            if req.eos_token_id is not None
-                            and o.token == req.eos_token_id
-                            else "length"
-                        )
-                        live.discard(o.request_id)
-                        deadlines.pop(o.request_id, None)
-                        eng.release(o.request_id)
+                        req = eng.peek_request(o.request_id)
+                        if req is None:
+                            # finished during a recovery probe and already
+                            # released by the reconciliation above (its
+                            # stream got catchup + finish; the token
+                            # arrives via catch-up, not this event)
+                            continue
+                        reason = retire(o.request_id, req, o.token)
                     events.append(("tok", o.request_id, o.token, reason))
             if events:
                 self._to_loop(events)
             if draining and not stop and not eng.has_unfinished():
                 stop = True
-        try:
-            self._loop.call_soon_threadsafe(self._on_stopped)
-        except RuntimeError:
-            pass

@@ -102,8 +102,20 @@ _HELP = {
     "client_disconnects": "Requests aborted because the client went away",
     "frontend_inflight": "Requests admitted by the frontend and not yet "
                          "finished",
-    "engine_step_errors": "Engine steps that raised (in-flight work "
-                          "failed over)",
+    "engine_step_errors": "Engine steps that raised (supervisor recovery "
+                          "entered)",
+    "engine_step_retries": "Bisection probe steps run while isolating a "
+                           "poisoned request",
+    "poison_requests_isolated": "Requests attributed by bisection and "
+                                "aborted alone (batch survived)",
+    "nonfinite_rows": "Step rows aborted for NaN/Inf logits "
+                      "(error:nonfinite_logits)",
+    "watchdog_trips": "Stuck-step watchdog firings (engine flipped "
+                      "unhealthy)",
+    "engine_thread_deaths": "Engine threads lost to an escaping "
+                            "exception (crash-safe exit ran)",
+    "engine_unhealthy": "1 when the engine is unhealthy (watchdog trip / "
+                        "thread death), else 0",
     "requests_cancelled": "Requests aborted via the frontend",
     "requests_timeout": "Requests aborted by their deadline",
 }

@@ -264,6 +264,17 @@ class Scheduler:
         if self.metrics is not None:
             self.metrics.inc("requests_aborted")
 
+    def preempt(self, req):
+        """Public preempt-by-recompute of a RUNNING request (the engine
+        supervisor re-queues every row of a failed step through here:
+        blocks back to the pool, replay on re-admission — no partial step
+        state can survive). Returns False for requests not currently
+        running (queued, finished, aborted)."""
+        if req.finished or req not in self.running:
+            return False
+        self._preempt(req)
+        return True
+
     def _preempt(self, req):
         """Preempt-by-recompute: drop the KV, re-queue at the front. The
         released blocks publish their hashes, so a victim whose cached
@@ -387,23 +398,37 @@ class Scheduler:
                                             {"src": b, "dst": nb})
         return True
 
-    def schedule(self):
+    def _admit(self, req):
+        req.state = RUNNING
+        if (self.prefix_cache and req.block_hashes and not req.blocks
+                and req.num_cached == 0):
+            self._match_prefix(req)
+        now = time.monotonic()
+        if req.admit_time is None:
+            req.admit_time = now   # queue wait = first admission only
+        if self.tracer is not None and req.traced:
+            self.tracer.request_admitted(req, now)
+        self.running.append(req)
+
+    def schedule(self, only=None):
         """Plan one mixed step. Returns the list of ScheduledRows (empty =
         idle). Every running sequence gets its decode token or its next
         prefill chunk (budget and pool permitting); waiting requests are
-        admitted FCFS into free lanes first."""
-        while self.waiting and len(self.running) < self.max_batch:
-            req = self.waiting.popleft()
-            req.state = RUNNING
-            if (self.prefix_cache and req.block_hashes and not req.blocks
-                    and req.num_cached == 0):
-                self._match_prefix(req)
-            now = time.monotonic()
-            if req.admit_time is None:
-                req.admit_time = now   # queue wait = first admission only
-            if self.tracer is not None and req.traced:
-                self.tracer.request_admitted(req, now)
-            self.running.append(req)
+        admitted FCFS into free lanes first. ``only`` (a set of request
+        ids) restricts BOTH admission and planning to those requests —
+        the supervisor's bisection probes step a suspect subset while
+        every other sequence holds its state untouched."""
+        if only is None:
+            while self.waiting and len(self.running) < self.max_batch:
+                self._admit(self.waiting.popleft())
+        else:
+            # probe admission: pull ONLY the probed ids out of the queue,
+            # preserving everyone else's position and FCFS order
+            for req in [r for r in self.waiting if r.request_id in only]:
+                if len(self.running) >= self.max_batch:
+                    break
+                self.waiting.remove(req)
+                self._admit(req)
 
         budget = self.token_budget
         rows = []
@@ -413,6 +438,8 @@ class Scheduler:
         for req in sorted(self.running, key=lambda r: r.arrival_seq):
             if req not in self.running:
                 continue  # preempted while an earlier row grew its blocks
+            if only is not None and req.request_id not in only:
+                continue  # held still while a probe steps the suspects
             pending = req.num_pending
             if pending == 1:
                 # decode row (also a prefill's final 1-token chunk): always
@@ -432,7 +459,7 @@ class Scheduler:
                 # so a deferred/preempted chunk's share flows to later rows
                 budget -= count
             rows.append(ScheduledRow(req, start, count, emit=count == pending))
-        if (self.drafter is not None and rows
+        if (self.drafter is not None and only is None and rows
                 and all(r.count == 1 for r in rows)):
             # pure-decode step: every row feeds exactly one token, so the
             # verify program's (max_batch, 1 + num_spec) width can carry

@@ -20,7 +20,14 @@ framework), one process, loopback-friendly for tests. Endpoints:
   the engine's saturation stats (`LLMEngine.pool_stats`: truly-free vs
   cached-free vs allocated KV blocks, running/waiting request counts), so
   a load balancer or operator can see saturation WITHOUT scraping
-  `/metrics`; 503 ``{"status": "draining"}`` during shutdown.
+  `/metrics`; 503 ``{"status": "draining"}`` during shutdown; 503
+  ``{"status": "unhealthy", "reason": "step_stuck", "stuck_for_s": ...}``
+  when the supervision layer tripped (stuck-step watchdog, dead engine
+  thread — serving/supervisor.py). Unhealthy is sticky: the replica
+  stays out of rotation until restarted. 429/503 rejections from
+  `/v1/completions` carry a ``Retry-After`` header and a structured
+  ``error.reason`` (``queue_full`` / ``kv_capacity`` / ``draining`` /
+  ``unhealthy`` / ``engine_dead``) so clients and LBs back off correctly.
 - ``GET /metrics`` — Prometheus text exposition from ServingMetrics
   (counters ``_total``, gauges, step/TTFT duration summaries).
 - ``GET /debug/trace`` — the engine's lifecycle/step trace as
@@ -61,26 +68,50 @@ def _http_response(status, body, content_type="application/json",
     return ("\r\n".join(head) + "\r\n\r\n").encode() + body
 
 
-def _error_body(status, message, err_type):
-    return {"error": {"message": message, "type": err_type, "code": status}}
+def _error_body(status, message, err_type, reason=None):
+    err = {"message": message, "type": err_type, "code": status}
+    if reason is not None:
+        # machine-readable backoff hint: queue_full / kv_capacity (429 —
+        # retry this replica) vs draining / unhealthy / engine_dead (503 —
+        # the LB should prefer another replica)
+        err["reason"] = reason
+    return {"error": err}
+
+
+def _retry_after(exc, default=None):
+    """``Retry-After`` header tuple for an admission rejection, or ()."""
+    s = getattr(exc, "retry_after_s", None) or default
+    if s is None:
+        return ()
+    return (f"Retry-After: {max(1, int(round(s)))}",)
 
 
 class ServingServer:
     def __init__(self, engine, host="127.0.0.1", port=0,
                  model_name="paddle-tpu-gpt", max_waiting=64,
-                 stream_queue_size=64, default_timeout_s=None):
+                 stream_queue_size=64, default_timeout_s=None,
+                 watchdog_step_timeout_s=None, max_step_retries=3,
+                 max_kv_commit_blocks=None):
         if isinstance(engine, AsyncLLMEngine):
             if (max_waiting != 64 or stream_queue_size != 64
-                    or default_timeout_s is not None):
+                    or default_timeout_s is not None
+                    or watchdog_step_timeout_s is not None
+                    or max_step_retries != 3
+                    or max_kv_commit_blocks is not None):
                 raise ValueError(
-                    "max_waiting/stream_queue_size/default_timeout_s belong "
-                    "to the AsyncLLMEngine you passed — set them there"
+                    "max_waiting/stream_queue_size/default_timeout_s/"
+                    "watchdog_step_timeout_s/max_step_retries/"
+                    "max_kv_commit_blocks belong to the AsyncLLMEngine "
+                    "you passed — set them there"
                 )
         else:
             engine = AsyncLLMEngine(
                 engine, max_waiting=max_waiting,
                 stream_queue_size=stream_queue_size,
                 default_timeout_s=default_timeout_s,
+                watchdog_step_timeout_s=watchdog_step_timeout_s,
+                max_step_retries=max_step_retries,
+                max_kv_commit_blocks=max_kv_commit_blocks,
             )
         self.engine = engine
         self.host = host
@@ -227,9 +258,19 @@ class ServingServer:
         await writer.drain()
 
     async def _healthz(self, writer):
+        health = self.engine.health.snapshot()
         draining = self._draining or not self.engine.started
+        if not health["healthy"]:
+            # unhealthy outranks draining: the LB must see WHY the replica
+            # is out (step_stuck carries stuck_for_s from the trip; the
+            # watchdog bounds detection at timeout + one poll interval)
+            status, state = "503 Service Unavailable", "unhealthy"
+        elif draining:
+            status, state = "503 Service Unavailable", "draining"
+        else:
+            status, state = "200 OK", "ok"
         payload = {
-            "status": "draining" if draining else "ok",
+            "status": state,
             "inflight": self.engine.inflight,
             # saturation without a /metrics scrape: block-pool occupancy
             # split by tier + scheduler queue depths (plain ints read off
@@ -240,9 +281,12 @@ class ServingServer:
                 if isinstance(v, (int, float))
             },
         }
-        writer.write(_http_response(
-            "503 Service Unavailable" if draining else "200 OK", payload
-        ))
+        if not health["healthy"]:
+            payload["reason"] = health.get("reason")
+            payload.update(
+                {k: v for k, v in health.items()
+                 if k not in ("healthy", "reason")})
+        writer.write(_http_response(status, payload))
         await writer.drain()
 
     # -- /v1/completions ---------------------------------------------------
@@ -279,6 +323,11 @@ class ServingServer:
             timeout_s = spec.get("timeout_s")
             if timeout_s is not None:
                 timeout_s = float(timeout_s)
+            request_id = spec.get("request_id")
+            if request_id is not None:
+                # client-supplied correlation id (shows up in traces, the
+                # request log, and fault-plan pins); duplicates are 400s
+                request_id = str(request_id)
             trace = spec.get("trace")
             if trace is not None:
                 trace = bool(trace)
@@ -294,17 +343,24 @@ class ServingServer:
                 eos_token_id=eos, timeout_s=timeout_s, top_k=top_k,
                 top_p=top_p, spec_decoding=spec_decoding,
                 num_spec_tokens=num_spec_tokens, trace=trace,
+                request_id=request_id,
             )
         except EngineOverloadedError as e:
             writer.write(_http_response(
                 "429 Too Many Requests",
-                _error_body(429, str(e), "overloaded"),
-                extra_headers=("Retry-After: 1",),
+                _error_body(429, str(e), "overloaded",
+                            reason=getattr(e, "reason", "queue_full")),
+                extra_headers=_retry_after(e, default=1.0),
             ))
             return await writer.drain()
         except EngineClosedError as e:
+            reason = getattr(e, "reason", "draining")
             writer.write(_http_response(
-                "503 Service Unavailable", _error_body(503, str(e), "draining")
+                "503 Service Unavailable",
+                # type doubles as the reason (back-compat: clients match
+                # on "draining"); reason is the canonical field
+                _error_body(503, str(e), reason, reason=reason),
+                extra_headers=_retry_after(e),
             ))
             return await writer.drain()
         except ValueError as e:
@@ -423,6 +479,17 @@ def main(argv=None):
                    help="per-request token queue before backpressure catch-up")
     p.add_argument("--timeout-s", type=float, default=None,
                    help="default per-request deadline (aborts in-flight work)")
+    p.add_argument("--watchdog-step-timeout-s", type=float, default=None,
+                   help="stuck-step watchdog: a device step running longer "
+                        "than this flips /healthz to 503 (step_stuck), "
+                        "closes admission, and errors out live streams")
+    p.add_argument("--max-step-retries", type=int, default=3,
+                   help="consecutive unattributable step failures before "
+                        "the supervisor falls back to aborting everything")
+    p.add_argument("--max-kv-commit-blocks", type=int, default=None,
+                   help="worst-case KV admission gate: reject (429 "
+                        "kv_capacity) when admitted requests could need "
+                        "more than this many blocks at their longest")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable automatic prefix caching (same as "
                         "PADDLE_TPU_PREFIX_CACHE=0)")
@@ -467,6 +534,9 @@ def main(argv=None):
             max_waiting=args.max_waiting,
             stream_queue_size=args.stream_queue_size,
             default_timeout_s=args.timeout_s,
+            watchdog_step_timeout_s=args.watchdog_step_timeout_s,
+            max_step_retries=args.max_step_retries,
+            max_kv_commit_blocks=args.max_kv_commit_blocks,
         )
         await server.start()
         print(f"serving on http://{server.host}:{server.port} "

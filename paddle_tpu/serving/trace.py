@@ -57,6 +57,7 @@ PID_REQUESTS = 2
 # tids inside PID_ENGINE
 TID_STEPS = 0
 TID_POOL = 1
+TID_SUPERVISOR = 2
 # request lanes: tids PID_REQUESTS/[_LANE_BASE, _LANE_BASE + _NUM_LANES).
 # Lanes are reused round-robin; concurrent requests can never collide as
 # long as max_batch + max_waiting < _NUM_LANES (every event still carries
@@ -92,6 +93,8 @@ class EngineTracer(Tracer):
                           {"name": "engine-step"}),
             self._meta_ev("thread_name", PID_ENGINE, TID_POOL,
                           {"name": "block-pool"}),
+            self._meta_ev("thread_name", PID_ENGINE, TID_SUPERVISOR,
+                          {"name": "supervisor"}),
             self._meta_ev("process_name", PID_REQUESTS, 0,
                           {"name": "requests"}),
         ]
@@ -193,3 +196,10 @@ class EngineTracer(Tracer):
 
     def pool_instant(self, name, args=None):
         self.instant(name, PID_ENGINE, TID_POOL, args=args)
+
+    def supervisor_instant(self, name, args=None):
+        """Fault-injection fires, poison-bisection probes/verdicts, and
+        watchdog trips land on the ``supervisor`` track — a chaos run's
+        injected failures and the engine's recovery decisions line up
+        against the step timeline in one Perfetto view."""
+        self.instant(name, PID_ENGINE, TID_SUPERVISOR, args=args)

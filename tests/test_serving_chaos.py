@@ -1,0 +1,595 @@
+"""Chaos suite: injected faults driven through the async/HTTP serving stack.
+
+Every fault point in serving/faults.py is exercised end to end — the full
+AsyncLLMEngine / ServingServer path, not the bare engine — and every test
+closes on the standing invariants: each request terminates EXACTLY once
+with a finish reason, pool refcounts return to zero, num_free returns to
+idle capacity, and no consumer future hangs. The exactly-once check uses
+the lifecycle tracer where it matters: one closing ``request`` span per
+request id, whatever interleaving of faults, drains, and aborts ran.
+
+Fast deterministic triggers run in tier-1; the randomized soak is ``slow``.
+The synchronous supervisor mechanics are tests/test_serving_supervisor.py.
+"""
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.serving import (
+    AsyncLLMEngine,
+    EngineClosedError,
+    EngineHealth,
+    EngineOverloadedError,
+    LLMEngine,
+    ServingServer,
+    faults,
+)
+from paddle_tpu.serving.faults import FaultPlan
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, attn_impl="xla", dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    plan = faults.active()
+    if plan is not None:
+        plan.release_hangs()
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def ref_engine(model):
+    """One shared no-fault engine for reference outputs — compiling a
+    fresh pair of step programs per reference run is the dominant cost
+    of this file (warm-vs-cold parity is PR 4's tested guarantee, so
+    reuse cannot change the reference tokens)."""
+    return LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64)
+
+
+def _prompts(lengths, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, (n,)).tolist() for n in lengths]
+
+
+def _reference(ref_engine, prompts, n=6):
+    return ref_engine.generate(prompts, max_new_tokens=n, temperature=0.0)
+
+
+def _idle(engine):
+    assert engine.pool._refcount == {}
+    return engine.pool.num_free == engine.pool.num_blocks - 1
+
+
+def _engine(model, **kw):
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq_len", 64)
+    return LLMEngine(model, **kw)
+
+
+def _assert_exactly_once(engine, rids):
+    """The single-terminal-event invariant, from the lifecycle trace:
+    every traced request closed with exactly ONE ``request`` span."""
+    closes = [e["args"]["request_id"]
+              for e in engine.tracer.chrome_trace()["traceEvents"]
+              if e.get("name") == "request" and e.get("ph") == "X"]
+    for rid in rids:
+        assert closes.count(rid) == 1, (rid, closes)
+
+
+async def _http(port, method, path, obj=None):
+    """One loopback exchange; returns (status, headers, body_bytes)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    data = json.dumps(obj).encode() if obj is not None else b""
+    writer.write(
+        (f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+         f"Content-Type: application/json\r\n"
+         f"Content-Length: {len(data)}\r\n\r\n").encode() + data
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except (ConnectionError, OSError):
+        pass
+    head, _, body = raw.partition(b"\r\n\r\n")
+    lines = head.decode("latin1").split("\r\n")
+    headers = {}
+    for line in lines[1:]:
+        name, sep, value = line.partition(":")
+        if sep:
+            headers[name.strip().lower()] = value.strip()
+    return int(lines[0].split(" ")[1]), headers, body
+
+
+def _sse(body):
+    """SSE body -> (tokens, finish_reason, saw_done)."""
+    toks, reason, done = [], None, False
+    for line in body.decode().splitlines():
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            done = True
+            continue
+        choice = json.loads(payload)["choices"][0]
+        toks.extend(choice["token_ids"])
+        if choice["finish_reason"] is not None:
+            reason = choice["finish_reason"]
+    return toks, reason, done
+
+
+# -- poison isolation over HTTP/SSE -----------------------------------------
+
+
+def test_http_poison_request_isolated_streams(model, ref_engine):
+    """A step_raise pinned to one request in a mixed SSE batch: exactly
+    that stream finishes with ``error`` while every other stream
+    completes token-identical to a no-fault serve; the replica stays
+    healthy and the pool drains to idle."""
+    prompts = _prompts((5, 9, 13), seed=20)
+    refs = _reference(ref_engine, prompts)
+    faults.install(FaultPlan([
+        {"point": "step_raise", "request_id": "poison", "exc": "DeviceBoom"},
+    ]))
+    engine = _engine(model)
+
+    async def main():
+        server = await ServingServer(engine, port=0, max_waiting=8).start()
+
+        async def one(i, p):
+            rid = "poison" if i == 1 else f"r{i}"
+            return await _http(
+                server.port, "POST", "/v1/completions",
+                {"prompt": p, "max_tokens": 6, "stream": True,
+                 "request_id": rid})
+        results = await asyncio.gather(
+            *(one(i, p) for i, p in enumerate(prompts)))
+        hstatus, _, hbody = await _http(server.port, "GET", "/healthz")
+        await server.shutdown(drain=True)
+        return results, hstatus, json.loads(hbody)
+
+    results, hstatus, health = asyncio.run(main())
+    for i, (status, _, body) in enumerate(results):
+        assert status == 200
+        toks, reason, done = _sse(body)
+        assert done
+        if i == 1:
+            assert reason == "error"
+        else:
+            assert reason == "length"
+            assert toks == refs[i]
+    # one poisoned request never unhealthies the replica
+    assert hstatus == 200 and health["status"] == "ok"
+    assert engine.metrics.counters["poison_requests_isolated"] == 1
+    assert _idle(engine)
+
+
+def test_http_nonfinite_logits_contained(model, ref_engine):
+    """step_nonfinite_logits over HTTP: the pinned request's non-stream
+    response is a 500 engine_error naming nonfinite_logits; a concurrent
+    innocent completes normally."""
+    prompts = _prompts((5, 9), seed=21)
+    refs = _reference(ref_engine, prompts)
+    faults.install(FaultPlan([
+        {"point": "step_nonfinite_logits", "request_id": "poison",
+         "times": 1},
+    ]))
+    engine = _engine(model)
+
+    async def main():
+        server = await ServingServer(engine, port=0, max_waiting=8).start()
+        good, bad = await asyncio.gather(
+            _http(server.port, "POST", "/v1/completions",
+                  {"prompt": prompts[0], "max_tokens": 6,
+                   "request_id": "ok"}),
+            _http(server.port, "POST", "/v1/completions",
+                  {"prompt": prompts[1], "max_tokens": 6,
+                   "request_id": "poison"}),
+        )
+        await server.shutdown(drain=True)
+        return good, bad
+
+    (gs, _, gbody), (bs, _, bbody) = asyncio.run(main())
+    assert gs == 200
+    assert json.loads(gbody)["choices"][0]["token_ids"] == refs[0]
+    assert bs == 500
+    err = json.loads(bbody)["error"]
+    assert err["type"] == "engine_error"
+    assert "nonfinite_logits" in err["message"]
+    assert _idle(engine)
+
+
+# -- stuck step + watchdog ---------------------------------------------------
+
+
+def test_http_stuck_step_watchdog_flips_healthz(model):
+    """THE watchdog acceptance criterion: with a step_hang, /healthz goes
+    503 {"reason": "step_stuck"} within watchdog_step_timeout_s + one
+    poll interval (plus scheduling slack), every consumer receives a
+    terminal event instead of silence, new work is rejected 503
+    unhealthy, and after the hang releases the pool drains to idle."""
+    prompts = _prompts((5, 7), seed=22)
+    plan = faults.install(FaultPlan([
+        {"point": "step_hang", "at_step": 1, "timeout_s": 60.0},
+    ]))
+    engine = _engine(model)
+    timeout_s, poll_s = 0.2, 0.05
+
+    async def main():
+        server = await ServingServer(
+            engine, port=0, max_waiting=8,
+            watchdog_step_timeout_s=timeout_s,
+        ).start()
+        server.engine._watchdog.poll_s = poll_s  # deterministic cadence
+        t0 = time.monotonic()
+        stream_task = asyncio.ensure_future(_http(
+            server.port, "POST", "/v1/completions",
+            {"prompt": prompts[0], "max_tokens": 4, "stream": True}))
+        full_task = asyncio.ensure_future(_http(
+            server.port, "POST", "/v1/completions",
+            {"prompt": prompts[1], "max_tokens": 4}))
+        flipped_at = None
+        while time.monotonic() - t0 < 10.0:
+            hs, _, hb = await _http(server.port, "GET", "/healthz")
+            if hs == 503:
+                flipped_at = time.monotonic()
+                health = json.loads(hb)
+                break
+            await asyncio.sleep(0.02)
+        assert flipped_at is not None, "healthz never flipped"
+        # both consumers must get terminal events while the step is STILL
+        # hung — that is the entire point of the watchdog
+        stream_res = await asyncio.wait_for(stream_task, 10.0)
+        full_res = await asyncio.wait_for(full_task, 10.0)
+        rs, _, rb = await _http(
+            server.port, "POST", "/v1/completions",
+            {"prompt": prompts[0], "max_tokens": 2})
+        plan.release_hangs()
+        await server.shutdown(drain=True, timeout_s=10.0)
+        return (flipped_at - t0, health, stream_res, full_res, (rs, rb))
+
+    latency, health, stream_res, full_res, rej = asyncio.run(main())
+    assert health["status"] == "unhealthy"
+    assert health["reason"] == "step_stuck"
+    assert health["stuck_for_s"] >= timeout_s
+    # detection latency: timeout + one poll interval, plus generous CI
+    # scheduling slack (the bound under test is "promptly", not "30s
+    # later when the LB gives up")
+    assert latency < timeout_s + poll_s + 3.0
+    _, sreason, sdone = _sse(stream_res[2])
+    assert sdone and sreason == "error"
+    assert full_res[0] == 500
+    assert "step_stuck" in json.loads(full_res[2])["error"]["message"]
+    rs, rb = rej
+    assert rs == 503
+    assert json.loads(rb)["error"]["reason"] == "unhealthy"
+    assert engine.metrics.counters["watchdog_trips"] == 1
+    assert engine.metrics.gauges["engine_unhealthy"] == 1.0
+    assert _idle(engine)
+
+
+# -- crash-safe engine-thread exit ------------------------------------------
+
+
+def test_thread_die_crash_safe_exit(model):
+    """An exception escaping the engine LOOP (not a step): every live
+    stream gets one terminal error event, KV blocks return to the pool,
+    the engine marks unhealthy, and later submits fail fast instead of
+    enqueueing into a queue nobody drains."""
+    prompts = _prompts((5, 9), seed=23)
+    engine = _engine(model, trace=True)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine, max_waiting=8).start()
+        streams = [fe.submit(p, max_new_tokens=40, temperature=0.0,
+                             request_id=f"r{i}")
+                   for i, p in enumerate(prompts)]
+        await asyncio.sleep(0.05)          # let serving begin
+        faults.install(FaultPlan([{"point": "thread_die"}]))
+        results = await asyncio.wait_for(
+            asyncio.gather(*(s.collect() for s in streams)), 10.0)
+        # crash epilogue signalled _stopped: shutdown is near-instant
+        await asyncio.wait_for(fe.shutdown(drain=False), 10.0)
+        with pytest.raises(EngineClosedError) as ei:
+            fe.submit(prompts[0], max_new_tokens=2)
+        return results, ei.value
+
+    results, closed = asyncio.run(main())
+    for _, reason in results:
+        assert reason == "error"
+    assert not engine.metrics.counters.get("requests_finished")
+    assert closed.reason == "unhealthy"
+    assert engine.metrics.counters["engine_thread_deaths"] == 1
+    _assert_exactly_once(engine, ["r0", "r1"])
+    assert _idle(engine)
+
+
+def test_dead_thread_detected_at_submit(model):
+    """White-box: a dead engine thread that somehow left health clean
+    (e.g. teardown ordering) is still caught AT submit — reason
+    engine_dead, no silent enqueue."""
+    engine = _engine(model)
+    (p,) = _prompts((5,), seed=24)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine).start()
+        faults.install(FaultPlan([{"point": "thread_die"}]))
+        await fe._stopped.wait()
+        faults.clear()
+        # simulate the pathological case: health/closed state lost
+        fe.health = EngineHealth()
+        fe._closed = False
+        with pytest.raises(EngineClosedError) as ei:
+            fe.submit(p, max_new_tokens=2)
+        return ei.value
+
+    err = asyncio.run(main())
+    assert err.reason == "engine_dead"
+
+
+# -- drain-during-fault interleavings ---------------------------------------
+
+
+def test_drain_racing_poisoned_step(model, ref_engine):
+    """begin_drain (stop_admitting) while the supervisor is isolating a
+    poisoned request: the poison errors out exactly once, every innocent
+    completes, drain finishes, pool idle."""
+    prompts = _prompts((5, 9, 13), seed=25)
+    refs = _reference(ref_engine, prompts)
+    faults.install(FaultPlan([
+        {"point": "step_raise", "request_id": "poison"},
+    ]))
+    engine = _engine(model, trace=True)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine, max_waiting=8).start()
+        streams = []
+        for i, p in enumerate(prompts):
+            rid = "poison" if i == 0 else f"r{i}"
+            streams.append(fe.submit(p, max_new_tokens=6, temperature=0.0,
+                                     request_id=rid))
+        await asyncio.sleep(0.05)          # mid-recovery, with luck
+        fe.stop_admitting()                # the LB drain pattern
+        with pytest.raises(EngineClosedError):
+            fe.submit(prompts[0], max_new_tokens=2)
+        results = await asyncio.wait_for(
+            asyncio.gather(*(s.collect() for s in streams)), 30.0)
+        await fe.shutdown(drain=True, timeout_s=10.0)
+        return results
+
+    results = asyncio.run(main())
+    assert results[0][1] == "error"
+    for i in (1, 2):
+        toks, reason = results[i]
+        assert reason == "length" and toks == refs[i]
+    _assert_exactly_once(engine, ["poison", "r1", "r2"])
+    assert _idle(engine)
+
+
+@pytest.mark.slow
+def test_abort_racing_bisection(model):
+    """Client aborts (the poisoned request AND an innocent) racing the
+    supervisor's bisection: every stream sees exactly one terminal
+    event, nothing double-frees, pool idle."""
+    prompts = _prompts((5, 9, 13, 7), seed=26)
+    faults.install(FaultPlan([
+        {"point": "step_raise", "request_id": "poison"},
+    ]))
+    engine = _engine(model, trace=True)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine, max_waiting=8).start()
+        streams = []
+        for i, p in enumerate(prompts):
+            rid = "poison" if i == 2 else f"r{i}"
+            streams.append(fe.submit(p, max_new_tokens=8, temperature=0.0,
+                                     request_id=rid))
+        await asyncio.sleep(0.05)
+        fe.abort("poison")                 # may race the isolation verdict
+        fe.abort("r0")                     # innocent mid-flight abort
+        results = await asyncio.wait_for(
+            asyncio.gather(*(s.collect() for s in streams)), 30.0)
+        await fe.shutdown(drain=True, timeout_s=10.0)
+        return results
+
+    results = asyncio.run(main())
+    reasons = [r for _, r in results]
+    assert reasons[2] in ("error", "cancelled")    # whoever won the race
+    assert reasons[0] in ("cancelled", "length")
+    for i in (1, 3):
+        assert reasons[i] == "length"
+    _assert_exactly_once(engine, ["r0", "r1", "poison", "r3"])
+    assert _idle(engine)
+
+
+def test_watchdog_trip_during_drain(model):
+    """A step hangs WHILE draining: the watchdog still fires, consumers
+    get terminal errors (not a drain that never ends), and once the hang
+    releases the drain completes with the pool idle."""
+    prompts = _prompts((5, 9), seed=27)
+    plan = faults.install(FaultPlan([
+        {"point": "step_hang", "at_step": 2, "timeout_s": 60.0},
+    ]))
+    engine = _engine(model, trace=True)
+
+    async def main():
+        fe = await AsyncLLMEngine(
+            engine, max_waiting=8,
+            watchdog_step_timeout_s=0.2, watchdog_poll_s=0.05,
+        ).start()
+        streams = [fe.submit(p, max_new_tokens=6, temperature=0.0,
+                             request_id=f"r{i}")
+                   for i, p in enumerate(prompts)]
+        fe.stop_admitting()                # drain begins immediately
+        results = await asyncio.wait_for(
+            asyncio.gather(*(s.collect() for s in streams)), 15.0)
+        assert not fe.health.healthy       # tripped during the drain
+        plan.release_hangs()
+        await fe.shutdown(drain=True, timeout_s=10.0)
+        return results
+
+    results = asyncio.run(main())
+    for _, reason in results:
+        assert reason == "error"
+    assert engine.metrics.counters["watchdog_trips"] == 1
+    _assert_exactly_once(engine, ["r0", "r1"])
+    assert _idle(engine)
+
+
+def test_emit_path_crash_loses_no_tokens_or_terminals(model, ref_engine):
+    """A step that raises from inside the EMISSION loop (a tracer/log
+    bug) after appending tokens — the step's StepOutputs are lost. The
+    post-recovery reconciliation must still terminate the stream of a
+    request that finished inside that step (with its full token list,
+    via lossless catch-up) and re-sync partially-emitted streams."""
+    prompts = _prompts((5, 9), seed=30)
+    refs = _reference(ref_engine, prompts, n=4)
+    engine = _engine(model)
+    orig_emit = engine._emit
+    state = {"armed": True}
+
+    def bomb(req, token):
+        out = orig_emit(req, token)
+        if state["armed"] and out.finished and req.request_id == "victim":
+            state["armed"] = False          # one-shot: recovery is clean
+            raise RuntimeError("emit-path bug")
+        return out
+
+    engine._emit = bomb
+
+    async def main():
+        fe = await AsyncLLMEngine(engine, max_waiting=8).start()
+        streams = [
+            fe.submit(prompts[0], max_new_tokens=4, temperature=0.0,
+                      request_id="victim"),
+            fe.submit(prompts[1], max_new_tokens=4, temperature=0.0,
+                      request_id="other"),
+        ]
+        results = await asyncio.wait_for(
+            asyncio.gather(*(s.collect() for s in streams)), 30.0)
+        await fe.shutdown(drain=True, timeout_s=10.0)
+        return results
+
+    results = asyncio.run(main())
+    assert results[0] == (refs[0], "length")   # finished in the lost step
+    assert results[1] == (refs[1], "length")   # re-synced and completed
+    assert _idle(engine)
+
+
+# -- admission rejections: structured bodies + Retry-After -------------------
+
+
+def test_reject_bodies_distinguish_reasons(model):
+    """429 queue_full and 503 draining carry Retry-After and a
+    machine-readable error.reason; kv_capacity is its own 429 reason
+    (frontend-level — the gate is opt-in)."""
+    (p,) = _prompts((5,), seed=28)
+    engine = _engine(model, max_batch=1)
+
+    async def main():
+        server = await ServingServer(engine, port=0, max_waiting=0).start()
+        hold = asyncio.ensure_future(_http(
+            server.port, "POST", "/v1/completions",
+            {"prompt": p, "max_tokens": 48, "stream": True}))
+        await asyncio.sleep(0.05)          # in flight: queue (0) is full
+        full = await _http(server.port, "POST", "/v1/completions",
+                           {"prompt": p, "max_tokens": 2})
+        server.begin_drain()
+        drain = await _http(server.port, "POST", "/v1/completions",
+                            {"prompt": p, "max_tokens": 2})
+        hstatus, _, _ = await _http(server.port, "GET", "/healthz")
+        await hold
+        await server.shutdown(drain=True, timeout_s=10.0)
+        return full, drain, hstatus
+
+    full, drain, hstatus = asyncio.run(main())
+    status, headers, body = full
+    assert status == 429
+    assert headers.get("retry-after") == "1"
+    assert json.loads(body)["error"]["reason"] == "queue_full"
+    status, headers, body = drain
+    assert status == 503
+    assert headers.get("retry-after") == "5"
+    err = json.loads(body)["error"]
+    assert err["reason"] == "draining" and err["type"] == "draining"
+    assert hstatus == 503
+    assert _idle(engine)
+
+
+@pytest.mark.slow
+def test_kv_capacity_gate(model):
+    """max_kv_commit_blocks: admission rejects with reason kv_capacity
+    when the in-flight worst case would oversubscribe, and the
+    commitment returns when requests finish."""
+    prompts = _prompts((5, 5), seed=29)
+    engine = _engine(model)
+    need = engine.pool.blocks_for(5 + 8 - 1)
+
+    async def main():
+        fe = await AsyncLLMEngine(
+            engine, max_waiting=8, max_kv_commit_blocks=need).start()
+        st = fe.submit(prompts[0], max_new_tokens=8, temperature=0.0)
+        with pytest.raises(EngineOverloadedError) as ei:
+            fe.submit(prompts[1], max_new_tokens=8, temperature=0.0)
+        assert ei.value.reason == "kv_capacity"
+        await st.collect()
+        st2 = fe.submit(prompts[1], max_new_tokens=8, temperature=0.0)
+        toks, reason = await st2.collect()
+        await fe.shutdown(drain=True)
+        return reason
+
+    assert asyncio.run(main()) == "length"
+    assert _idle(engine)
+
+
+# -- randomized soak ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_randomized_faults(model):
+    """Seeded random faults (raises, phantom alloc failures, non-finite
+    rows) over a mixed wave: every stream terminates exactly once, and
+    the pool drains to idle whatever interleaving ran."""
+    rs = np.random.RandomState(31)
+    prompts = [rs.randint(0, 128, (int(n),)).tolist()
+               for n in rs.randint(3, 40, size=24)]
+    faults.install(FaultPlan([
+        {"point": "step_raise", "probability": 0.05, "seed": 1},
+        {"point": "alloc_fail", "probability": 0.05, "seed": 2},
+        {"point": "step_nonfinite_logits", "probability": 0.01, "seed": 3},
+        {"point": "slow_step_ms", "probability": 0.1, "seed": 4, "ms": 2},
+    ]))
+    engine = _engine(model, trace=True)
+
+    async def main():
+        fe = await AsyncLLMEngine(engine, max_waiting=32,
+                                  max_step_retries=4).start()
+        streams = [fe.submit(p, max_new_tokens=int(rs.randint(1, 12)),
+                             temperature=0.0, request_id=f"s{i}")
+                   for i, p in enumerate(prompts)]
+        results = await asyncio.wait_for(
+            asyncio.gather(*(s.collect() for s in streams)), 120.0)
+        await fe.shutdown(drain=True, timeout_s=30.0)
+        return results
+
+    results = asyncio.run(main())
+    for toks, reason in results:
+        assert reason in ("length", "error")
+    _assert_exactly_once(engine, [f"s{i}" for i in range(len(prompts))])
+    assert _idle(engine)

@@ -1,0 +1,181 @@
+"""serving/faults.py: the deterministic fault-injection plan.
+
+Pure host-side units — triggers (at_step / nth_call / probability+seed /
+request_id / times), the env-var spec, install/clear semantics, and the
+one-pointer-test discipline at every hook site. The faults driving a real
+engine are tests/test_serving_supervisor.py and test_serving_chaos.py.
+"""
+import inspect
+import re
+
+import pytest
+
+from paddle_tpu.serving import faults
+from paddle_tpu.serving.faults import FaultInjected, FaultPlan, FaultPoint
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process-global plan disarmed (and no thread
+    parked in a hang)."""
+    yield
+    plan = faults.active()
+    if plan is not None:
+        plan.release_hangs()
+    faults.clear()
+
+
+def test_disabled_by_default():
+    assert faults.active() is None
+    assert faults._PLAN is None
+
+
+def test_install_clear_roundtrip():
+    plan = faults.install(FaultPlan([{"point": "step_raise"}]))
+    assert faults.active() is plan
+    faults.clear()
+    assert faults.active() is None
+    with pytest.raises(TypeError):
+        faults.install([{"point": "step_raise"}])
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultPoint("step_explode")
+    with pytest.raises(ValueError, match="nth_call"):
+        FaultPoint("step_raise", nth_call=0)
+    with pytest.raises(ValueError, match="probability"):
+        FaultPoint("step_raise", probability=1.5)
+
+
+def test_context_triggers_rejected_on_contextless_points():
+    """alloc_fail/thread_die hook sites carry no step counter or batch:
+    arming at_step/request_id there would silently never fire, so the
+    plan rejects the combination loudly at construction."""
+    for point in ("alloc_fail", "thread_die"):
+        with pytest.raises(ValueError, match="no step/batch context"):
+            FaultPoint(point, at_step=3)
+        with pytest.raises(ValueError, match="no step/batch context"):
+            FaultPoint(point, request_id="x")
+        FaultPoint(point, nth_call=2)       # context-free triggers fine
+        FaultPoint(point, probability=0.5)
+
+
+def test_at_step_trigger_fires_exactly_at_that_step():
+    plan = FaultPlan([{"point": "step_raise", "at_step": 3}])
+    hits = [s for s in range(1, 8)
+            if plan.match("step_raise", step=s) is not None]
+    assert hits == [3]
+    assert len(plan.fired) == 1
+    assert plan.fired[0]["step"] == 3
+
+
+def test_nth_call_trigger_is_one_based():
+    plan = FaultPlan([{"point": "alloc_fail", "nth_call": 2}])
+    hits = [i for i in range(1, 6)
+            if plan.match("alloc_fail") is not None]
+    assert hits == [2]
+
+
+def test_match_request_trigger_fires_whenever_request_in_batch():
+    plan = FaultPlan([{"point": "step_raise", "request_id": "poison"}])
+    assert plan.match("step_raise", step=1, request_ids=["a", "b"]) is None
+    assert plan.match("step_raise", step=2,
+                      request_ids=["a", "poison"]) is not None
+    # unlimited by default: re-fires every time the request is present
+    assert plan.match("step_raise", step=3,
+                      request_ids=["poison"]) is not None
+    assert plan.match("step_raise", step=4, request_ids=None) is None
+
+
+def test_times_caps_total_fires():
+    plan = FaultPlan([{"point": "slow_step_ms", "times": 2, "ms": 1}])
+    fires = sum(plan.match("slow_step_ms") is not None for _ in range(5))
+    assert fires == 2
+
+
+def test_probability_trigger_is_deterministic_per_seed():
+    def draws(seed):
+        plan = FaultPlan([{"point": "step_raise", "probability": 0.3,
+                           "seed": seed}])
+        return [plan.match("step_raise") is not None for _ in range(50)]
+
+    a, b, c = draws(7), draws(7), draws(8)
+    assert a == b                      # same seed -> same fault sequence
+    assert a != c                      # different seed -> different one
+    assert 0 < sum(a) < 50             # actually Bernoulli, not const
+
+
+def test_conditions_are_anded():
+    plan = FaultPlan([{"point": "step_raise", "at_step": 2,
+                       "request_id": "x"}])
+    assert plan.match("step_raise", step=2, request_ids=["y"]) is None
+    assert plan.match("step_raise", step=3, request_ids=["x"]) is None
+    assert plan.match("step_raise", step=2, request_ids=["x"]) is not None
+
+
+def test_point_name_mismatch_never_fires():
+    plan = FaultPlan([{"point": "step_hang"}])
+    assert plan.match("step_raise", step=1) is None
+    assert plan.fired == []
+
+
+def test_hang_release_is_sticky_and_timeout_bounded():
+    plan = FaultPlan([{"point": "step_hang", "timeout_s": 0.01}])
+    fp = plan.match("step_hang")
+    plan.hang(fp)                      # returns via its own timeout
+    plan.release_hangs()
+    fp2 = plan.add("step_hang")        # no timeout, but released already
+    plan.hang(fp2)                     # passes straight through
+
+
+def test_plan_from_json_list_and_object_forms():
+    p1 = faults.plan_from_json('[{"point": "step_raise", "at_step": 1}]')
+    assert len(p1.points) == 1 and p1.points[0].at_step == 1
+    p2 = faults.plan_from_json(
+        '{"points": [{"point": "alloc_fail"}, '
+        '{"point": "slow_step_ms", "ms": 5}]}')
+    assert [fp.point for fp in p2.points] == ["alloc_fail", "slow_step_ms"]
+    assert p2.points[1].ms == 5.0
+    with pytest.raises(ValueError, match="JSON list"):
+        faults.plan_from_json('"step_raise"')
+
+
+def test_env_install_respects_existing_plan(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULTS",
+                       '[{"point": "thread_die", "nth_call": 9}]')
+    installed = faults.maybe_install_from_env()
+    assert installed is faults.active()
+    assert installed.points[0].point == "thread_die"
+    # an explicitly installed plan wins over the env on later calls
+    mine = faults.install(FaultPlan())
+    assert faults.maybe_install_from_env() is mine
+    faults.clear()
+    monkeypatch.delenv("PADDLE_TPU_FAULTS")
+    assert faults.maybe_install_from_env() is None
+
+
+def test_fault_injected_carries_point():
+    e = FaultInjected("step_raise")
+    assert e.point == "step_raise"
+    assert "step_raise" in str(e)
+
+
+def test_hook_sites_are_one_pointer_test():
+    """The disabled-path discipline (same as the tracer): every hook site
+    in the serving hot paths guards on the single module-attribute test
+    ``faults._PLAN is not None`` — no plan construction, env read, or
+    method call happens on the no-fault path."""
+    from paddle_tpu.serving import block_pool, engine, frontend
+
+    guard = re.compile(r"faults\._PLAN is not None")
+    # engine: step-scoped hooks + the two row_ok corruption sites
+    assert len(guard.findall(inspect.getsource(engine))) >= 3
+    # block pool: alloc_fail
+    assert len(guard.findall(inspect.getsource(block_pool))) >= 1
+    # frontend: thread_die in the engine loop
+    assert len(guard.findall(inspect.getsource(frontend))) >= 1
+    # and no hook site calls faults.active() (an extra function call on
+    # the hot path) — active() is the test/inspection API
+    for mod in (engine, block_pool, frontend):
+        assert "faults.active()" not in inspect.getsource(mod)
