@@ -377,6 +377,77 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     }
 
 
+def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
+    """Sharded multi-chip serve wave (serving/sharded.py) on the
+    8-fake-device CPU mesh: tp=2 and tp=4 tensor-parallel engines serve
+    the same mixed wave as a single-chip reference, reporting tok/s per
+    degree plus a ``sharded_parity: ok|mismatch`` verdict — greedy sharded
+    output must be token-for-token identical to single-chip (the parity
+    guarantee tests/test_serving_sharded.py locks in tier-1). ALWAYS runs
+    on the fake CPU host platform, even with a TPU reachable: this wave
+    certifies the sharded engine's correctness and topology plumbing, not
+    accelerator speed (`_child` forces the platform via
+    `_cpu_mesh.force_host_cpu_devices` before any jax backend init, the
+    same trick as the MULTICHIP dryrun)."""
+    del on_tpu  # forced to the fake CPU mesh by _child
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import LLMEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=256, attn_impl="xla")
+    model = GPT(cfg)
+    model.eval()
+    rs = np.random.RandomState(0)
+    lens = (24, 60, 100, 40)
+    prompts = [rs.randint(0, cfg.vocab_size, (n,)).tolist() for n in lens]
+    max_new = 8 if _fast() else 16
+
+    def wave(mesh):
+        eng = LLMEngine(model, block_size=16, max_batch=4, mesh=mesh)
+        # warm: compiles the mixed + decode programs outside the timing
+        eng.generate([prompts[0]], max_new_tokens=2, temperature=0.0)
+        t0_tok = eng.metrics.counters["generated_tokens"]
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=max_new,
+                            temperature=0.0)
+        dt = time.perf_counter() - t0
+        toks = eng.metrics.counters["generated_tokens"] - t0_tok
+        return outs, (toks / dt if dt > 0 else 0.0), eng
+
+    # mesh=1 is the EXPLICIT single-chip request: a PADDLE_TPU_TP env
+    # left set must not shard the reference and make parity vacuous
+    ref_outs, ref_tok_s, _ = wave(1)
+    out = {"n_devices": len(jax.devices()),
+           "max_new_tokens": max_new,
+           "requests": len(lens),
+           "tok_s_single": round(ref_tok_s, 1)}
+    parity_all = "ok"
+    for tp in (2, 4):
+        if time.monotonic() > deadline_s:
+            errors.append(f"gpt_serve_multichip: deadline before tp={tp}")
+            break
+        outs, tok_s, eng = wave(tp)
+        parity = "ok" if outs == ref_outs else "mismatch"
+        if parity != "ok":
+            parity_all = "mismatch"
+            errors.append(f"gpt_serve_multichip: tp={tp} greedy output "
+                          "diverged from single-chip")
+        out[f"tp{tp}_tok_s"] = round(tok_s, 1)
+        out[f"tp{tp}_sharded_parity"] = parity
+        out[f"tp{tp}_mesh"] = eng.mesh_info()
+        _log(f"multichip serve tp={tp}: {tok_s:.1f} tok/s "
+             f"sharded_parity: {parity}")
+    if "tp2_tok_s" not in out:
+        return None
+    out["value"] = out["tp2_tok_s"]
+    out["sharded_parity"] = parity_all
+    return out
+
+
 def _serve_shared_prefix(model, cfg, max_batch, rs, errors, deadline_s,
                          on_tpu):
     """Shared-system-prompt wave: N requests = one long common prefix +
@@ -756,6 +827,7 @@ def bench_lenet(on_tpu, errors, deadline_s):
 _BENCHES = {
     "gpt": bench_gpt,
     "gpt_serve": bench_gpt_serve,
+    "gpt_serve_multichip": bench_gpt_serve_multichip,
     "resnet50": bench_resnet50,
     "lenet": bench_lenet,
     "ppyoloe": bench_ppyoloe,
@@ -764,6 +836,14 @@ _BENCHES = {
 
 def _child(name, soft_deadline_s):
     """Run ONE benchmark and print its JSON on the last line."""
+    if name == "gpt_serve_multichip":
+        # the sharded wave ALWAYS runs on the 8-fake-device CPU host
+        # platform — flip it before any jax backend init (the env var
+        # alone is not enough; same trick as tests/conftest.py)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from _cpu_mesh import force_host_cpu_devices
+
+        force_host_cpu_devices(8)
     import jax
 
     # (persistent compile cache is enabled by paddle_tpu at import —
@@ -910,6 +990,16 @@ def main():
     if serve:
         completed += 1
         extras["gpt_serve"] = serve
+
+    # sharded serve wave: tp=2/tp=4 tok/s + single-chip parity verdict on
+    # the fake CPU mesh (correctness plumbing, not accelerator speed)
+    r = _run_isolated("gpt_serve_multichip", min(240.0, _remaining()))
+    errors.extend(r.get("errors") or [])
+    mc = _emit_model("gpt_serve_multichip", r, "tokens/sec",
+                     metric="gpt_serve_multichip_tokens_per_sec")
+    if mc:
+        completed += 1
+        extras["gpt_serve_multichip"] = mc
 
     units = {"resnet50": "samples/sec", "ppyoloe": "ms", "lenet": "ms"}
     for name in ("resnet50", "ppyoloe", "lenet"):

@@ -13,6 +13,9 @@ compiled train step, and the eager collective API.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import numpy as np
 
 import jax
@@ -22,6 +25,22 @@ AXES = ("dp", "pp", "sharding", "mp", "sp")
 
 _GLOBAL_MESH = None
 _GLOBAL_TOPOLOGY = None
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_mesh():
+    """Make `get_mesh()` return None in THIS THREAD for the duration —
+    without touching the process-global mesh other threads may be tracing
+    against. The serving engine wraps its traced forward in this: its
+    sharding is fully explicit (in_shardings + PagedState.constrain), so
+    the TP layers' training-mesh constraints must not leak in, while a
+    concurrent training trace on another thread keeps its mesh."""
+    _TLS.suppress = getattr(_TLS, "suppress", 0) + 1
+    try:
+        yield
+    finally:
+        _TLS.suppress -= 1
 
 
 def build_mesh(degrees: dict, devices=None) -> Mesh:
@@ -49,6 +68,8 @@ def set_mesh(mesh: Mesh):
 
 
 def get_mesh() -> Mesh | None:
+    if getattr(_TLS, "suppress", 0):
+        return None
     return _GLOBAL_MESH
 
 

@@ -72,10 +72,16 @@ class CausalSelfAttention(nn.Layer):
     def forward(self, x, cache=None):
         b, s, _ = x.shape
         qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded on last dim)
-        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
-        q = M.squeeze(M.slice(qkv, [2], [0], [1]), 2)
-        k = M.squeeze(M.slice(qkv, [2], [1], [2]), 2)
-        v = M.squeeze(M.slice(qkv, [2], [2], [3]), 2)
+        # per-head-grouped fused QKV (the Megatron column order): column
+        # block for head i is its contiguous [q_i, k_i, v_i], so a
+        # contiguous tp shard of the 3h axis IS a head group — head-
+        # sharding the split q/k/v costs no cross-chip realignment in the
+        # tensor-parallel serving path (a [b,s,3,heads,hd] order would
+        # put all Q heads first and force an all-to-all per layer)
+        qkv = M.reshape(qkv, [b, s, self.num_heads, 3, self.head_dim])
+        q = M.squeeze(M.slice(qkv, [3], [0], [1]), 3)
+        k = M.squeeze(M.slice(qkv, [3], [1], [2]), 3)
+        v = M.squeeze(M.slice(qkv, [3], [2], [3]), 3)
         if cache is not None and getattr(cache, "is_paged", False):
             # serving path: K/V live in the global block arena and are
             # attended through this sequence's block table (vLLM-style
@@ -256,6 +262,14 @@ class GPT(nn.Layer):
         if caches is None:
             logits = _constraint(logits, "dp", "sp", "mp")
             return logits
+        if paged and getattr(caches, "mesh", None) is not None:
+            # tensor-parallel serving (serving/sharded.py): keep the LM
+            # head column-parallel — logits stay vocab-sharded on tp out
+            # of the matmul; the sampler's argmax/top-k gather is the one
+            # place the full vocab row materializes
+            logits = Tensor._from_op(
+                caches.constrain(logits._array, None, None, "tp")
+            )
         return logits, (caches if paged else new_caches)
 
     def init_caches(self, batch_size, max_len, dtype=None):

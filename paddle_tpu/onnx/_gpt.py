@@ -60,11 +60,14 @@ def emit_gpt(em, model, ids_name, seq_len):
 
     def attention(attn, x):
         qkv = linear(attn.qkv, x)  # [N, S, 3H]
-        qkv = em.node("Reshape", [qkv, em.init_i64("shape", [0, 0, 3, nh, hd])])
-        q, k, v = em.node("Split", [qkv], n_out=3, axis=2, num_outputs=3)
-        q = em.node("Squeeze", [q, em.init_i64("axes", [2])])
-        k = em.node("Squeeze", [k, em.init_i64("axes", [2])])
-        v = em.node("Squeeze", [v, em.init_i64("axes", [2])])
+        # per-head-grouped fused-QKV column order — [N, S, nh, 3, hd],
+        # split on the qkv axis — matching CausalSelfAttention.forward
+        # (the grouping that lets tp shards of the 3H axis be head groups)
+        qkv = em.node("Reshape", [qkv, em.init_i64("shape", [0, 0, nh, 3, hd])])
+        q, k, v = em.node("Split", [qkv], n_out=3, axis=3, num_outputs=3)
+        q = em.node("Squeeze", [q, em.init_i64("axes", [3])])
+        k = em.node("Squeeze", [k, em.init_i64("axes", [3])])
+        v = em.node("Squeeze", [v, em.init_i64("axes", [3])])
         # [N, S, nh, hd] -> [N, nh, S, hd]
         q = em.node("Transpose", [q], perm=[0, 2, 1, 3])
         k = em.node("Transpose", [k], perm=[0, 2, 1, 3])

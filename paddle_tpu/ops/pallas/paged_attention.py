@@ -208,15 +208,59 @@ def ragged_paged_attention(q, k_arena, v_arena, layer, block_tables,
 # dispatch — the seam serving/block_pool.py calls
 # ---------------------------------------------------------------------------
 
+def ragged_paged_attention_sharded(q, k_arena, v_arena, layer, block_tables,
+                                   q_start, kv_live, mesh, tp_axis="tp",
+                                   interpret=False):
+    """Per-shard dispatch of the single-device ragged kernel on a tp mesh.
+
+    The kernel walks one (row, head, block) grid and DMAs (head, block)
+    tiles out of the local arena — it has no concept of a mesh. Under
+    `shard_map` over the head axis each shard sees exactly its local
+    slice: q ``[B, S, H/tp, D]`` and arenas ``[layers, H/tp, blocks,
+    block_size, head_dim]``, with the block table / ragged metadata
+    replicated (block ids are global, shard-invariant host bookkeeping).
+    Heads never mix across chips inside attention, so the per-shard
+    outputs concatenate with NO collective here — the tp all-reduce
+    happens where the layout demands it, on the output-projection matmul
+    that follows (serving/sharded.py documents the full layout)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel._compat import shard_map
+
+    def local(qh, ka, va, bt, qs, kl):
+        return ragged_paged_attention(qh, ka, va, layer, bt, qs, kl,
+                                      interpret=interpret)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, None, tp_axis, None), P(None, tp_axis),
+                  P(None, tp_axis), P(), P(), P()),
+        out_specs=P(None, None, tp_axis, None),
+    )
+    # raw metadata passes through; ragged_paged_attention normalizes
+    # (int32 casts + the >=1 kv_live clamp) per shard — one canonical site
+    return fn(q, k_arena, v_arena, block_tables, q_start, kv_live)
+
+
 def paged_attention_arrays(q, k_arena, v_arena, layer, block_tables, qpos,
-                           q_start=None, kv_live=None, scale=None):
+                           q_start=None, kv_live=None, scale=None,
+                           mesh=None, tp_axis="tp"):
     """Attend q through the block table: Pallas ragged kernel when the
-    backend gate and the ragged metadata allow it, XLA gather otherwise."""
+    backend gate and the ragged metadata allow it, XLA gather otherwise.
+    With a `mesh` (tensor-parallel serving, serving/sharded.py) the Pallas
+    path runs per-shard over the head axis via `shard_map`; the XLA
+    fallback needs no wrapper — GSPMD partitions the padded gather over
+    the arena's head sharding on its own."""
     if (
         q_start is not None and kv_live is not None
         and scale is None  # kernel bakes 1/sqrt(D); custom scales fall back
         and use_pallas()
     ):
+        if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
+            return ragged_paged_attention_sharded(
+                q, k_arena, v_arena, layer, block_tables, q_start, kv_live,
+                mesh, tp_axis=tp_axis, interpret=interpret_mode(),
+            )
         return ragged_paged_attention(
             q, k_arena, v_arena, layer, block_tables, q_start, kv_live,
             interpret=interpret_mode(),

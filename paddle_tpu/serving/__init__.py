@@ -18,6 +18,14 @@ with ``LLMEngine(spec_decoding=True)`` or ``PADDLE_TPU_SPEC_DECODE=1`` to
 score up to ``num_spec_tokens + 1`` decode positions per step; greedy
 outputs stay token-for-token identical to non-speculative decode.
 
+**Tensor-parallel serving** (serving/sharded.py): pass ``mesh=N`` (or a
+`build_serving_mesh` handle, or ``PADDLE_TPU_TP=N``) to shard weights and
+the head-major KV arena over a ``tp`` NamedSharding mesh — attention
+heads and FFN columns on ``tp``, block tables/scheduler/prefix-cache
+refcounts host-side and unchanged, still exactly three compiled
+programs. Greedy sharded output is token-for-token identical to the
+single-chip engine. See README "Sharded serving".
+
 Quickstart::
 
     from paddle_tpu.models.gpt import gpt_tiny
@@ -65,6 +73,13 @@ from .frontend import (  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
 from .server import ServingServer  # noqa: F401
+from .sharded import (  # noqa: F401
+    ServingMesh,
+    as_serving_mesh,
+    build_serving_mesh,
+    kv_capacity_blocks,
+    serving_param_specs,
+)
 from .spec import NgramDrafter, apply_top_k_top_p  # noqa: F401
 from .supervisor import (  # noqa: F401
     EngineHealth,

@@ -37,12 +37,37 @@ the scheduler's `_ensure_writable`).
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 from collections import OrderedDict
 
 import numpy as np
 
 from . import faults
+
+
+def blocks_for(num_tokens, block_size):
+    """KV blocks `num_tokens` tokens occupy (>= 1) — THE worst-case
+    ceiling formula: `BlockPool.blocks_for` and the engine's
+    construction-time `kv_hbm_bytes` gate (which runs before the pool
+    exists) both delegate here so admission and construction bounds can
+    never drift apart."""
+    return max(1, -(-int(num_tokens) // int(block_size)))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_zeros_fn(shape, dtype_name, sharding):
+    """Compiled sharded-zeros builder, cached per (shape, dtype,
+    sharding): allocates an arena SHARDED from the start — eager zeros +
+    device_put would materialize the full logical arena on the default
+    chip first, and under a per-chip ``kv_hbm_bytes`` budget the logical
+    arena is tp x one chip's HBM (OOM at construction on real
+    accelerators)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda: jnp.zeros(shape, dtype_name),
+                   out_shardings=sharding)
 
 
 def chain_block_hashes(token_ids, block_size):
@@ -100,12 +125,16 @@ class PagedState:
                     kernel metadata; chunk tokens are consecutive)
       kv_live       [B] int32 — live KV blocks per row (>= 1); the ragged
                     kernel walks exactly this many blocks
+
+    `mesh` (static, not an array) is the tensor-parallel serving mesh
+    (serving/sharded.py) or None: it selects the per-shard Pallas dispatch
+    and lets `constrain` pin traced activations to the tp layout.
     """
 
     is_paged = True
 
     def __init__(self, k, v, block_tables, slots, offs, qpos,
-                 q_start=None, kv_live=None):
+                 q_start=None, kv_live=None, mesh=None):
         self.k = k
         self.v = v
         self.block_tables = block_tables
@@ -114,9 +143,24 @@ class PagedState:
         self.qpos = qpos
         self.q_start = q_start
         self.kv_live = kv_live
+        self.mesh = mesh
 
     def layer(self, i):
         return PagedLayerView(self, i)
+
+    def constrain(self, arr, *spec):
+        """`with_sharding_constraint` on the serving mesh — the explicit
+        tp layout pin for serving activations (heads axis of per-step
+        K/V/Q, vocab axis of the logits). A no-op single-chip, so the
+        unsharded engine traces byte-identical programs."""
+        if self.mesh is None:
+            return arr
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(self.mesh, PartitionSpec(*spec))
+        )
 
 
 def paged_attention(q, k_new, v_new, view, scale=None):
@@ -130,6 +174,13 @@ def paged_attention(q, k_new, v_new, view, scale=None):
     from ..ops.pallas.paged_attention import paged_attention_arrays
 
     st, layer = view.state, view.layer
+    if st.mesh is not None:
+        # tensor-parallel serving: pin the step's new K/V (and q) to the
+        # head sharding BEFORE the scatter, so GSPMD writes each chip's
+        # own head slab of the arena instead of inventing a gather
+        q = st.constrain(q, None, None, "tp", None)
+        k_new = st.constrain(k_new, None, None, "tp", None)
+        v_new = st.constrain(v_new, None, None, "tp", None)
     # scatter the step's K/V rows into their (block, offset) homes; padded
     # and inactive rows carry slot 0 (the null block). The advanced indices
     # (layer, slots, offs) are separated by the head-axis slice, so the
@@ -138,7 +189,7 @@ def paged_attention(q, k_new, v_new, view, scale=None):
     st.v = st.v.at[layer, :, st.slots, st.offs].set(v_new.astype(st.v.dtype))
     return paged_attention_arrays(
         q, st.k, st.v, layer, st.block_tables, st.qpos,
-        q_start=st.q_start, kv_live=st.kv_live, scale=scale,
+        q_start=st.q_start, kv_live=st.kv_live, scale=scale, mesh=st.mesh,
     )
 
 
@@ -160,7 +211,8 @@ class BlockPool:
     """
 
     def __init__(self, num_blocks, num_layers, block_size, num_heads,
-                 head_dim, dtype=None, metrics=None, tracer=None):
+                 head_dim, dtype=None, metrics=None, tracer=None,
+                 sharding=None):
         import jax.numpy as jnp
 
         if num_blocks < 2:
@@ -170,8 +222,20 @@ class BlockPool:
         shape = (num_layers, num_heads, self.num_blocks, self.block_size,
                  head_dim)
         dt = dtype or jnp.float32
-        self.k = jnp.zeros(shape, dt)
-        self.v = jnp.zeros(shape, dt)
+        # `sharding` (tensor-parallel serving, serving/sharded.py): a
+        # NamedSharding placing the head axis over tp — each chip owns its
+        # heads' slab of every block. ALL host bookkeeping below (free
+        # lists, refcounts, hashes) stays per-LOGICAL-block and identical
+        # to the single-chip pool: sharding changes where bytes live,
+        # never which block ids exist.
+        self._sharding = sharding
+        if sharding is None:
+            self.k = jnp.zeros(shape, dt)
+            self.v = jnp.zeros(shape, dt)
+        else:
+            zeros = _sharded_zeros_fn(shape, str(jnp.dtype(dt)), sharding)
+            self.k = zeros()
+            self.v = zeros()
         # block 0 reserved as the null/scratch block
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._refcount = {}           # block -> holders (held blocks only)
@@ -201,7 +265,7 @@ class BlockPool:
 
     def blocks_for(self, num_tokens):
         """How many blocks a sequence of `num_tokens` tokens needs."""
-        return max(1, -(-int(num_tokens) // self.block_size))
+        return blocks_for(num_tokens, self.block_size)
 
     def refcount(self, block):
         """Holders of `block` (0 = free or cached-free)."""
@@ -335,8 +399,18 @@ class BlockPool:
                 return (k.at[:, :, d].set(k[:, :, s]),
                         v.at[:, :, d].set(v[:, :, s]))
 
-            # jaxlint: disable=JL004 -- COW scatter donates the single-device KV arenas in place; gating would materialize a full arena copy per COW on CPU (see docstring)
-            self._copy_fn = jax.jit(_copy, donate_argnums=(0, 1))
+            if self._sharding is not None:
+                # sharded arenas: donation MUST route through the JL004
+                # gate — the host-platform CPU mesh miscompiles donated
+                # sharded buffers, real accelerators keep the in-place
+                # scatter
+                from ..parallel.spmd import mesh_donate_argnums
+
+                self._copy_fn = jax.jit(
+                    _copy, donate_argnums=mesh_donate_argnums((0, 1)))
+            else:
+                # jaxlint: disable=JL004 -- COW scatter donates the single-device KV arenas in place; gating would materialize a full arena copy per COW on CPU (see docstring)
+                self._copy_fn = jax.jit(_copy, donate_argnums=(0, 1))
         self.k, self.v = self._copy_fn(
             self.k, self.v, jnp.asarray(src, jnp.int32),
             jnp.asarray(dst, jnp.int32),

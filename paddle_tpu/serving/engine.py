@@ -44,6 +44,15 @@ tier. A cache-hit serve is token-for-token identical to a cold serve
 (tests/test_prefix_cache.py): reused blocks hold exactly the K/V a replay
 would recompute, and writes into shared blocks copy-on-write first.
 
+**Tensor-parallel serving** (``mesh=...`` / ``PADDLE_TPU_TP``,
+serving/sharded.py): weights and the head-major KV arena shard over a
+``tp`` NamedSharding mesh — the same three programs compile mesh-aware
+(weights/arena pinned to their tp layouts, host-marshalled step inputs
+replicated, arena donation through the ``mesh_donate_argnums`` gate),
+while block tables, scheduler, prefix cache, and refcounts stay host-side
+and identical to the single-chip engine. Greedy sharded output is
+token-for-token identical to single-chip serving.
+
 **Fault tolerance**: the step programs report per-row logit finiteness,
 and a NaN/Inf row is aborted with ``error:nonfinite_logits`` (its blocks
 never published to the prefix cache) instead of sampling garbage —
@@ -80,7 +89,8 @@ import numpy as np
 
 from ..core.functional import functional_call, state_dict_arrays
 from . import faults
-from .block_pool import BlockPool, PagedState, chain_block_hashes
+from .block_pool import (BlockPool, PagedState, blocks_for,
+                         chain_block_hashes)
 from .faults import FaultInjected
 from .metrics import ServingMetrics
 from .scheduler import WAITING, Request, Scheduler
@@ -103,12 +113,25 @@ class LLMEngine:
                  prefill_buckets=None, prefill_interval=None, seed=0,
                  prefix_cache=None, spec_decoding=None, num_spec_tokens=4,
                  spec_max_ngram=3, spec_min_ngram=1, trace=None,
-                 trace_buffer=None, request_log=None):
+                 trace_buffer=None, request_log=None, mesh=None,
+                 kv_hbm_bytes=None):
         import jax
+
+        from .sharded import as_serving_mesh, kv_capacity_blocks
 
         model.eval()
         self.model = model
         cfg = model.cfg
+        # tensor-parallel serving (serving/sharded.py): `mesh` is a
+        # ServingMesh / jax Mesh with a 'tp' axis / int tp degree; the
+        # PADDLE_TPU_TP env var supplies a default degree when unset.
+        # None (degree 1) keeps the single-chip engine byte-identical.
+        if mesh is None:
+            env_tp = int(os.environ.get("PADDLE_TPU_TP", "1") or 1)
+            mesh = env_tp if env_tp > 1 else None
+        self._smesh = as_serving_mesh(mesh)
+        if self._smesh is not None:
+            self._smesh.validate_model(cfg)
         self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
         if self.max_seq_len > cfg.max_seq_len:
             raise ValueError(
@@ -118,6 +141,41 @@ class LLMEngine:
         self.block_size = int(block_size)
         self.max_blocks = -(-self.max_seq_len // self.block_size)
         self.max_batch = int(max_batch)
+        if kv_hbm_bytes is not None:
+            if num_blocks is not None:
+                raise ValueError(
+                    "pass num_blocks OR kv_hbm_bytes, not both — the byte "
+                    "budget would be silently ignored"
+                )
+            # size the pool from a PER-CHIP byte budget. The arena is
+            # head-sharded under tp, so one shard stores heads/tp per
+            # block and the budget buys tp x the logical-head-count
+            # formula's blocks — capacity (and therefore `validate`'s
+            # admission bound) is derived from what ONE SHARD holds.
+            dt_probe = model.wte.weight._array.dtype
+            num_blocks = kv_capacity_blocks(
+                kv_hbm_bytes, cfg.num_layers, cfg.num_heads,
+                self.block_size, cfg.hidden_size // cfg.num_heads,
+                dt_probe.itemsize,
+                tp_degree=(1 if self._smesh is None
+                           else self._smesh.tp_degree),
+            )
+            # validate()'s worst case for a max-length request: every
+            # token but the final sampled one is cached — the gate must
+            # mirror that bound exactly or it rejects budgets admission
+            # would serve (blocks_for is the ONE ceiling formula; the
+            # pool doesn't exist yet, so use the module-level form)
+            worst = blocks_for(self.max_seq_len - 1, self.block_size)
+            if num_blocks < 1 + worst:
+                # too small to hold even ONE max-length sequence (+null):
+                # fail at construction naming the budget, not per-request
+                raise ValueError(
+                    f"kv_hbm_bytes {kv_hbm_bytes} buys only {num_blocks} "
+                    f"KV blocks per shard but one max_seq_len="
+                    f"{self.max_seq_len} sequence needs {worst} (+ the "
+                    "null block) — raise the budget, lower max_seq_len, "
+                    "or raise tp_degree"
+                )
         if num_blocks is None:
             # enough for a full decode batch of max-length sequences (+null)
             num_blocks = self.max_batch * self.max_blocks + 1
@@ -187,12 +245,46 @@ class LLMEngine:
             if request_log is None else bool(request_log)
         )
         self._params, self._buffers = state_dict_arrays(model)
+        self._param_shardings = self._buffer_shardings = None
+        if self._smesh is not None:
+            # place weights once at construction: attention heads / FFN
+            # columns / vocab rows over 'tp' (serving_param_specs is the
+            # model's own Megatron sharding_axes renamed mp -> tp),
+            # everything unannotated replicated. The step programs then
+            # pin these layouts via in_shardings — placement never
+            # re-happens per step.
+            from .sharded import serving_param_specs
+
+            specs = serving_param_specs(model, self._smesh)
+            self._param_shardings = {
+                k: self._smesh.named(*specs[k]) for k in self._params
+            }
+            self._buffer_shardings = {
+                k: self._smesh.replicated() for k in self._buffers
+            }
+            self._params = {
+                k: jax.device_put(v, self._param_shardings[k])
+                for k, v in self._params.items()
+            }
+            self._buffers = {
+                k: jax.device_put(v, self._buffer_shardings[k])
+                for k, v in self._buffers.items()
+            }
         dt = model.wte.weight._array.dtype
         self.pool = BlockPool(
             num_blocks, cfg.num_layers, self.block_size, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads, dtype=dt,
             metrics=self.metrics, tracer=self.tracer,
+            sharding=(None if self._smesh is None
+                      else self._smesh.arena_sharding()),
         )
+        # mesh topology gauges: a replica's shape is visible on /metrics
+        # and /healthz without log-diving (single-chip engines report
+        # tp_degree 1 so dashboards need no sharded-or-not special case)
+        mi = self.mesh_info()
+        self.metrics.set_gauge("mesh_tp_degree", mi["tp_degree"])
+        self.metrics.set_gauge("mesh_device_count", mi["device_count"])
+        self.metrics.set_info("mesh", {"backend": mi["backend"]})
         self.scheduler = Scheduler(
             self.pool, max_batch=self.max_batch,
             token_budget=int(token_budget),
@@ -240,17 +332,40 @@ class LLMEngine:
                       num_spec_tokens=num_spec_tokens, trace=trace)
         return self.add(req)
 
+    def mesh_info(self):
+        """Topology of this replica — {tp_degree, device_count, backend} —
+        for /healthz, the ``mesh_*`` gauges, and benches. Single-chip
+        engines report degree/count 1 on the default backend."""
+        if self._smesh is not None:
+            return self._smesh.info()
+        import jax
+
+        return {"tp_degree": 1, "device_count": 1,
+                "backend": jax.default_backend()}
+
+    def kv_capacity_blocks(self):
+        """Usable KV blocks — what ONE SHARD of the arena actually holds
+        (minus the null block). Under tp the arena is head-sharded, so a
+        per-chip byte budget (``kv_hbm_bytes``) buys ``tp_degree`` times
+        the blocks of the naive logical-head-count formula; the pool's
+        ``num_blocks`` is already derived per-shard at construction, and
+        every admission bound (`validate`, hence the frontend's
+        ``max_kv_commit_blocks`` gate) must reject against THIS number,
+        never a logical-head recomputation."""
+        return self.pool.num_blocks - 1
+
     def validate(self, req):
         """Admission-time request validation, shared by `add` and the async
         frontend's `submit` (which must reject bad requests BEFORE they
         reach the engine thread). Raises ValueError on a request that could
         never complete: too long for the model, or needing more KV blocks
-        at its worst case than the pool owns — without this check such a
-        request is accepted, becomes the oldest running sequence, and the
-        scheduler's no-livelock error then kills the whole serve instead
-        of the one offender. Returns the request's worst-case KV block
-        need (the frontend's ``max_kv_commit_blocks`` gate reuses it —
-        ONE definition of worst case)."""
+        at its worst case than one arena shard holds (`kv_capacity_blocks`
+        — per-shard under tp, NOT a logical-head-count formula) — without
+        this check such a request is accepted, becomes the oldest running
+        sequence, and the scheduler's no-livelock error then kills the
+        whole serve instead of the one offender. Returns the request's
+        worst-case KV block need (the frontend's ``max_kv_commit_blocks``
+        gate reuses it — ONE definition of worst case)."""
         if req.num_tokens + req.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"request {req.request_id}: prompt {req.num_tokens} + "
@@ -259,10 +374,10 @@ class LLMEngine:
             )
         # worst-case cached tokens: everything but the final sampled token
         need = self.pool.blocks_for(req.num_tokens + req.max_new_tokens - 1)
-        if need > self.pool.num_blocks - 1:
+        if need > self.kv_capacity_blocks():
             raise ValueError(
                 f"request {req.request_id}: needs up to {need} KV blocks "
-                f"but the pool only has {self.pool.num_blocks - 1} usable "
+                f"but the pool only has {self.kv_capacity_blocks()} usable "
                 "— raise num_blocks or shorten the request"
             )
         return need
@@ -371,16 +486,31 @@ class LLMEngine:
         model = self.model
         metrics = self.metrics
 
+        smesh = self._smesh
+
         def forward(params, buffers, k_arena, v_arena, ids, block_tables,
                     slots, offs, qpos, q_start, kv_live):
             # runs at TRACE time only — the test's recompile alarm
             metrics.inc("jit_traces")
             state = PagedState(k_arena, v_arena, block_tables, slots, offs,
-                               qpos, q_start=q_start, kv_live=kv_live)
-            (logits, _), _ = functional_call(
-                model, params, buffers, args=(ids,),
-                kwargs={"caches": state}, training=False,
-            )
+                               qpos, q_start=q_start, kv_live=kv_live,
+                               mesh=None if smesh is None else smesh.mesh)
+            # mask the process-global TRAINING mesh for the trace (thread-
+            # local — a concurrent training trace on another thread keeps
+            # its mesh): the serving step's sharding is fully explicit
+            # (in_shardings + PagedState.constrain), but the TP layers'
+            # dp/mp sharding constraints consult
+            # distributed.mesh.get_mesh() — a mesh left installed by
+            # fleet.init/init_mesh would stamp its (differently-deviced)
+            # NamedShardings into this program and the call would reject
+            # the engine's own placement
+            from ..distributed.mesh import suppress_mesh
+
+            with suppress_mesh():
+                (logits, _), _ = functional_call(
+                    model, params, buffers, args=(ids,),
+                    kwargs={"caches": state}, training=False,
+                )
             return logits, state
 
         def step(params, buffers, k_arena, v_arena, ids, block_tables,
@@ -423,9 +553,29 @@ class LLMEngine:
             )
             return accept, out_tok, row_ok, state.k, state.v
 
-        fn = jax.jit(verify if kind == "verify" else step,
-                     # jaxlint: disable=JL004 -- serving step donates the single-device KV arenas (unsharded); gating would copy the whole arena every step on CPU
-                     donate_argnums=(2, 3))
+        if smesh is None:
+            fn = jax.jit(verify if kind == "verify" else step,
+                         # jaxlint: disable=JL004 -- serving step donates the single-device KV arenas (unsharded); gating would copy the whole arena every step on CPU
+                         donate_argnums=(2, 3))
+        else:
+            # mesh-aware program, same (B, S, kind) keying: weights and
+            # arenas pinned to their tp shardings, every host-marshalled
+            # step input (and the sampled tokens out) replicated. Arena
+            # donation routes through the JL004 gate — the host-platform
+            # CPU mesh miscompiles donated sharded buffers, so donation
+            # is off exactly there and in-place on real accelerators.
+            from ..parallel.spmd import mesh_donate_argnums
+
+            rep = smesh.replicated()
+            arena = smesh.arena_sharding()
+            host_in = (rep,) * 12  # ids..key marshalling args + PRNG key
+            in_sh = (self._param_shardings, self._buffer_shardings,
+                     arena, arena) + host_in
+            out_sh = ((rep, rep, rep, arena, arena) if kind == "verify"
+                      else (rep, rep, arena, arena))
+            fn = jax.jit(verify if kind == "verify" else step,
+                         in_shardings=in_sh, out_shardings=out_sh,
+                         donate_argnums=mesh_donate_argnums((2, 3)))
         self._step_fns[(B, S, kind)] = fn
         return fn
 

@@ -118,6 +118,10 @@ _HELP = {
                         "thread death), else 0",
     "requests_cancelled": "Requests aborted via the frontend",
     "requests_timeout": "Requests aborted by their deadline",
+    "mesh_tp_degree": "Tensor-parallel degree of this replica's serving "
+                      "mesh (1 = single-chip)",
+    "mesh_device_count": "Devices in this replica's serving mesh",
+    "mesh": "Serving mesh topology labels (backend)",
 }
 
 
@@ -133,6 +137,7 @@ class ServingMetrics:
     def __init__(self, max_intervals=4096):
         self.counters = defaultdict(float)
         self.gauges = {}
+        self.infos = {}   # name -> {label: value} (constant-1 info series)
         # name -> running stats + a bounded recent window for percentiles
         # (a long-running engine must not grow per-step history without
         # bound — same reason _intervals is capped)
@@ -147,6 +152,13 @@ class ServingMetrics:
 
     def set_gauge(self, name, value):
         self.gauges[name] = value
+
+    def set_info(self, name, labels):
+        """Record an info-style series: constant value 1 with string
+        labels (the Prometheus ``*_info`` convention — how non-numeric
+        facts like the mesh backend reach a scraper). Exported as
+        ``<prefix>_<name>_info{label="value",...} 1``."""
+        self.infos[name] = {str(k): str(v) for k, v in dict(labels).items()}
 
     def observe(self, name, seconds, start=None, interval=True):
         """Record one timed operation (a mixed or decode step). Pass
@@ -236,6 +248,19 @@ class ServingMetrics:
             m = _n(name)
             _header(m, name, "gauge")
             lines.append(f"{m} {float(gauges[name]):g}")
+        def _lv(v):
+            # exposition-format label escaping: a raw quote/backslash/
+            # newline in a label value would invalidate the WHOLE scrape
+            return (v.replace("\\", r"\\").replace('"', r"\"")
+                    .replace("\n", r"\n"))
+
+        for name in sorted(dict(self.infos)):
+            labels = self.infos[name]
+            m = _n(name) + "_info"
+            _header(m, name, "gauge")
+            body = ",".join(f'{_NAME_RE.sub("_", k)}="{_lv(v)}"'
+                            for k, v in sorted(labels.items()))
+            lines.append(f"{m}{{{body}}} 1")
         for name in sorted(durations):
             d = durations[name]
             m = _n(name) + "_seconds"

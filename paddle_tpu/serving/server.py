@@ -272,6 +272,11 @@ class ServingServer:
         payload = {
             "status": state,
             "inflight": self.engine.inflight,
+            # mesh topology (tp_degree / device_count / backend): a
+            # sharded replica's shape is visible to the LB/operator
+            # without log-diving; /metrics exposes the same facts as
+            # mesh_* gauges + mesh_info, and the two must agree
+            "mesh": self.engine.engine.mesh_info(),
             # saturation without a /metrics scrape: block-pool occupancy
             # split by tier + scheduler queue depths (plain ints read off
             # the live engine — GIL-consistent, no engine-thread handshake)
@@ -473,6 +478,15 @@ def main(argv=None):
     p.add_argument("--max-batch", type=int, default=4)
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--prefill-chunk", type=int, default=None)
+    p.add_argument("--tp-degree", type=int, default=None,
+                   help="tensor-parallel degree: shard weights + the KV "
+                        "arena over a 'tp' mesh of this many devices "
+                        "(serving/sharded.py; same as PADDLE_TPU_TP; "
+                        "1/unset = single-chip)")
+    p.add_argument("--kv-hbm-bytes", type=int, default=None,
+                   help="size the KV pool from a per-chip byte budget "
+                        "(per-shard under --tp-degree) instead of "
+                        "max_batch * max_seq_len")
     p.add_argument("--max-waiting", type=int, default=64,
                    help="wait-queue bound beyond max_batch lanes (429 past it)")
     p.add_argument("--stream-queue-size", type=int, default=64,
@@ -522,6 +536,11 @@ def main(argv=None):
         spec_decoding=True if args.spec_decode else None,
         num_spec_tokens=args.num_spec_tokens,
         trace=args.trace, request_log=True if args.request_log else None,
+        # pass the degree through untouched: --tp-degree 1 is an EXPLICIT
+        # single-chip request and must beat a PADDLE_TPU_TP env default
+        # (the engine only consults the env when mesh is None/unset)
+        mesh=args.tp_degree,
+        kv_hbm_bytes=args.kv_hbm_bytes,
     )
     if args.request_log:
         import logging

@@ -1,0 +1,214 @@
+"""Tensor-parallel serving: the NamedSharding mesh layer under LLMEngine.
+
+Single-chip serving caps the model at one chip's HBM and one chip's FLOPs.
+This module makes the whole serving subsystem mesh-native (ROADMAP item 1,
+the Gemma-on-TPU comparison's standard TP recipe): GPT weights and the
+paged KV arena shard over a ``tp`` mesh axis while every scheduling
+decision — block tables, prefix cache, refcounts, admission, preemption —
+stays host-side and byte-identical to the single-chip engine. Build a
+mesh with `build_serving_mesh` (or just pass ``mesh=2`` to `LLMEngine`)
+and the engine's three compiled programs (mixed / decode / verify) become
+mesh-aware with the same ``(B, S, kind)`` keying.
+
+The tp layout (the Megatron partitioning the training side already
+encodes in ``Parameter.sharding_axes``, here renamed onto the serving
+axis — `serving_param_specs` is `spmd.module_param_specs` with ``mp`` →
+``tp``):
+
+====================  =========================  ========================
+tensor                 shape                      PartitionSpec
+====================  =========================  ========================
+wte (vocab embed)      [vocab, hidden]            P('tp', None)
+attn qkv weight        [hidden, 3*hidden]         P(None, 'tp')  (heads)
+attn proj weight       [hidden, hidden]           P('tp', None)  (+psum)
+ffn fc1 weight         [hidden, 4*hidden]         P(None, 'tp')  (columns)
+ffn fc2 weight         [4*hidden, hidden]         P('tp', None)  (+psum)
+layernorms, wpe        (small)                    P()  (replicated)
+KV arena k/v           [layers, heads, blocks,    P(None, 'tp')
+                        block_size, head_dim]      (head-major shard)
+step metadata/tokens   block tables, slots, ids…  P()  (replicated)
+====================  =========================  ========================
+
+Head-sharding the arena is what the PR 2 head-major layout was for: each
+chip owns a contiguous ``[layers, heads/tp, blocks, block_size,
+head_dim]`` slab, scatters only its own heads' K/V, and attends its own
+heads. The fused QKV projection is per-head-grouped (models/gpt.py), so a
+contiguous tp shard of its columns IS a head group and the q/k/v split
+costs no realignment; the dominant cross-chip traffic in a step is the tp
+all-reduce on the attention/FFN output projections (kept explicit so
+EQuARX-style quantized collectives can slot in later), plus the sampled
+positions' logit gather at the program boundary. The Pallas ragged kernel is single-device
+by construction; on a mesh the dispatch (ops/pallas/paged_attention.py
+`ragged_paged_attention_sharded`) runs it per-shard via `shard_map` over
+the head axis (each shard sees its local head slice of the arena), with
+the XLA padded-gather path as the GSPMD-partitioned fallback everywhere
+else.
+
+Donation of the sharded arenas routes through
+`parallel.spmd.mesh_donate_argnums` (the JL004 gate): the XLA-CPU
+host-platform mesh miscompiles donated sharded buffers (outputs alias
+freed inputs), so donation stays off on the cpu backend and on for real
+accelerators.
+
+Single-chip parity guarantee: with greedy sampling, a tp-sharded serve is
+token-for-token identical to the single-chip engine on the same model —
+the mesh changes WHERE flops run, never which tokens come out
+(tests/test_serving_sharded.py locks this on the 8-fake-device CPU mesh,
+prefix-cache hits and speculative decoding included).
+
+Known limit: the engine places SHARDED COPIES of the model's weights
+(`jax.device_put` per `serving_param_specs`) and serves from those; the
+caller's eager model keeps its own single-device arrays — the engine does
+not mutate state it does not own (test fixtures share one model across
+sharded and reference engines). A model too large for one chip therefore
+needs its parameters built/loaded sharded before engine construction
+(checkpoint-streaming placement is follow-on work with the checkpoint
+machinery); for models that fit, the cost is one transient full replica
+held by the caller.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ServingMesh:
+    """The serving topology handle threaded through engine, pool, and the
+    paged-attention dispatch: a `jax.sharding.Mesh` whose ``tp`` axis
+    shards attention heads / FFN columns / the KV arena's head axis.
+    Construct via `build_serving_mesh` (or pass an int/Mesh to
+    `LLMEngine(mesh=...)`, which lands here through `as_serving_mesh`)."""
+
+    TP_AXIS = "tp"
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        if self.TP_AXIS not in mesh.shape:
+            raise ValueError(
+                f"serving mesh needs a '{self.TP_AXIS}' axis; got axes "
+                f"{tuple(mesh.shape)}"
+            )
+
+    @property
+    def tp_degree(self):
+        return int(self.mesh.shape[self.TP_AXIS])
+
+    @property
+    def device_count(self):
+        return int(self.mesh.devices.size)
+
+    @property
+    def backend(self):
+        return self.mesh.devices.flat[0].platform
+
+    def named(self, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self):
+        return self.named()
+
+    def arena_sharding(self):
+        """The head-major KV arena ``[layers, heads, blocks, block_size,
+        head_dim]`` shards its HEAD axis over tp — each chip owns
+        ``heads/tp`` full head slabs, so the ragged kernel's per-(head,
+        block) tiles never straddle chips."""
+        return self.named(None, self.TP_AXIS)
+
+    def validate_model(self, cfg):
+        """Reject a model the tp degree cannot shard evenly: attention
+        heads, FFN columns, and the (vocab-parallel) embedding rows must
+        all divide, or GSPMD would silently pad — and the head-sharded
+        arena would not tile. One loud error at engine construction."""
+        tp = self.tp_degree
+        for name, dim in (("num_heads", cfg.num_heads),
+                          ("intermediate_size", cfg.intermediate_size),
+                          ("vocab_size", cfg.vocab_size)):
+            if dim % tp:
+                raise ValueError(
+                    f"tp_degree {tp} does not divide {name} {dim} — pick "
+                    "a tp degree that divides the head/FFN/vocab dims"
+                )
+
+    def info(self):
+        """Topology facts for /healthz and the mesh gauges."""
+        return {"tp_degree": self.tp_degree,
+                "device_count": self.device_count,
+                "backend": self.backend}
+
+
+def build_serving_mesh(tp_degree, devices=None):
+    """A 1-D ``('tp',)`` mesh over the first `tp_degree` devices. On the
+    8-fake-device CPU host platform (tests/_cpu_mesh.py) this is how the
+    tp=2/tp=4 parity harnesses get their mesh without TPUs."""
+    import jax
+    from jax.sharding import Mesh
+
+    tp = int(tp_degree)
+    if tp < 2:
+        raise ValueError("build_serving_mesh needs tp_degree >= 2 "
+                         "(single-chip engines pass mesh=None)")
+    devices = list(devices if devices is not None else jax.devices())
+    if tp > len(devices):
+        raise ValueError(
+            f"tp_degree {tp} needs {tp} devices, have {len(devices)}"
+        )
+    return ServingMesh(Mesh(np.asarray(devices[:tp]), (ServingMesh.TP_AXIS,)))
+
+
+def as_serving_mesh(mesh):
+    """Coerce `LLMEngine(mesh=...)`'s accepted forms — ServingMesh,
+    jax Mesh (must carry a tp axis), or int tp degree — to a ServingMesh.
+    Any form that resolves to tp degree <= 1 coerces to None: ``mesh=1``
+    (or a 1-device Mesh) is the EXPLICIT single-chip request (it beats
+    the PADDLE_TPU_TP env default, which only applies when mesh is
+    unset), and degree 1 must take the true single-chip path — the
+    sharded engine would otherwise disable donation for nothing."""
+    if mesh is None:
+        return mesh
+    if isinstance(mesh, (int, np.integer)):
+        return None if int(mesh) <= 1 else build_serving_mesh(int(mesh))
+    smesh = mesh if isinstance(mesh, ServingMesh) else ServingMesh(mesh)
+    return None if smesh.tp_degree <= 1 else smesh
+
+
+def serving_param_specs(model, smesh):
+    """Per-parameter PartitionSpecs for the serving mesh: the model's own
+    ``Parameter.sharding_axes`` Megatron layout (mp_layers.py annotates
+    ColumnParallel out-dims, RowParallel in-dims, and the vocab embedding)
+    renamed onto the serving ``tp`` axis — the `spmd.module_param_specs`
+    pattern, minus the training-only ZeRO branches. Unannotated tensors
+    (layernorms, wpe, RowParallel biases) replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    tp = smesh.TP_AXIS
+    specs = {}
+    for name, p in model.named_parameters_dict().items():
+        axes = getattr(p, "sharding_axes", None)
+        spec = [tp if a == "mp" else None for a in axes] if axes else []
+        specs[name] = P(*spec) if any(spec) else P()
+    return specs
+
+
+def kv_capacity_blocks(kv_bytes, num_layers, num_heads, block_size,
+                       head_dim, dtype_itemsize, tp_degree=1):
+    """KV blocks a PER-CHIP byte budget buys. The arena is head-sharded
+    over tp, so one chip stores ``num_heads / tp_degree`` heads per block
+    — the same budget holds ``tp_degree``x the blocks of the naive
+    logical-head-count formula. Admission (`LLMEngine.validate`, and the
+    frontend's ``max_kv_commit_blocks`` gate that reuses it) must reject
+    against what one shard can actually hold, which is THIS number, so
+    every capacity derivation funnels here. Returns the raw block count
+    (possibly 0/1) — the engine rejects an unusably small budget loudly
+    at construction rather than booting a replica that 4xxes every
+    request."""
+    local_heads = -(-int(num_heads) // max(1, int(tp_degree)))
+    per_block = (2 * int(num_layers) * local_heads * int(block_size)
+                 * int(head_dim) * int(dtype_itemsize))
+    return int(kv_bytes) // per_block
+
+
+# The per-shard Pallas dispatch (shard_map over the head axis) lives next
+# to the kernel it wraps: ops/pallas/paged_attention.py
+# `ragged_paged_attention_sharded`, selected by `paged_attention_arrays`
+# whenever the threaded-through PagedState carries a mesh.
