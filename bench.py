@@ -418,13 +418,29 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
         toks = eng.metrics.counters["generated_tokens"] - t0_tok
         return outs, (toks / dt if dt > 0 else 0.0), eng
 
+    def program_collectives(eng):
+        """hlolint collective counts per program kind — the bench line
+        records them so the trajectory catches collective-count drift
+        (an accidental per-layer re-gather), not just tok/s drift.
+        Lowering recompiles the programs, so past the deadline the
+        counts are skipped rather than overshooting the budget."""
+        if time.monotonic() > deadline_s:
+            return {}
+        from paddle_tpu.analysis.ir import engine_collective_counts
+
+        return {
+            kind: {op: n for op, n in counts.items() if n}
+            for kind, counts in engine_collective_counts(eng).items()
+        }
+
     # mesh=1 is the EXPLICIT single-chip request: a PADDLE_TPU_TP env
     # left set must not shard the reference and make parity vacuous
-    ref_outs, ref_tok_s, _ = wave(1)
+    ref_outs, ref_tok_s, ref_eng = wave(1)
     out = {"n_devices": len(jax.devices()),
            "max_new_tokens": max_new,
            "requests": len(lens),
            "tok_s_single": round(ref_tok_s, 1)}
+    engines = {"tp1": ref_eng}
     parity_all = "ok"
     for tp in (2, 4):
         if time.monotonic() > deadline_s:
@@ -439,10 +455,17 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
         out[f"tp{tp}_tok_s"] = round(tok_s, 1)
         out[f"tp{tp}_sharded_parity"] = parity
         out[f"tp{tp}_mesh"] = eng.mesh_info()
+        engines[f"tp{tp}"] = eng
         _log(f"multichip serve tp={tp}: {tok_s:.1f} tok/s "
              f"sharded_parity: {parity}")
     if "tp2_tok_s" not in out:
         return None
+    # collective counts come LAST: the drift metric is order-independent,
+    # and its lowering+compiling must never eat deadline budget the tp
+    # waves (the primary tok/s + parity measurement) still need
+    out["collectives"] = {name: program_collectives(eng)
+                          for name, eng in engines.items()}
+    _log(f"multichip serve collectives: {out['collectives']}")
     out["value"] = out["tp2_tok_s"]
     out["sharded_parity"] = parity_all
     return out

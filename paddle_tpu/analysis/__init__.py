@@ -1,16 +1,17 @@
-"""jaxlint: a jit-hygiene static analyzer for this codebase.
+"""Static analysis for this codebase: jaxlint (AST) + hlolint (IR).
 
 Every rule encodes a bug class this repo has shipped, debugged, and
-postmortemed (CHANGES.md PRs 1, 3, 5, 6) — the analyzer turns those
-postmortems into machine-checked invariants, run as a tier-1 CI gate
-(tests/test_lint_codebase.py).
+postmortemed (CHANGES.md PRs 1, 3, 5, 6, 10) — the analyzer turns those
+postmortems into machine-checked invariants, run as tier-1 CI gates
+(tests/test_lint_codebase.py, tests/test_ir_contracts.py).
 
 Usage:
 
     python -m paddle_tpu.analysis [paths...]    # or: paddle-tpu-lint
+    python -m paddle_tpu.analysis --ir          # + compiled-program contracts
     from paddle_tpu.analysis import lint_paths, lint_source
 
-Rules (suppress inline with ``# jaxlint: disable=JLxxx -- reason``):
+AST rules (suppress inline with ``# jaxlint: disable=JLxxx -- reason``):
 
 - JL001 donation-aliasing     zero-copy jnp.asarray into donated state
 - JL002 repr-keyed-cache      repr/str/f-string cache keys constant-bake
@@ -19,8 +20,20 @@ Rules (suppress inline with ``# jaxlint: disable=JLxxx -- reason``):
 - JL005 lock-discipline       guarded state touched outside its lock
 - JL006 retrace-hazard        per-call jit rebuilds / unhashable statics
 - JL007 async-hygiene         blocking calls on the event loop
+- JL008 eager-materialize-then-place  device_put(jnp.zeros(...), sharding)
 
-Pure stdlib ``ast`` — importing this package pulls in no jax/numpy.
+IR contracts (``--ir``; submodules `ir` and `contracts`, which lower the
+engine's three serving programs at tp=1/tp=2 plus the spmd train step
+and check the artifact XLA actually runs):
+
+- IR001 collective-budget        exact all-reduce/all-gather counts
+- IR002 donation-verified        input_output_aliases match the gate
+- IR003 host-sync-hygiene        no unsanctioned custom-call/infeed/...
+- IR004 program-shape-baseline   flops/bytes/peak-memory vs baseline
+
+Importing this package (and the default AST-only CLI path) pulls in no
+jax/numpy — the IR layer imports jax lazily and the CLI exits 2 with a
+pointed message when ``--ir`` is requested without it.
 """
 from .core import (  # noqa: F401
     Finding,

@@ -1,0 +1,345 @@
+"""Program contracts: the declarative rules hlolint evaluates.
+
+A *contract* is a checked invariant of a lowered+compiled program
+(`ir.ProgramArtifact`), the IR-level sibling of a jaxlint rule: it
+carries an ``IRxxx`` id, the incident it encodes, and a ``check``
+yielding `Violation`s. Contracts live HERE, next to the registry of the
+programs they govern (`ir.default_artifacts`), and run via
+``python -m paddle_tpu.analysis --ir`` and the tier-1 gate
+(tests/test_ir_contracts.py). ``--select``/``--ignore`` accept IR ids
+exactly like JL ids.
+
+The catalog:
+
+- IR001 collective-budget   a program's collective ops match the layout-
+                            derived budget exactly (serving: 2L+1
+                            all-reduce, 1 sampler-boundary all-gather,
+                            nothing else — serving/sharded.py
+                            `serving_collective_budget`)
+- IR002 donation-verified   donation that should alias DOES appear in
+                            ``input_output_alias``, and donation the
+                            `mesh_donate_argnums` gate turned off aliases
+                            NOTHING (the 8 JL004 waivers become checked
+                            facts instead of trusted comments)
+- IR003 host-sync-hygiene   no custom-call / infeed / outfeed / send /
+                            recv outside the whitelist — the IR backstop
+                            behind jaxlint JL003
+- IR004 program-shape       flops / bytes-accessed / peak-memory per
+                            program stay within tolerance of the checked-
+                            in baseline (ir_baseline.json); update it
+                            deliberately with ``--ir --update-baseline``
+                            when a change legitimately moves a budget
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from . import ir as _ir
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "ir_baseline.json")
+
+# relative tolerance for IR004: generous enough to absorb minor
+# jaxlib-version drift in XLA's cost model, tight enough that a 2x flops
+# or bytes regression (an accidental extra matmul, a de-fused gather)
+# cannot hide
+BASELINE_RTOL = 0.25
+
+
+@dataclasses.dataclass
+class Violation:
+    contract: str                 # "IR001"
+    name: str                     # "collective-budget"
+    program: str                  # artifact name ("serve/tp2/decode")
+    message: str
+
+    def format(self):
+        return f"{self.program}: {self.contract} {self.name}: {self.message}"
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+
+class IRContract:
+    """One checked program invariant. Subclasses set `id`/`name`/
+    `incident` and implement ``check(artifact, context)``; `context`
+    carries run-wide inputs (today: the IR004 baseline)."""
+
+    id = "IR000"
+    name = "abstract"
+    incident = ""
+
+    def check(self, artifact, context):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def violation(self, artifact, message):
+        return Violation(contract=self.id, name=self.name,
+                         program=artifact.name, message=message)
+
+
+IR_CONTRACTS: dict[str, IRContract] = {}
+
+
+def register_contract(cls):
+    inst = cls()
+    if inst.id in IR_CONTRACTS:
+        raise ValueError(f"duplicate contract id {inst.id}")
+    IR_CONTRACTS[inst.id] = inst
+    return cls
+
+
+def all_contracts():
+    return [IR_CONTRACTS[k] for k in sorted(IR_CONTRACTS)]
+
+
+def _describe_ops(ops, limit=4):
+    shown = "; ".join(op.describe() for op in ops[:limit])
+    more = len(ops) - limit
+    return shown + (f"; ... {more} more" if more > 0 else "")
+
+
+@register_contract
+class CollectiveBudget(IRContract):
+    """Every collective op in the program is in the budget, and the
+    budget is EXACT — one surplus all-gather means some sharded axis is
+    being re-gathered that the layout promises never moves."""
+
+    id = "IR001"
+    name = "collective-budget"
+    incident = ("PR 10 review: a qkv-major fused-QKV regroup silently "
+                "added 10 all-gathers to every compiled tp=2 decode "
+                "step; only a hand-read of the HLO caught it")
+
+    def check(self, artifact, context):
+        budget = artifact.expected.get("collective_budget")
+        if budget is None:
+            return
+        actual = artifact.collectives
+        for op in sorted(set(budget) | set(actual)):
+            want, got = int(budget.get(op, 0)), int(actual.get(op, 0))
+            if want == got:
+                continue
+            offenders = [o for o in artifact.ops
+                         if _ir._base_opcode(o.opcode) == op
+                         and not o.opcode.endswith("-done")]
+            detail = (f" — offending HLO ops: {_describe_ops(offenders)}"
+                      if got > want and offenders else "")
+            yield self.violation(
+                artifact,
+                f"{op} count {got} != budget {want} "
+                f"(serving_collective_budget, tp={artifact.tp_degree})"
+                f"{detail}",
+            )
+
+
+@register_contract
+class DonationVerified(IRContract):
+    """``input_output_alias`` matches what the donation gate decided:
+    donation that is supposed to be on actually bought in-place reuse,
+    and donation the gate turned off (the cpu host-platform mesh
+    miscompile) left NOTHING aliased."""
+
+    id = "IR002"
+    name = "donation-verified"
+    incident = ("PR 3: donated sharded buffers on the host-platform mesh "
+                "aliased outputs to freed inputs — silent loss drift, "
+                "then a segfault (the mesh_donate_argnums gate exists "
+                "for this; hlolint checks the gate actually held)")
+
+    def check(self, artifact, context):
+        don = artifact.expected.get("donation")
+        if don is None:
+            return
+        alias_by_param = {a.param_number: a for a in artifact.aliases}
+        if don["expected"]:
+            missing = [i for i in don["param_indices"]
+                       if i not in alias_by_param]
+            if missing:
+                yield self.violation(
+                    artifact,
+                    f"{don['what']} donated (parameters {missing}) but "
+                    "absent from the compiled program's "
+                    "input_output_alias map — donation silently did not "
+                    "alias, so every step pays a full copy",
+                )
+            # aliasing SOMEWHERE is not enough: the donated buffer must
+            # land on its updated-state output (param_indices and
+            # output_indices pair positionally) — in-place reuse routed
+            # to the wrong output corrupts whatever actually lands there
+            for p, want_out in zip(don["param_indices"],
+                                   don.get("output_indices") or ()):
+                al = alias_by_param.get(p)
+                if al is None:
+                    continue      # already reported as missing above
+                got_out = al.output_index[0] if al.output_index else 0
+                if got_out != want_out:
+                    yield self.violation(
+                        artifact,
+                        f"{don['what']} parameter {p} aliases output "
+                        f"{got_out} instead of its updated-state output "
+                        f"{want_out} — donation bought in-place reuse of "
+                        "the WRONG buffer",
+                    )
+        elif artifact.aliases:
+            rows = ", ".join(
+                f"param {a.param_number} -> output {a.output_index}"
+                for a in artifact.aliases[:4])
+            yield self.violation(
+                artifact,
+                "donation is gated OFF on this backend "
+                f"({artifact.backend}) yet input_output_alias maps "
+                f"{rows} — the host-platform-mesh donation miscompile "
+                "class (outputs alias freed inputs)",
+            )
+
+
+@register_contract
+class HostSyncHygiene(IRContract):
+    """No device->host round-trip compiled into a hot program: every
+    custom-call / infeed / outfeed / send / recv must be on the
+    explicit whitelist (device kernels and SPMD plumbing only)."""
+
+    id = "IR003"
+    name = "host-sync-hygiene"
+    incident = ("PR 5/6 postmortems (jaxlint JL003): host callbacks "
+                "traced into jitted steps serialize the device pipeline; "
+                "this is the lowered-program backstop for anything the "
+                "AST rule cannot see")
+
+    def check(self, artifact, context):
+        whitelist = artifact.expected.get(
+            "custom_call_whitelist", _ir.DEFAULT_CUSTOM_CALL_WHITELIST)
+        bad = [op for op in _ir.host_boundary_ops(artifact.ops)
+               if op.custom_call_target not in whitelist]
+        if bad:
+            yield self.violation(
+                artifact,
+                "host-boundary ops outside the whitelist: "
+                f"{_describe_ops(bad)} — a compiled serving/train step "
+                "must not round-trip through the host",
+            )
+
+
+@register_contract
+class ProgramShapeBaseline(IRContract):
+    """flops / bytes-accessed / peak-memory per program stay within
+    BASELINE_RTOL of the recorded baseline; a legitimate change reruns
+    ``python -m paddle_tpu.analysis --ir --update-baseline`` and commits
+    the moved numbers WITH the change that moved them."""
+
+    id = "IR004"
+    name = "program-shape-baseline"
+    incident = ("PR 10 round-3: an eager zeros+device_put builder "
+                "transiently materialized the tp x one-chip logical "
+                "arena — a peak-memory regression invisible to both "
+                "tests and tok/s benches")
+
+    CHECKED = ("flops", "bytes_accessed", "peak_bytes")
+
+    def check(self, artifact, context):
+        baseline = (context or {}).get("baseline")
+        if baseline is None:
+            return            # no context at all: a bare check() call
+        recorded = baseline.get("backend")
+        if recorded and artifact.backend and recorded != artifact.backend:
+            # cost-model facts are backend-specific: comparing a real-TPU
+            # run against the checked-in cpu numbers would flag drift
+            # where nothing regressed (and refreshing there would poison
+            # the cpu CI gate) — IR001-003 still fully apply
+            return
+        progs = baseline.get("programs", {})
+        base = progs.get(artifact.name)
+        if base is None:
+            yield self.violation(
+                artifact,
+                "program has no recorded baseline — run `python -m "
+                "paddle_tpu.analysis --ir --update-baseline` and commit "
+                "ir_baseline.json",
+            )
+            return
+        for key in self.CHECKED:
+            want, got = base.get(key), artifact.facts.get(key)
+            if want is None or got is None:
+                continue
+            if want == 0 and got == 0:
+                continue
+            ref = max(abs(float(want)), 1.0)
+            if abs(float(got) - float(want)) / ref > BASELINE_RTOL:
+                yield self.violation(
+                    artifact,
+                    f"{key} {got:.6g} drifted beyond ±{BASELINE_RTOL:.0%}"
+                    f" of baseline {want:.6g} — if intentional, refresh "
+                    "with --ir --update-baseline",
+                )
+
+
+# ---------------------------------------------------------------------------
+# evaluation + baseline persistence
+
+
+def _select_contracts(select=None, ignore=None):
+    contracts = all_contracts()
+    if select:
+        sel = {s.upper() for s in select}
+        contracts = [c for c in contracts if c.id in sel]
+    if ignore:
+        ign = {s.upper() for s in ignore}
+        contracts = [c for c in contracts if c.id not in ign]
+    return contracts
+
+
+def evaluate(artifacts, select=None, ignore=None, baseline=None):
+    """Run every (selected) contract over every artifact; returns the
+    sorted Violation list. `baseline=None` loads the checked-in file; a
+    missing/unreadable file evaluates as an EMPTY baseline, so IR004
+    reports every program as unrecorded instead of silently going green
+    (a wheel that forgot the package-data entry, a corrupted file). Skip
+    the shape comparison deliberately with ``ignore=["IR004"]``."""
+    if baseline is None:
+        baseline = load_baseline()
+    context = {"baseline": baseline}
+    violations = []
+    for contract in _select_contracts(select, ignore):
+        for art in artifacts:
+            violations.extend(contract.check(art, context))
+    violations.sort(key=lambda v: (v.program, v.contract))
+    return violations
+
+
+def load_baseline(path=None):
+    """The recorded program-shape baseline, or {} when absent/unreadable
+    (IR004 then reports the missing-program violation per artifact)."""
+    p = path or BASELINE_PATH
+    try:
+        with open(p, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def baseline_facts(artifacts):
+    """The machine-readable baseline document for these artifacts."""
+    import jax
+
+    return {
+        "version": 1,
+        "tool": "hlolint",
+        "jax": jax.__version__,
+        "backend": artifacts[0].backend if artifacts else None,
+        "programs": {
+            a.name: {k: a.facts[k] for k in ProgramShapeBaseline.CHECKED
+                     if k in a.facts}
+            for a in artifacts
+        },
+    }
+
+
+def save_baseline(artifacts, path=None):
+    p = path or BASELINE_PATH
+    doc = baseline_facts(artifacts)
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return p

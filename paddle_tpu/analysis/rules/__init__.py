@@ -1,2 +1,2 @@
 """Rule modules self-register on import (see core.register)."""
-from . import caching, concurrency, donation, jit_hygiene  # noqa: F401
+from . import caching, concurrency, donation, jit_hygiene, placement  # noqa: F401

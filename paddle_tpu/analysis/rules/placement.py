@@ -1,0 +1,68 @@
+"""Placement rules: JL008 (eager materialize, then place)."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, qn_matches, register
+
+_DEVICE_PUT = ("jax.device_put", "device_put")
+
+# jnp factories that materialize a FRESH buffer on the default device
+# before device_put ever sees it; *_like variants included for when the
+# template array is itself large
+_EAGER_FACTORIES = tuple(
+    f"{mod}.{fn}"
+    for mod in ("jax.numpy", "jnp")
+    for fn in ("zeros", "ones", "full", "empty",
+               "zeros_like", "ones_like", "full_like", "empty_like")
+)
+
+
+def _placement_args(call):
+    """True when the device_put call actually places (a second positional
+    argument or a device=/sharding= keyword) — a bare one-arg device_put
+    is a no-op transfer, not the materialize-then-place pattern."""
+    if len(call.args) >= 2:
+        return True
+    return any(kw.arg in ("device", "sharding") for kw in call.keywords)
+
+
+@register
+class EagerMaterializeThenPlace(Rule):
+    """``jax.device_put(jnp.zeros/ones/full/empty(...), sharding)``: the
+    factory materializes the FULL logical array on the default chip
+    first and only then re-places it — under a per-chip memory budget a
+    sharded target is tp x one chip's capacity, so construction OOMs on
+    real accelerators (and silently works on hosts). Allocate sharded
+    from the start with a jit-with-``out_shardings`` builder
+    (parallel/spmd.py ``_sharded_zeros_fn`` is the shared helper)."""
+
+    id = "JL008"
+    name = "eager-materialize-then-place"
+    incident = ("PR 10 round-3: the sharded KV arena was built as eager "
+                "zeros + device_put — the tp x one-chip logical arena "
+                "would materialize on chip 0 and OOM at engine "
+                "construction on real accelerators")
+
+    def check(self, module):
+        for node in module.nodes:
+            if not (isinstance(node, ast.Call)
+                    and qn_matches(module.qualname(node.func),
+                                   *_DEVICE_PUT)
+                    and _placement_args(node)):
+                continue
+            value = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg in ("x", "arr"):
+                    value = kw.value
+            if (isinstance(value, ast.Call)
+                    and qn_matches(module.qualname(value.func),
+                                   *_EAGER_FACTORIES)):
+                yield self.finding(
+                    module, value,
+                    "eager jnp factory materializes the full logical "
+                    "array on the default device before device_put "
+                    "re-places it (OOM at tp x one-chip scale) — "
+                    "allocate sharded from the start via a cached "
+                    "jit-with-out_shardings builder",
+                )

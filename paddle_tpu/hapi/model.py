@@ -127,7 +127,7 @@ class _StaticGraphAdapter:
                 )
                 return loss, outs, bufs, new_params, new_opt
 
-            # jaxlint: disable=JL004 -- static-program adapter is single-device (no mesh shardings); the gate only exists for the host-platform-mesh sharded-donation miscompile
+            # jaxlint: disable=JL004 -- static-program adapter is single-device (no mesh shardings); the gate only exists for the host-platform-mesh sharded-donation miscompile. Not IR-checkable: the adapter jit is built per traced static Program, not one of hlolint's registered programs
             jstep = jax.jit(step, donate_argnums=(0, 1))
             self._steps[sig] = (jstep, prog, externals, tr_pos, tr_names)
         jstep, prog, externals, tr_pos, tr_names = self._steps[sig]
@@ -292,7 +292,7 @@ class Model:
             return loss, outs, new_buf, new_params, new_opt
 
         if mesh is None:
-            # jaxlint: disable=JL004 -- mesh is None here by the guard above: single-device jit, unsharded buffers; the sharded path below uses the gate
+            # jaxlint: disable=JL004 -- mesh is None here by the guard above: single-device jit, unsharded buffers; the sharded path below uses the gate AND is donation-verified by IR contract IR002 on the lowered spmd train step (tests/test_ir_contracts.py)
             return jax.jit(step, donate_argnums=(0, 2))
 
         # ---- sharded step: GSPMD over the fleet mesh ----------------------

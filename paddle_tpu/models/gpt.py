@@ -55,6 +55,24 @@ class GPTConfig:
         self.fused_head_chunks = fused_head_chunks
 
 
+def _split_fused_qkv(qkv, b, s, num_heads, head_dim):
+    """Split the fused QKV projection PER-HEAD-GROUPED (the Megatron
+    column order): column block for head i is its contiguous
+    ``[q_i, k_i, v_i]``, so a contiguous tp shard of the 3h axis IS a
+    head group — head-sharding the split q/k/v costs no cross-chip
+    realignment in the tensor-parallel serving path. A qkv-major
+    ``[b, s, 3, heads, hd]`` order would put all Q heads first and force
+    XLA to re-gather the sharded axis every layer; hlolint's seeded
+    tp=2 regression (tests/test_ir_contracts.py) patches this function
+    with exactly that order to prove the collective-budget contract
+    (analysis/contracts.py IR001) trips on it."""
+    qkv = M.reshape(qkv, [b, s, num_heads, 3, head_dim])
+    q = M.squeeze(M.slice(qkv, [3], [0], [1]), 3)
+    k = M.squeeze(M.slice(qkv, [3], [1], [2]), 3)
+    v = M.squeeze(M.slice(qkv, [3], [2], [3]), 3)
+    return q, k, v
+
+
 class CausalSelfAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -72,16 +90,9 @@ class CausalSelfAttention(nn.Layer):
     def forward(self, x, cache=None):
         b, s, _ = x.shape
         qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded on last dim)
-        # per-head-grouped fused QKV (the Megatron column order): column
-        # block for head i is its contiguous [q_i, k_i, v_i], so a
-        # contiguous tp shard of the 3h axis IS a head group — head-
-        # sharding the split q/k/v costs no cross-chip realignment in the
-        # tensor-parallel serving path (a [b,s,3,heads,hd] order would
-        # put all Q heads first and force an all-to-all per layer)
-        qkv = M.reshape(qkv, [b, s, self.num_heads, 3, self.head_dim])
-        q = M.squeeze(M.slice(qkv, [3], [0], [1]), 3)
-        k = M.squeeze(M.slice(qkv, [3], [1], [2]), 3)
-        v = M.squeeze(M.slice(qkv, [3], [2], [3]), 3)
+        # per-head-grouped regroup (module-level so hlolint's seeded
+        # regression can patch in the qkv-major layout it exists to catch)
+        q, k, v = _split_fused_qkv(qkv, b, s, self.num_heads, self.head_dim)
         if cache is not None and getattr(cache, "is_paged", False):
             # serving path: K/V live in the global block arena and are
             # attended through this sequence's block table (vLLM-style
@@ -346,9 +357,9 @@ class GPT(nn.Layer):
                 return sample(logits[:, -1], key), caches
 
             self._decode_fns[sig] = (
-                # jaxlint: disable=JL004 -- single-device decode jit donating its own KV caches (unsharded); gating would copy the cache per step on CPU
+                # jaxlint: disable=JL004 -- single-device decode jit donating its own KV caches (unsharded); gating would copy the cache per step on CPU. Not IR-checkable: generate()'s per-signature jits are not serving programs; the serving engine's arena donation is the IR002-verified equivalent
                 jax.jit(prefill, donate_argnums=(3,)),
-                # jaxlint: disable=JL004 -- same: unsharded cache donation, not the mesh miscompile class
+                # jaxlint: disable=JL004 -- same: unsharded cache donation, not the mesh miscompile class (see prefill waiver above for the IR002 pointer)
                 jax.jit(step, donate_argnums=(3,)),
             )
         prefill, step = self._decode_fns[sig]

@@ -174,7 +174,7 @@ class Optimizer:
         def f(param, grad, lr, state, hyper):
             return self._update_with_wd(param, grad, lr, state, hyper, apply_wd)
 
-        # jaxlint: disable=JL004 -- per-parameter eager update jit: single device, unsharded param/state buffers (the mesh train paths donate through the gate)
+        # jaxlint: disable=JL004 -- per-parameter eager update jit: single device, unsharded param/state buffers (the mesh train paths donate through the gate). Not IR-checkable: hlolint lowers whole train/serve programs, not these per-(param,wd) eager jits built at runtime
         jf = jax.jit(f, donate_argnums=(0, 3))
         self._jit_cache[bool(apply_wd)] = jf
         return jf

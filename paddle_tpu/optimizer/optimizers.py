@@ -311,7 +311,7 @@ class Lars(Optimizer):
                 new_s["master_weight"] = new_p.astype(jnp.float32)
             return new_p.astype(param.dtype), new_s
 
-        # jaxlint: disable=JL004 -- LARS eager update jit: single device, unsharded buffers (same contract as Optimizer._jitted_update)
+        # jaxlint: disable=JL004 -- LARS eager update jit: single device, unsharded buffers (same contract as Optimizer._jitted_update, same reason hlolint cannot lower it)
         jf = jax.jit(f, donate_argnums=(0, 3))
         self._jit_cache[bool(apply_wd)] = jf
         return jf

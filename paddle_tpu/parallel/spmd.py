@@ -36,6 +36,20 @@ def mesh_donate_argnums(argnums):
     return () if jax.default_backend() == "cpu" else tuple(argnums)
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_zeros_fn(shape, dtype_name, sharding):
+    """Compiled sharded-zeros builder, cached per (shape, dtype, sharding)
+    — THE allocate-sharded-from-the-start helper (the serving arena
+    allocator in serving/block_pool.py imports this one): a jit with only
+    out_shardings allocates the buffer SHARDED from the start, where
+    eager ``jnp.zeros`` + ``device_put`` would materialize the full
+    logical array on the default chip first (the jaxlint JL008
+    eager-materialize-then-place class — at gradient-merge scale the
+    accumulators are a full param-sized f32 replica)."""
+    return jax.jit(lambda: jnp.zeros(shape, dtype_name),
+                   out_shardings=sharding)
+
+
 def _largest_divisible_dim(shape, degree):
     best = None
     for i, s in enumerate(shape):
@@ -182,14 +196,14 @@ class ShardedTrainStep:
         }
         if self.gm_k > 1:
             accum = {
-                k: jax.device_put(
-                    jnp.zeros(v.shape, jnp.float32),
+                k: _sharded_zeros_fn(
+                    tuple(v.shape), "float32",
                     NamedSharding(
                         self.mesh,
                         grad_pspec(self.param_specs[k], v.shape, self.mesh,
                                    self.zero_stage),
                     ),
-                )
+                )()
                 for k, v in params.items()
             }
             opt_state = {"inner": opt_state, "gm_accum": accum,
@@ -305,6 +319,41 @@ class ShardedTrainStep:
             self._compiled = InstrumentedStep(
                 self._build(len(batch)), {"source": "ShardedTrainStep"})
         return self._compiled(params, buffers, opt_state, lr, key, *batch)
+
+    # -- lowered-program surface (analysis/ir.py "hlolint") ------------------
+
+    def lower_step(self, *batch):
+        """AOT-lower THE compiled train-step program for the IR contract
+        checker: state placed exactly as `init_state` would serve it,
+        `batch` entries given as `jax.ShapeDtypeStruct`s. Nothing runs and
+        `self._compiled` is untouched — `.compile()` on the result yields
+        the post-SPMD HLO + cost/alias facts hlolint evaluates. Returns
+        ``(lowered, donation_spec)`` where `donation_spec` carries the
+        flat parameter-index ranges of the donated pytrees (params, opt
+        state) and whether the `mesh_donate_argnums` gate leaves donation
+        on for this backend — the IR002 inputs."""
+        params, buffers, opt_state = self.init_state()
+        lowered = self._build(len(batch)).lower(
+            params, buffers, opt_state, jnp.float32(0.01),
+            jax.random.PRNGKey(0), *batch)
+        n_p = len(jax.tree_util.tree_leaves(params))
+        n_b = len(jax.tree_util.tree_leaves(buffers))
+        n_o = len(jax.tree_util.tree_leaves(opt_state))
+        donation = {
+            # donate_argnums=(0, 2): the params dict and the opt-state
+            # tree, in flat parameter-number terms
+            "donated_param_indices": tuple(
+                list(range(n_p)) + list(range(n_p + n_b, n_p + n_b + n_o))
+            ),
+            # deliberately NOT derived from mesh_donate_argnums: the
+            # contract's "expected" side must restate the policy
+            # independently (sharded donation is off on the cpu host
+            # platform), or a broken/bypassed gate would move both sides
+            # together and IR002 could never trip — same discipline as
+            # LLMEngine.step_program_spec
+            "donation_expected": jax.default_backend() != "cpu",
+        }
+        return lowered, donation
 
 
 def make_sharded_train_step(model, loss_fn, optimizer, mesh, batch_specs=None, zero_stage=0, remat=False, gradient_merge_k=1, gradient_merge_avg=True):

@@ -78,6 +78,7 @@ from .sharded import (  # noqa: F401
     as_serving_mesh,
     build_serving_mesh,
     kv_capacity_blocks,
+    serving_collective_budget,
     serving_param_specs,
 )
 from .spec import NgramDrafter, apply_top_k_top_p  # noqa: F401

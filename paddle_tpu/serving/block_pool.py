@@ -37,7 +37,6 @@ the scheduler's `_ensure_writable`).
 """
 from __future__ import annotations
 
-import functools
 import hashlib
 from collections import OrderedDict
 
@@ -53,21 +52,6 @@ def blocks_for(num_tokens, block_size):
     exists) both delegate here so admission and construction bounds can
     never drift apart."""
     return max(1, -(-int(num_tokens) // int(block_size)))
-
-
-@functools.lru_cache(maxsize=None)
-def _sharded_zeros_fn(shape, dtype_name, sharding):
-    """Compiled sharded-zeros builder, cached per (shape, dtype,
-    sharding): allocates an arena SHARDED from the start — eager zeros +
-    device_put would materialize the full logical arena on the default
-    chip first, and under a per-chip ``kv_hbm_bytes`` budget the logical
-    arena is tp x one chip's HBM (OOM at construction on real
-    accelerators)."""
-    import jax
-    import jax.numpy as jnp
-
-    return jax.jit(lambda: jnp.zeros(shape, dtype_name),
-                   out_shardings=sharding)
 
 
 def chain_block_hashes(token_ids, block_size):
@@ -233,6 +217,14 @@ class BlockPool:
             self.k = jnp.zeros(shape, dt)
             self.v = jnp.zeros(shape, dt)
         else:
+            # the shared cached jit-with-out_shardings builder: allocates
+            # the arena SHARDED from the start — eager zeros + device_put
+            # would materialize the full logical arena on the default chip
+            # first, and under a per-chip ``kv_hbm_bytes`` budget the
+            # logical arena is tp x one chip's HBM (OOM at construction
+            # on real accelerators)
+            from ..parallel.spmd import _sharded_zeros_fn
+
             zeros = _sharded_zeros_fn(shape, str(jnp.dtype(dt)), sharding)
             self.k = zeros()
             self.v = zeros()
@@ -409,7 +401,7 @@ class BlockPool:
                 self._copy_fn = jax.jit(
                     _copy, donate_argnums=mesh_donate_argnums((0, 1)))
             else:
-                # jaxlint: disable=JL004 -- COW scatter donates the single-device KV arenas in place; gating would materialize a full arena copy per COW on CPU (see docstring)
+                # jaxlint: disable=JL004 -- COW scatter donates the single-device KV arenas in place; gating would materialize a full arena copy per COW on CPU (see docstring). Not IR-checkable directly: hlolint lowers the engine's step programs, and this jit shares their arenas — IR002 verifying step-program arena aliasing at tp=1 covers the same donation class
                 self._copy_fn = jax.jit(_copy, donate_argnums=(0, 1))
         self.k, self.v = self._copy_fn(
             self.k, self.v, jnp.asarray(src, jnp.int32),

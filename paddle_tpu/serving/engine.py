@@ -520,6 +520,14 @@ class LLMEngine:
                                     block_tables, slots, offs, qpos,
                                     q_start, kv_live)
             lg = logits[jnp.arange(ids.shape[0]), last_idx].astype(jnp.float32)
+            if smesh is not None:
+                # THE one sanctioned boundary all-gather (analysis
+                # contract IR001): materialize the sampled positions'
+                # full vocab rows replicated ONCE, so every sampler
+                # reduction below (argmax, top-k/top-p, categorical,
+                # isfinite) runs collective-free instead of each paying
+                # its own partial-gather pair on vocab-sharded rows
+                lg = jax.lax.with_sharding_constraint(lg, smesh.replicated())
             # non-finite containment (the TrainMonitor discipline applied
             # to serving): a NaN/Inf in the sampled-position logits means
             # this row's forward is numerically poisoned — report it per
@@ -540,6 +548,14 @@ class LLMEngine:
             logits, state = forward(params, buffers, k_arena, v_arena, ids,
                                     block_tables, slots, offs, qpos,
                                     q_start, kv_live)
+            if smesh is not None:
+                # the verify-step boundary gather (contract IR001): all
+                # 1 + num_spec_tokens positions are sampled/compared, so
+                # the whole [B, S, vocab] row block replicates here once
+                # and the accept/rejection sampler below stays
+                # collective-free
+                logits = jax.lax.with_sharding_constraint(
+                    logits, smesh.replicated())
             # non-finite containment over the row's LIVE positions only
             # (the pending token + its drafted candidates); padded tail
             # positions attend through the null block and are never
@@ -555,7 +571,7 @@ class LLMEngine:
 
         if smesh is None:
             fn = jax.jit(verify if kind == "verify" else step,
-                         # jaxlint: disable=JL004 -- serving step donates the single-device KV arenas (unsharded); gating would copy the whole arena every step on CPU
+                         # jaxlint: disable=JL004 -- single-device arena donation, deliberately ungated (gating would copy the whole arena every step on CPU); the aliasing it relies on is machine-checked by IR contract IR002 (analysis/contracts.py) on the lowered tp=1 programs
                          donate_argnums=(2, 3))
         else:
             # mesh-aware program, same (B, S, kind) keying: weights and
@@ -578,6 +594,89 @@ class LLMEngine:
                          donate_argnums=mesh_donate_argnums((2, 3)))
         self._step_fns[(B, S, kind)] = fn
         return fn
+
+    # -- lowered-program surface (analysis/ir.py "hlolint") ----------------
+
+    def step_program_shapes(self):
+        """{kind: (B, S)} for every program this engine would compile —
+        the mixed step, the decode step, and (speculative engines) the
+        verify step. The IR contract checker lowers exactly these."""
+        shapes = {"mixed": (self.max_batch, self.prefill_chunk),
+                  "decode": (self.max_batch, 1)}
+        if self.spec_decoding:
+            shapes["verify"] = (self.max_batch, 1 + self.num_spec_tokens)
+        return shapes
+
+    def lowered_step_programs(self, kinds=None):
+        """AOT-lower the engine's compiled-step programs WITHOUT serving
+        traffic: {kind: jax.stages.Lowered} for each program in
+        `step_program_shapes` (or the `kinds` subset). Weights and the
+        KV arenas pass as their real placed arrays (so shardings and
+        donation lower exactly as a served step would); the host-
+        marshalled inputs pass as ShapeDtypeStructs. Nothing executes —
+        ``.compile()`` on a result yields the artifact hlolint parses
+        (post-SPMD HLO text, cost/memory analysis, input_output_alias).
+        Lowering re-traces outside the jit dispatch cache, so the
+        ``jit_traces`` counter is snapshotted and restored — the
+        recompile sentinel must never blame an analysis pass."""
+        import jax
+        import jax.numpy as jnp
+
+        shapes = self.step_program_shapes()
+        if kinds is not None:
+            shapes = {k: shapes[k] for k in kinds}
+        snap = self.metrics.counters.get("jit_traces", 0)
+        h = lambda shape, dt=jnp.int32: jax.ShapeDtypeStruct(shape, dt)
+        lowered = {}
+        try:
+            for kind, (B, S) in shapes.items():
+                fn = self._get_step_fn(B, S, "verify" if kind == "verify"
+                                       else "step")
+                lowered[kind] = fn.lower(
+                    self._params, self._buffers, self.pool.k, self.pool.v,
+                    h((B, S)), h((B, self.max_blocks)), h((B, S)), h((B, S)),
+                    h((B, S)), h((B,)), h((B,)),
+                    # last_idx for step programs, spec_lens for verify —
+                    # same (B,) int32 slot either way
+                    h((B,)),
+                    h((B,), jnp.float32), h((B,)), h((B,), jnp.float32),
+                    jax.ShapeDtypeStruct(self._key.shape, self._key.dtype),
+                )
+        finally:
+            # restore even when a lower() raises mid-loop: the recompile
+            # sentinel must never blame serving for analysis traces
+            self.metrics.counters["jit_traces"] = snap
+        return lowered
+
+    def step_program_spec(self):
+        """Flat-signature facts the donation contract (IR002) checks the
+        lowered programs against: where the donated KV arena inputs land
+        in the flat parameter numbering, where the updated arenas land in
+        the flat outputs, and whether arena donation is expected to alias
+        on this engine (single-chip engines donate unconditionally; mesh
+        engines route through `parallel.spmd.mesh_donate_argnums`, which
+        turns donation off on the cpu host platform)."""
+        import jax
+
+        n_state = (len(jax.tree_util.tree_leaves(self._params))
+                   + len(jax.tree_util.tree_leaves(self._buffers)))
+        if self._smesh is None:
+            donation_on = True
+        else:
+            # deliberately NOT derived from mesh_donate_argnums: the
+            # contract's "expected" side must be an independent statement
+            # of the policy (sharded donation is off on the cpu host
+            # platform), or a broken/bypassed gate would move both sides
+            # together and IR002 could never trip (the seeded regression
+            # in tests/test_ir_contracts.py patches the gate ungated and
+            # must fail the contract)
+            donation_on = jax.default_backend() != "cpu"
+        return {
+            "arena_param_indices": (n_state, n_state + 1),
+            "arena_output_indices": {"mixed": (2, 3), "decode": (2, 3),
+                                     "verify": (3, 4)},
+            "donation_expected": donation_on,
+        }
 
     def _annotation(self, step_id):
         """While tracing, the device dispatch runs under a jax.profiler

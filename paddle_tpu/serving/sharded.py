@@ -190,6 +190,35 @@ def serving_param_specs(model, smesh):
     return specs
 
 
+def serving_collective_budget(cfg, tp_degree):
+    """EXACT expected collective counts in ONE compiled serving step at
+    this tp degree — the layout table above, stated as arithmetic, and
+    the IR collective-budget contract's input (analysis/contracts.py
+    IR001, gated in tier-1 by tests/test_ir_contracts.py):
+
+    - ``all-reduce``: one per RowParallel output projection (attn proj +
+      ffn fc2 = 2 per layer) plus one for the vocab-parallel embedding's
+      masked-lookup psum -> ``2 * num_layers + 1``;
+    - ``all-gather``: exactly ONE — the sampler-boundary gather that
+      materializes the sampled positions' full vocab rows replicated
+      (engine.py pins it with a sharding constraint so no other sampler
+      reduction pays its own collective);
+    - everything else (``all-to-all``, ``reduce-scatter``, ...): zero.
+      The head-major arena + per-head-grouped fused QKV exist precisely
+      so the attention path needs NO re-gather of the sharded axis; a
+      qkv-major regroup (the pre-PR-10 layout) adds per-layer gathers
+      and must trip the budget.
+
+    Single-chip programs (tp<=1) budget zero collectives of any kind."""
+    if int(tp_degree) <= 1:
+        return {"all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+                "reduce-scatter": 0, "collective-permute": 0,
+                "collective-broadcast": 0}
+    return {"all-reduce": 2 * int(cfg.num_layers) + 1, "all-gather": 1,
+            "all-to-all": 0, "reduce-scatter": 0, "collective-permute": 0,
+            "collective-broadcast": 0}
+
+
 def kv_capacity_blocks(kv_bytes, num_layers, num_heads, block_size,
                        head_dim, dtype_itemsize, tp_degree=1):
     """KV blocks a PER-CHIP byte budget buys. The arena is head-sharded

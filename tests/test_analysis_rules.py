@@ -23,9 +23,10 @@ def rule_ids(rep):
     return [f.rule for f in rep.unsuppressed]
 
 
-def test_registry_has_all_seven_rules():
+def test_registry_has_all_eight_rules():
     assert [r.id for r in all_rules()] == [
-        "JL001", "JL002", "JL003", "JL004", "JL005", "JL006", "JL007"]
+        "JL001", "JL002", "JL003", "JL004", "JL005", "JL006", "JL007",
+        "JL008"]
     for r in all_rules():
         assert r.incident, f"{r.id} must name its historical incident"
 
@@ -491,6 +492,53 @@ def test_suppression_marker_inside_string_is_inert():
         MARKER = "# jaxlint: disable-file=JL001"
     """)
     assert rule_ids(rep) == ["JL001"]
+
+
+# ---------------------------------------------------------------------------
+# JL008 eager-materialize-then-place
+
+
+def test_jl008_flags_device_put_of_eager_factory():
+    rep = run("""
+        import jax
+        import jax.numpy as jnp
+        def build(shape, sharding):
+            arena = jax.device_put(jnp.zeros(shape, jnp.float32), sharding)
+            accum = jax.device_put(jnp.full(shape, 0.0), device=sharding)
+            return arena, accum
+    """)
+    assert rule_ids(rep) == ["JL008", "JL008"]
+
+
+def test_jl008_flags_ones_like_and_from_import():
+    rep = run("""
+        from jax import device_put
+        import jax.numpy as jnp
+        def build(template, sharding):
+            return device_put(jnp.ones_like(template), sharding)
+    """)
+    assert rule_ids(rep) == ["JL008"]
+
+
+def test_jl008_clean_placement_of_existing_arrays_and_builders():
+    # placing an EXISTING array is the normal checkpoint/batch path, a
+    # bare one-arg device_put places nothing, and the fix — the cached
+    # jit-with-out_shardings builder — must not flag itself
+    rep = run("""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        def place(params, shardings):
+            return {k: jax.device_put(v, shardings[k])
+                    for k, v in params.items()}
+        def noop(v):
+            return jax.device_put(jnp.zeros((2,)))
+        @functools.lru_cache(maxsize=None)
+        def _sharded_zeros_fn(shape, dtype_name, sharding):
+            return jax.jit(lambda: jnp.zeros(shape, dtype_name),
+                           out_shardings=sharding)
+    """)
+    assert rule_ids(rep) == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,380 @@
+"""Tier-1 CI gate: the hlolint IR contracts hold on the compiled programs.
+
+Lowers the serving engine's exactly-3 programs (mixed/decode/verify) at
+tp=1 and tp=2 on the 8-fake-device host mesh plus the spmd train step —
+all on the smallest GPT that still exercises tp sharding — and checks:
+
+- zero contract violations on main (collective budget, donation
+  aliasing, host-sync hygiene, program-shape baseline);
+- the SEEDED regressions trip: a deliberately qkv-major (pre-PR-10)
+  fused-QKV layout blows the tp=2 all-gather budget, and ungated
+  ``donate_argnums`` on the cpu host-platform mesh blows the donation
+  contract — both with messages naming the contract and the offending
+  HLO facts;
+- the HLO-text parsing schema canary: a trivial jitted psum on the fake
+  mesh must parse to the expected op names, so a jax lowering-format
+  drift fails HERE with a pointed message instead of letting every
+  contract pass vacuously;
+- the CLI: --ir without jax exits 2, --select/--ignore span both layers.
+"""
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import contracts, ir
+from paddle_tpu.serving.sharded import serving_collective_budget
+
+_build_s = []
+
+
+@pytest.fixture(scope="module")
+def artifacts():
+    paddle.seed(0)
+    t0 = time.perf_counter()
+    arts = ir.default_artifacts()
+    _build_s.append(time.perf_counter() - t0)
+    return arts
+
+
+# ---------------------------------------------------------------------------
+# main is clean
+
+
+def test_main_is_contract_clean(artifacts):
+    violations = contracts.evaluate(artifacts)
+    assert violations == [], (
+        "IR contract violations (if a budget moved legitimately, rerun "
+        "`python -m paddle_tpu.analysis --ir --update-baseline` and "
+        "commit ir_baseline.json with the change that moved it):\n"
+        + "\n".join(v.format() for v in violations))
+
+
+def test_program_set_covers_the_registry(artifacts):
+    names = {a.name for a in artifacts}
+    want = {f"serve/tp{tp}/{kind}"
+            for tp in (1, 2) for kind in ("mixed", "decode", "verify")}
+    want.add("train/dp2_mp2")
+    assert names == want, names
+
+
+def test_gate_stays_under_budget(artifacts):
+    # the whole lower+compile pass must stay cheap enough for tier-1
+    assert _build_s[0] < 45.0, (
+        f"hlolint program set took {_build_s[0]:.1f}s to lower+compile "
+        "(budget 45s) — shrink the tiny config or trim the registry")
+
+
+def test_tp2_collectives_match_the_layout_budget(artifacts):
+    by_name = {a.name: a for a in artifacts}
+    tp2 = by_name["serve/tp2/decode"]
+    assert tp2.collectives == serving_collective_budget(
+        ir.tiny_gpt_config(), 2)
+    # 2 output projections per layer + the vocab-parallel embedding psum
+    assert tp2.collectives["all-reduce"] == 2 * 2 + 1
+    # exactly ONE all-gather: the sampler-boundary logit materialization
+    assert tp2.collectives["all-gather"] == 1
+    for kind in ("mixed", "verify"):
+        assert by_name[f"serve/tp2/{kind}"].collectives == tp2.collectives
+    for kind in ("mixed", "decode", "verify"):
+        assert not any(by_name[f"serve/tp1/{kind}"].collectives.values())
+
+
+def test_donation_aliases_match_the_gate(artifacts):
+    """tp=1 donates unconditionally: the arena inputs must actually
+    alias. tp=2 on the cpu host platform is gated OFF: nothing may
+    alias (the PR 3 miscompile is outputs aliasing freed inputs)."""
+    for a in artifacts:
+        if not a.name.startswith("serve/tp1/"):
+            continue
+        don = a.expected["donation"]
+        assert don["expected"] is True
+        aliased = {al.param_number for al in a.aliases}
+        assert set(don["param_indices"]) <= aliased, (a.name, a.aliases)
+        # and the aliased outputs are the updated arenas, not the tokens
+        outs = {al.output_index[0] for al in a.aliases}
+        assert outs == set(don["output_indices"]), (a.name, a.aliases)
+    for a in artifacts:
+        if a.name.startswith("serve/tp2/") or a.kind == "train":
+            assert a.expected["donation"]["expected"] is False
+            assert a.aliases == [], (a.name, a.aliases)
+
+
+# ---------------------------------------------------------------------------
+# seeded regressions: the two incidents the checker exists to catch
+
+
+def _qkv_major_split(qkv, b, s, num_heads, head_dim):
+    """The pre-PR-10 layout: all Q heads first. A contiguous tp shard of
+    the fused 3h axis is then NOT a head group, so XLA must re-gather
+    the sharded axis inside every layer."""
+    from paddle_tpu.ops import manipulation as M
+
+    qkv = M.reshape(qkv, [b, s, 3, num_heads, head_dim])
+    q = M.squeeze(M.slice(qkv, [2], [0], [1]), 2)
+    k = M.squeeze(M.slice(qkv, [2], [1], [2]), 2)
+    v = M.squeeze(M.slice(qkv, [2], [2], [3]), 2)
+    return q, k, v
+
+
+def test_qkv_major_layout_trips_the_all_gather_budget(monkeypatch):
+    from paddle_tpu.models import gpt as gpt_mod
+
+    monkeypatch.setattr(gpt_mod, "_split_fused_qkv", _qkv_major_split)
+    arts = ir.serving_artifacts(tp_degrees=(2,), kinds=["decode"])
+    (art,) = arts
+    assert art.collectives["all-gather"] > 1, art.collectives
+    violations = contracts.evaluate(arts, select=["IR001"])
+    assert violations, "qkv-major regroup must blow the collective budget"
+    msg = violations[0].format()
+    assert "IR001" in msg and "collective-budget" in msg
+    assert "all-gather" in msg
+    # the message names the offending HLO ops so the diff author sees
+    # WHERE the re-gather got inserted
+    assert "offending HLO ops" in msg and "all-gather" in msg, msg
+
+
+def test_ungated_donation_trips_the_donation_contract(monkeypatch):
+    from paddle_tpu.parallel import spmd
+
+    monkeypatch.setattr(spmd, "mesh_donate_argnums",
+                        lambda argnums: tuple(argnums))
+    arts = ir.serving_artifacts(tp_degrees=(2,), kinds=["decode"])
+    (art,) = arts
+    assert art.aliases, "ungated donation should alias on the host mesh"
+    violations = contracts.evaluate(arts, select=["IR002"])
+    assert violations, "ungated sharded donation must trip IR002"
+    msg = violations[0].format()
+    assert "IR002" in msg and "donation-verified" in msg
+    assert "input_output_alias" in msg and "param" in msg, msg
+
+
+# ---------------------------------------------------------------------------
+# cheap contract-unit checks (hand-built artifacts, no lowering)
+
+
+def _fake_artifact(**kw):
+    base = dict(name="serve/tp2/decode", kind="decode", tp_degree=2,
+                backend="cpu", hlo_text="", ops=[], aliases=[],
+                facts={}, expected={})
+    base.update(kw)
+    return ir.ProgramArtifact(**base)
+
+
+def test_host_sync_hygiene_contract_flags_unsanctioned_custom_call():
+    op = ir.HloOp(opcode="custom-call", result_type="f32[2]", line=7,
+                  op_name="jit(step)/jit(main)/pure_callback",
+                  custom_call_target="xla_python_cpu_callback",
+                  text="custom-call(...)")
+    art = _fake_artifact(ops=[op])
+    violations = contracts.evaluate([art], select=["IR003"], baseline={})
+    assert len(violations) == 1
+    assert "xla_python_cpu_callback" in violations[0].message
+    # whitelisted targets (the Pallas kernel, SPMD plumbing) pass
+    ok = ir.HloOp(opcode="custom-call", result_type="f32[2]", line=7,
+                  op_name="x", custom_call_target="tpu_custom_call",
+                  text="custom-call(...)")
+    assert contracts.evaluate([_fake_artifact(ops=[ok])],
+                              select=["IR003"], baseline={}) == []
+
+
+def test_donation_contract_flags_wrong_output_alias():
+    """Aliasing SOMEWHERE is not enough: a donated arena routed to the
+    wrong output (in-place reuse of the sampled-tokens buffer, say) must
+    trip IR002 even though the param number appears in the alias map."""
+    don = {"expected": True, "param_indices": (10, 11),
+           "output_indices": (2, 3), "what": "KV arena (k, v)"}
+    right = [ir.Alias(output_index=(2,), param_number=10, kind="must-alias"),
+             ir.Alias(output_index=(3,), param_number=11, kind="must-alias")]
+    art = _fake_artifact(aliases=right, expected={"donation": don})
+    assert contracts.evaluate([art], select=["IR002"], baseline={}) == []
+    wrong = [ir.Alias(output_index=(0,), param_number=10, kind="must-alias"),
+             ir.Alias(output_index=(3,), param_number=11, kind="must-alias")]
+    art = _fake_artifact(aliases=wrong, expected={"donation": don})
+    violations = contracts.evaluate([art], select=["IR002"], baseline={})
+    assert len(violations) == 1
+    msg = violations[0].message
+    assert "parameter 10" in msg and "output 0" in msg and "2" in msg
+
+
+def test_baseline_contract_flags_drift_and_missing_programs(artifacts):
+    art = artifacts[0]
+    drifted = dataclasses.replace(
+        art, facts={k: v * 3 for k, v in art.facts.items()})
+    violations = contracts.evaluate([drifted], select=["IR004"])
+    assert violations and "drifted" in violations[0].message
+    unknown = dataclasses.replace(art, name="serve/tp2/nonesuch")
+    violations = contracts.evaluate([unknown], select=["IR004"])
+    assert violations and "no recorded baseline" in violations[0].message
+    # a missing/unreadable baseline FILE must not silently disable the
+    # contract (a wheel without the package-data entry would otherwise be
+    # a permanent false green) — it reports every program as unrecorded
+    violations = contracts.evaluate([art], select=["IR004"], baseline={})
+    assert violations and "no recorded baseline" in violations[0].message
+
+
+# ---------------------------------------------------------------------------
+# schema canary: HLO-text parsing vs jax lowering-format drift
+
+
+def test_hlo_parser_schema_canary():
+    """Lower a trivial jitted psum on the fake mesh and assert the
+    parser extracts the expected op names — if jax/XLA ever change the
+    compiled-HLO text format, THIS fails with a pointed message instead
+    of every contract passing vacuously on empty parses."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    fn = jax.jit(shard_map(lambda x: jax.lax.psum(x, "tp"), mesh=mesh,
+                           in_specs=P("tp"), out_specs=P()))
+    comp = fn.lower(jax.ShapeDtypeStruct((8,), jnp.float32)).compile()
+    text = comp.as_text()
+    ops = ir.parse_hlo_ops(text)
+    drift = ("jax lowering-format drift: analysis/ir.py's HLO-text "
+             "parser no longer extracts %s from a trivial jitted psum — "
+             "fix the parser or every IR contract passes vacuously")
+    assert ops, drift % "any instructions"
+    counts = ir.collective_counts(ops)
+    assert counts["all-reduce"] >= 1, drift % "the psum's all-reduce"
+    ar = next(o for o in ops if ir._base_opcode(o.opcode) == "all-reduce")
+    assert ar.result_type.startswith("f32"), drift % "result types"
+    assert any(o.op_name for o in ops), drift % "op_name metadata"
+
+    donated = jax.jit(lambda a: a * 2.0, donate_argnums=(0,))
+    dcomp = donated.lower(
+        jax.ShapeDtypeStruct((128,), jnp.float32)).compile()
+    aliases = ir.parse_input_output_aliases(dcomp.as_text())
+    assert [a.param_number for a in aliases] == [0], (
+        drift % "the input_output_alias map")
+    facts = ir.extract_facts(dcomp)
+    assert facts.get("flops", 0) > 0, drift % "cost_analysis flops"
+    assert facts.get("peak_bytes", 0) > 0, drift % "memory_analysis sizes"
+
+
+# ---------------------------------------------------------------------------
+# CLI: both layers behind one command
+
+
+def test_cli_ir_without_jax_exits_2(capsys, monkeypatch):
+    from paddle_tpu.analysis import cli
+
+    def broken_import():
+        raise ImportError("No module named 'jax'")
+
+    monkeypatch.setattr(cli, "_import_jax", broken_import)
+    assert cli.main(["--ir"]) == 2
+    err = capsys.readouterr().err
+    assert "jax" in err and "--ir" in err
+    # the AST-only path stays stdlib-pure and fully functional
+    monkeypatch.undo()
+    assert cli.main(["--update-baseline"]) == 2  # requires --ir
+    capsys.readouterr()
+    # a contract-only --select without --ir must be a usage error, not a
+    # run of NEITHER layer that exits 0 (a false green in a CI job that
+    # dropped the flag)
+    assert cli.main(["--select", "IR001"]) == 2
+    assert "--ir" in capsys.readouterr().err
+    # same class: a typo'd id prefix must not silently run neither layer
+    assert cli.main(["--select", "JK001"]) == 2
+    assert "JK001" in capsys.readouterr().err
+    assert cli.main(["--ignore", "XX999"]) == 2
+    assert "XX999" in capsys.readouterr().err
+    # and a correctly-prefixed but NONEXISTENT id (IR01 typo of IR001)
+    # must not select zero contracts and exit 0 — validate against the
+    # catalog, not the prefix
+    assert cli.main(["--select", "IR01"]) == 2
+    assert "IR01" in capsys.readouterr().err
+    assert cli.main(["--ignore", "JL999"]) == 2
+    assert "JL999" in capsys.readouterr().err
+    # a typo'd explicit path must exit 2 even when an IR-only --select
+    # skips the AST sweep that would have read it — not silently pass
+    # having checked nothing at that path (returns before any lowering,
+    # so this costs no compile time)
+    assert cli.main(["--ir", "--select", "IR001",
+                     "/no/such/paddle_tpu_path"]) == 2
+    assert "no such path" in capsys.readouterr().err
+
+
+def test_cli_harness_errors_exit_2_but_program_failures_propagate(
+        capsys, monkeypatch):
+    """Only usage-shaped harness failures (IRHarnessError, OSError) map
+    to exit 2; a genuine lowering/compile failure of a registered
+    program — jax's XlaRuntimeError is also a RuntimeError subclass —
+    must propagate with its traceback instead of masquerading as a
+    misconfigured invocation a CI wrapper might skip."""
+    from paddle_tpu.analysis import cli
+
+    def harness_broken(args, ir_select, ir_ignore, record_only=False):
+        raise ir.IRHarnessError("backend has 1 device")
+
+    monkeypatch.setattr(cli, "_run_ir", harness_broken)
+    assert cli.main(["--ir", "--select", "IR001"]) == 2
+    assert "1 device" in capsys.readouterr().err
+
+    class FakeXlaRuntimeError(RuntimeError):
+        pass
+
+    def program_broken(args, ir_select, ir_ignore, record_only=False):
+        raise FakeXlaRuntimeError("INTERNAL: program failed to compile")
+
+    monkeypatch.setattr(cli, "_run_ir", program_broken)
+    with pytest.raises(FakeXlaRuntimeError):
+        cli.main(["--ir", "--select", "IR001"])
+
+
+def test_cli_select_and_ignore_span_both_layers(capsys, monkeypatch,
+                                                artifacts):
+    from paddle_tpu.analysis import cli
+
+    # reuse the module fixture's artifacts so the CLI test costs no
+    # second lowering pass
+    monkeypatch.setattr(ir, "default_artifacts", lambda: artifacts)
+    assert cli.main(["--ir", "--select", "IR001,IR002,IR003", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ir"]["summary"]["programs"] == len(artifacts)
+    assert doc["ir"]["summary"]["violations"] == 0
+    # an IR-only select skips the AST sweep (0 files linted)
+    assert doc["summary"]["files"] == 0
+    # per-program facts + collectives ride on the JSON line
+    names = {p["name"] for p in doc["ir"]["programs"]}
+    assert "serve/tp2/decode" in names
+    p = next(p for p in doc["ir"]["programs"]
+             if p["name"] == "serve/tp2/decode")
+    assert p["collectives"]["all-reduce"] == 5
+    assert {"flops", "bytes_accessed", "peak_bytes"} <= set(p["facts"])
+    # ignoring every contract leaves the IR layer green trivially
+    assert cli.main(["--ir", "--ignore",
+                     "IR001,IR002,IR003,IR004"]) == 0
+    capsys.readouterr()
+    # a JL-only select skips the IR layer even with --ir: no "ir" key
+    assert cli.main(["--ir", "--select", "JL008", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert "ir" not in doc
+    assert cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "IR001" in out and "JL008" in out
+
+
+def test_cli_update_baseline_respects_jl_only_select(capsys, monkeypatch,
+                                                     artifacts, tmp_path):
+    """--update-baseline forced the IR layer on so the artifacts exist to
+    record from, but a JL-only --select still means "skip this layer's
+    CHECKS": the baseline is written and no contract evaluates (an IR004
+    drift between the old and new baseline must not flip the exit)."""
+    from paddle_tpu.analysis import cli
+
+    monkeypatch.setattr(ir, "default_artifacts", lambda: artifacts)
+    path = tmp_path / "ir_baseline.json"
+    monkeypatch.setattr(contracts, "BASELINE_PATH", str(path))
+    assert cli.main(["--ir", "--update-baseline", "--select", "JL008",
+                     "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ir"]["summary"]["violations"] == 0
+    recorded = json.loads(path.read_text())
+    assert set(recorded["programs"]) == {a.name for a in artifacts}

@@ -64,6 +64,13 @@ import jax
 def build(step):
     return jax.jit(step, donate_argnums=(0, 2))
 """,
+        # PR 10 round-3 OOM class: eager materialize, then place
+        "JL008": """
+import jax
+import jax.numpy as jnp
+def build_arena(shape, sharding):
+    return jax.device_put(jnp.zeros(shape, jnp.float32), sharding)
+""",
         # PR 6 ring-buffer race: guarded deque iterated outside the lock
         "JL005": """
 import threading
