@@ -296,7 +296,11 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     model = GPT(cfg)
     model.to(dtype="bfloat16")
     max_batch = 8 if on_tpu else 4
-    engine = LLMEngine(model, block_size=16, max_batch=max_batch)
+    # slo=True: the ledger's lifecycle hooks are per-request (never per
+    # step/token), so the measured tok/s still reflects the serving hot
+    # path — and the line gains tail-latency fields (tpot p50/p95,
+    # deadline attainment) so the trajectory catches tail drift too
+    engine = LLMEngine(model, block_size=16, max_batch=max_batch, slo=True)
     rs = np.random.RandomState(0)
 
     # warmup: one multi-chunk request compiles BOTH programs — the mixed
@@ -310,15 +314,23 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     warm_tokens = engine.metrics.counters["generated_tokens"]
     warm_traces = engine.metrics.counters["jit_traces"]
     # drop warmup step timings (they include the jit traces/compiles) so the
-    # reported engine_utilization/TTFT describe the measured wave only
+    # reported engine_utilization/TTFT/TPOT describe the measured wave only
     engine.metrics.reset_schedule()
+    engine.slo.reset()
 
     max_new = 64 if on_tpu else 16
     if _fast():
         max_new //= 2
+    # a generous accounting deadline (nothing enforces it on the bare
+    # engine): attainment on the bench line is 1.0 unless the tail
+    # regresses pathologically — exactly the drift alarm we want.
+    # NOT the harness `deadline_s` param — that is an absolute monotonic
+    # timestamp bounding the whole bench child.
+    slo_deadline_s = 120.0
     for ln in lens:
         engine.add_request(
-            rs.randint(0, cfg.vocab_size, (ln,)), max_new_tokens=max_new
+            rs.randint(0, cfg.vocab_size, (ln,)), max_new_tokens=max_new,
+            deadline_s=slo_deadline_s,
         )
     t0 = time.perf_counter()
     while engine.has_unfinished():
@@ -353,6 +365,8 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     lat = engine.metrics.latency_summary()
     ttft = lat.get("ttft", {})
     counters = engine.metrics.counters
+    slo_total = engine.slo.rollup()["total"]
+    tpot = slo_total["tpot_ms"]
     return {
         "value": round(generated / dt, 1),
         "requests": len(lens),
@@ -361,6 +375,9 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
         "prefill_chunk": engine.prefill_chunk,
         "ttft_p50_ms": round(ttft.get("p50_ms", 0.0), 2),
         "ttft_p95_ms": round(ttft.get("p95_ms", 0.0), 2),
+        "tpot_p50_ms": round(tpot["p50"] or 0.0, 3),
+        "tpot_p95_ms": round(tpot["p95"] or 0.0, 3),
+        "deadline_attainment": slo_total["deadline"]["attainment"],
         "mixed_steps": int(counters["mixed_steps"]),
         "decode_steps": int(counters["decode_steps"]),
         "mixed_step_mean_ms": round(

@@ -53,6 +53,14 @@ saturation gauges), and a Prometheus `/metrics` endpoint. Observability
 lifecycle + engine-step tracer exporting Perfetto-loadable JSON at
 ``GET /debug/trace``, joinable to device xplane captures by step id;
 ``PADDLE_TPU_REQUEST_LOG=1`` adds one JSON summary log line per request.
+The SLO ledger (serving/slo.py, ``PADDLE_TPU_SLO``) decomposes every
+request's wall time into exhaustive phases (queued / prefill / decode /
+preempted / stalled / emit — they sum to e2e by construction), rolls up
+per-tenant/priority classes (p95 TTFT, TPOT, deadline attainment) at
+``GET /debug/slo``, and exports true labeled Prometheus histograms; the
+fault flight recorder (serving/postmortem.py,
+``PADDLE_TPU_POSTMORTEM_DIR``) writes one pruned on-disk postmortem
+bundle per supervisor fault event, listable at ``GET /debug/postmortem``.
 See README "Observability".
 """
 from . import faults  # noqa: F401
@@ -71,7 +79,9 @@ from .frontend import (  # noqa: F401
     RequestStream,
 )
 from .metrics import ServingMetrics  # noqa: F401
+from .postmortem import FlightRecorder  # noqa: F401
 from .scheduler import Request, Scheduler  # noqa: F401
+from .slo import SLOLedger  # noqa: F401
 from .server import ServingServer  # noqa: F401
 from .sharded import (  # noqa: F401
     ServingMesh,

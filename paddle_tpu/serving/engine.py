@@ -72,9 +72,26 @@ anywhere else), with step ids stamped into `jax.profiler` annotations so
 device captures join back to host spans. Disabled, ``self.tracer`` is
 None and every hook is one pointer test. Independently,
 ``request_log=True`` / ``PADDLE_TPU_REQUEST_LOG=1`` logs ONE structured
-JSON line per finished/aborted request (queue wait, TTFT, cached/spec
-tokens, preemptions) on the ``paddle_tpu.serving.request`` logger — the
+JSON line per finished/aborted request (queue wait, TTFT, TPOT,
+tenant/priority/deadline, the phase decomposition, cached/spec tokens,
+preemptions) on the ``paddle_tpu.serving.request`` logger — the
 greppable fallback when full tracing is off.
+
+**SLO ledger** (serving/slo.py, ``slo=True`` / ``PADDLE_TPU_SLO=1``):
+a per-request phase clock decomposes every request's wall time into
+``queued`` / ``prefill_compute`` / ``decode_compute`` / ``preempted`` /
+``stalled`` / ``emit`` (summing to e2e exactly, by construction), and
+per-(tenant, priority) rollups — p50/p95 TTFT, TPOT, tokens/s,
+preemption share, deadline attainment against ``deadline_s`` — export
+as ``GET /debug/slo`` JSON and true labeled Prometheus histograms on
+``/metrics``. **Flight recorder** (serving/postmortem.py,
+``postmortem_dir=`` / ``PADDLE_TPU_POSTMORTEM_DIR``): every supervisor
+fault event (poison isolation, watchdog trip, non-finite row,
+engine-thread death) writes one bounded on-disk postmortem bundle
+(trace ring, metrics/pool/health snapshots, fault plan, the victim's
+ledger decomposition, recent request-log lines), pruned to a cap and
+listable at ``GET /debug/postmortem``. Both off by default behind one
+pointer test per hook site.
 """
 from __future__ import annotations
 
@@ -114,7 +131,8 @@ class LLMEngine:
                  prefix_cache=None, spec_decoding=None, num_spec_tokens=4,
                  spec_max_ngram=3, spec_min_ngram=1, trace=None,
                  trace_buffer=None, request_log=None, mesh=None,
-                 kv_hbm_bytes=None):
+                 kv_hbm_bytes=None, slo=None, postmortem_dir=None,
+                 postmortem_keep=None):
         import jax
 
         from .sharded import as_serving_mesh, kv_capacity_blocks
@@ -244,6 +262,31 @@ class LLMEngine:
             _env_flag("PADDLE_TPU_REQUEST_LOG", False)
             if request_log is None else bool(request_log)
         )
+        # flight recorder (serving/postmortem.py): a configured directory
+        # turns supervisor events (poison isolation, watchdog trip,
+        # non-finite row, thread death) into pruned on-disk postmortem
+        # bundles; None otherwise and every hook is one pointer test
+        from .postmortem import FlightRecorder
+        from .slo import SLOLedger
+
+        pm_dir = (os.environ.get("PADDLE_TPU_POSTMORTEM_DIR")
+                  if postmortem_dir is None else postmortem_dir) or None
+        self.recorder = None
+        if pm_dir:
+            keep = (int(postmortem_keep) if postmortem_keep is not None
+                    else int(os.environ.get("PADDLE_TPU_POSTMORTEM_KEEP",
+                                            "16") or 16))
+            self.recorder = FlightRecorder(pm_dir, keep=keep).attach(self)
+        # SLO attribution ledger (serving/slo.py): per-request phase
+        # clock + per-(tenant, priority) rollups/histograms and
+        # /debug/slo. On when asked — and whenever the request log or
+        # the flight recorder is on, since both embed the decomposition;
+        # otherwise None and every hook is one pointer test.
+        slo_on = (_env_flag("PADDLE_TPU_SLO", False) if slo is None
+                  else bool(slo))
+        self.slo = (SLOLedger(metrics=self.metrics)
+                    if slo_on or self.request_log
+                    or self.recorder is not None else None)
         self._params, self._buffers = state_dict_arrays(model)
         self._param_shardings = self._buffer_shardings = None
         if self._smesh is not None:
@@ -291,7 +334,7 @@ class LLMEngine:
             prefill_chunk=self.prefill_chunk,
             prefill_interval=prefill_interval, metrics=self.metrics,
             prefix_cache=self.prefix_cache, drafter=drafter,
-            tracer=self.tracer,
+            tracer=self.tracer, slo=self.slo,
         )
         self._requests = {}
         self._step_fns = {}
@@ -313,7 +356,8 @@ class LLMEngine:
     def add_request(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                     eos_token_id=None, request_id=None, top_k=None,
                     top_p=None, spec_decoding=None, num_spec_tokens=None,
-                    trace=None):
+                    trace=None, tenant=None, priority=None,
+                    deadline_s=None):
         """Enqueue one generation request; returns its id. Admission happens
         inside a later `step()` (continuous batching: requests join the
         running batch between decode steps, never blocking them). Prompts of
@@ -323,13 +367,18 @@ class LLMEngine:
         ignores them); `spec_decoding=False` / `num_spec_tokens` opt this
         request out of (or cap) speculative drafting on a spec-enabled
         engine; `trace=True`/`False` forces this request into (out of)
-        the lifecycle tracer regardless of its sampling fraction."""
+        the lifecycle tracer regardless of its sampling fraction;
+        `tenant`/`priority` label the request's SLO accounting class and
+        `deadline_s` its attainment target (serving/slo.py — accounting
+        only here; the async frontend's ``timeout_s`` also enforces)."""
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=request_id, top_k=top_k, top_p=top_p,
                       spec_decoding=spec_decoding,
-                      num_spec_tokens=num_spec_tokens, trace=trace)
+                      num_spec_tokens=num_spec_tokens, trace=trace,
+                      tenant=tenant, priority=priority,
+                      deadline_s=deadline_s)
         return self.add(req)
 
     def mesh_info(self):
@@ -396,6 +445,8 @@ class LLMEngine:
                 req.prompt_ids, self.block_size
             )
         self._requests[req.request_id] = req
+        if self.slo is not None:
+            self.slo.begin(req)   # the `queued` phase opens at arrival
         self.scheduler.add(req)
         self.metrics.inc("requests_added")
         tr = self.tracer
@@ -795,6 +846,10 @@ class LLMEngine:
         self.metrics.inc("nonfinite_rows")
         self.step_faults.append((req.request_id, detail))
         self.abort(req.request_id, reason=f"error:{detail}")
+        if self.recorder is not None:
+            # after the abort: the bundle carries the victim's FINAL
+            # ledger decomposition (record never raises — postmortem.py)
+            self.recorder.record("nonfinite_row", detail=detail, victim=req)
 
     # -- one engine step ---------------------------------------------------
 
@@ -1087,6 +1142,9 @@ class LLMEngine:
             self.metrics.observe(
                 "ttft", now - req.arrival_time, interval=False
             )
+            if self.slo is not None:
+                # the first token closes prefill: decode begins
+                self.slo.transition(req, "decode_compute", now)
             if req.traced:
                 self.tracer.first_token(req, now)
         req.output_ids.append(token)
@@ -1096,6 +1154,11 @@ class LLMEngine:
             or (req.eos_token_id is not None and token == req.eos_token_id)
         )
         if done:
+            if self.slo is not None:
+                # `emit` covers final-token bookkeeping: finish, block
+                # release/publish, terminal logging (its open timestamp
+                # doubles as the last token's emission time for TPOT)
+                self.slo.transition(req, "emit")
             self.scheduler.finish(req)
             self.metrics.inc("requests_finished")
             self._finalize(req, "finished")
@@ -1103,28 +1166,46 @@ class LLMEngine:
 
     def _finalize(self, req, reason):
         """Request-terminal observability (finish AND abort funnel here):
-        close the lifecycle trace span and emit the one-line JSON summary
-        log. Both are no-ops in the default configuration."""
+        close the lifecycle trace span, close the SLO ledger's phase
+        clock (rollups + histograms), and emit the one-line JSON summary
+        log / feed the flight recorder's tail ring. All no-ops in the
+        default configuration."""
         if req.traced:
             self.tracer.end_request(req, reason)
+        if self.slo is None:
+            return   # request_log/recorder imply a ledger (constructor)
+        now = time.monotonic()
+        summary = self.slo.finalize(req, reason, now)
+        if not self.request_log and self.recorder is None:
+            return
+        ms = lambda t: None if t is None else round(t * 1e3, 3)  # noqa: E731
+        line = {
+            "event": "request_done",
+            "request_id": str(req.request_id),
+            "reason": reason,
+            "tenant": req.tenant,
+            "priority": req.priority,
+            "deadline_s": req.deadline_s,
+            "deadline": summary["deadline"],
+            "prompt_tokens": len(req.prompt_ids),
+            "output_tokens": len(req.output_ids),
+            "prefix_hit_tokens": req.prefix_hit_tokens,
+            "spec_accepted_tokens": req.spec_accepted,
+            "preemptions": req.preemptions,
+            "queue_wait_ms": ms(None if req.admit_time is None
+                                else req.admit_time - req.arrival_time),
+            "ttft_ms": ms(summary["ttft_s"]),
+            "tpot_ms": ms(summary["tpot_s"]),
+            # the ledger's e2e, so the line's phase_<name>_ms fields sum
+            # to total_ms by construction (the tested invariant)
+            "total_ms": ms(summary["e2e_s"]),
+        }
+        for p, v in summary["phases_ms"].items():
+            line[f"phase_{p}_ms"] = v
+        if self.recorder is not None:
+            self.recorder.note_request_line(line)
         if self.request_log:
-            now = time.monotonic()
-            ms = lambda t: None if t is None else round(t * 1e3, 3)  # noqa: E731
-            _request_log.info(json.dumps({
-                "event": "request_done",
-                "request_id": str(req.request_id),
-                "reason": reason,
-                "prompt_tokens": len(req.prompt_ids),
-                "output_tokens": len(req.output_ids),
-                "prefix_hit_tokens": req.prefix_hit_tokens,
-                "spec_accepted_tokens": req.spec_accepted,
-                "preemptions": req.preemptions,
-                "queue_wait_ms": ms(None if req.admit_time is None
-                                    else req.admit_time - req.arrival_time),
-                "ttft_ms": ms(None if req.first_token_time is None
-                              else req.first_token_time - req.arrival_time),
-                "total_ms": ms(now - req.arrival_time),
-            }, sort_keys=True))
+            _request_log.info(json.dumps(line, sort_keys=True))
 
     def pool_stats(self):
         """Saturation gauges for /healthz (serving/server.py) and

@@ -301,7 +301,8 @@ class AsyncLLMEngine:
     def submit(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                eos_token_id=None, timeout_s=None, request_id=None,
                top_k=None, top_p=None, spec_decoding=None,
-               num_spec_tokens=None, trace=None):
+               num_spec_tokens=None, trace=None, tenant=None,
+               priority=None):
         """Admit one request; returns its RequestStream. Raises
         EngineClosedError when draining/stopped, EngineOverloadedError when
         the bounded wait queue is full, ValueError on a bad request —
@@ -309,7 +310,10 @@ class AsyncLLMEngine:
         restrict the sampling support; `spec_decoding`/`num_spec_tokens`
         opt out of (or cap) speculative drafting per request;
         `trace=True`/`False` forces this request into (out of) the
-        engine's lifecycle tracer regardless of its sampling fraction."""
+        engine's lifecycle tracer regardless of its sampling fraction;
+        `tenant`/`priority` label the request's SLO accounting class
+        (serving/slo.py) and the effective ``timeout_s`` becomes its
+        deadline-attainment target."""
         from .scheduler import Request
 
         if not self.health.healthy:
@@ -341,11 +345,18 @@ class AsyncLLMEngine:
                 f"{self.max_waiting})",
                 reason="queue_full", retry_after_s=1.0,
             )
+        if timeout_s is None:
+            timeout_s = self.default_timeout_s
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=request_id, top_k=top_k, top_p=top_p,
                       spec_decoding=spec_decoding,
-                      num_spec_tokens=num_spec_tokens, trace=trace)
+                      num_spec_tokens=num_spec_tokens, trace=trace,
+                      tenant=tenant, priority=priority,
+                      # the enforced timeout IS the SLO deadline: the
+                      # ledger judges met/missed against what the serve
+                      # actually promised
+                      deadline_s=timeout_s)
         worst_case_blocks = self.engine.validate(req)
         need = 0
         if self.max_kv_commit_blocks is not None:
@@ -382,8 +393,6 @@ class AsyncLLMEngine:
             self._kv_need[req.request_id] = need
         self._inflight += 1
         self.metrics.set_gauge("frontend_inflight", self._inflight)
-        if timeout_s is None:
-            timeout_s = self.default_timeout_s
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         self._cmds.put(("add", req, deadline))
         return st
@@ -526,9 +535,19 @@ class AsyncLLMEngine:
                     self.engine.abort(rid, reason="error:engine_thread_died")
             except Exception:  # noqa: BLE001 — best-effort last rites on
                 pass               # state the escaping exception may have
-            self._to_loop([(       # already corrupted
+                                   # already corrupted
+            self._to_loop([(
                 "fail_all", None, "error",
                 f"engine thread died: {type(e).__name__}: {e}")])
+            rec = getattr(self.engine, "recorder", None)
+            if rec is not None:
+                # the dying thread's last observability act: one durable
+                # bundle (record never raises — postmortem.py). AFTER
+                # fail_all is posted: a slow postmortem volume must not
+                # delay failure delivery to waiting clients.
+                rec.record("engine_thread_died",
+                           detail=f"{type(e).__name__}: {e}",
+                           health=self.health.snapshot())
         finally:
             self._closed = True
             if self._watchdog is not None:
@@ -616,7 +635,9 @@ class AsyncLLMEngine:
             for rid, dl in list(deadlines.items()):
                 if now >= dl:
                     deadlines.pop(rid)
-                    if eng.abort(rid):
+                    # reason "timeout" labels the trace span/request-log
+                    # line and maps to the SLO ledger's `missed` verdict
+                    if eng.abort(rid, reason="timeout"):
                         live.discard(rid)
                         self.metrics.inc("requests_timeout")
                         events.append(("finish", rid, "timeout", None))

@@ -9,8 +9,11 @@ read them without adapters:
   `xplane.print_schedule_analysis` renders engine schedules exactly like
   device captures;
 - `prometheus_text()` — Prometheus text exposition for the HTTP frontend's
-  `/metrics` endpoint (serving/server.py): counters, gauges, and duration
-  summaries with p50/p95 quantiles;
+  `/metrics` endpoint (serving/server.py): counters, gauges, duration
+  summaries with p50/p95 quantiles, plus LABELED families — `inc_labeled`
+  counters and `observe_hist` true cumulative histograms (ordered ``le``
+  buckets ending ``+Inf`` with ``_sum``/``_count``), which the SLO ledger
+  (serving/slo.py) uses for its per-tenant/priority-class series;
 - direct attribute access for tests (`metrics.counters["preemptions"]`).
 
 Counters and gauges are open-ended (a `defaultdict` — every series any
@@ -40,11 +43,44 @@ The speculative-decoding series (engine emits when spec decoding is on):
 """
 from __future__ import annotations
 
+import bisect
 import re
+import threading
 import time
 from collections import defaultdict
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+# default latency buckets (seconds) for `observe_hist` — a cumulative
+# histogram's resolution is fixed at first observation, so these span
+# sub-millisecond decode steps through multi-second queue waits
+DEFAULT_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _escape_label_value(v):
+    """Exposition-format label-value escaping: a raw backslash, quote, or
+    newline in a label value (e.g. an adversarial tenant name) would
+    invalidate the WHOLE scrape."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _label_tuple(labels):
+    """Normalize a labels mapping to the sorted (key, value) tuple the
+    stores key series by — one canonical order, so {a, b} and {b, a}
+    are the same series."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in dict(labels).items()))
+
+
+def _label_body(label_t, extra=()):
+    return ",".join(
+        f'{_NAME_RE.sub("_", k)}="{_escape_label_value(v)}"'
+        for k, v in tuple(label_t) + tuple(extra))
 
 # HELP text for the well-known series (open-ended producers get a generic
 # fallback). Scrapers surface these verbatim, so say what the number IS,
@@ -122,6 +158,27 @@ _HELP = {
                       "mesh (1 = single-chip)",
     "mesh_device_count": "Devices in this replica's serving mesh",
     "mesh": "Serving mesh topology labels (backend)",
+    "slo_ttft_seconds": "Arrival to first token, by tenant/priority "
+                        "class (SLO ledger)",
+    "slo_tpot_seconds": "Inter-token latency (time per output token), "
+                        "by tenant/priority class",
+    "slo_e2e_seconds": "Request end-to-end wall time, by tenant/priority "
+                       "class",
+    "slo_requests": "Requests finalized by the SLO ledger, by class",
+    "slo_output_tokens": "Output tokens emitted, by tenant/priority "
+                         "class",
+    "slo_phase_seconds": "Request wall time attributed to each lifecycle "
+                         "phase, by class (phases sum to e2e)",
+    "slo_deadline_met": "Requests that finished within their deadline, "
+                        "by class",
+    "slo_deadline_missed": "Requests that finished late or were aborted "
+                           "by their deadline, by class",
+    "slo_deadline_aborted": "Deadline-carrying requests aborted for "
+                            "other reasons, by class",
+    "postmortem_bundles": "Postmortem bundles written by the flight "
+                          "recorder",
+    "postmortem_write_errors": "Flight-recorder bundle writes that "
+                               "failed (disk/permission)",
 }
 
 
@@ -146,9 +203,51 @@ class ServingMetrics:
         )
         self._intervals = []                  # (start_s, end_s, name)
         self._max_intervals = int(max_intervals)
+        # labeled families (the SLO ledger's per-class series):
+        # name -> {"buckets": (...), "series": {label_tuple: {...}}}
+        self._hist = {}
+        # name -> {label_tuple: float}
+        self._labeled = defaultdict(lambda: defaultdict(float))
+        # serializes family writes against scrape/snapshot copies: a
+        # histogram's bucket counts and _sum must come from ONE moment
+        # (unlike the plain counters, where a torn read is a benign
+        # off-by-one, a _count/_sum mismatch is an invalid histogram)
+        self._families_lock = threading.Lock()
 
     def inc(self, name, value=1.0):
         self.counters[name] += value
+
+    def inc_labeled(self, name, labels, value=1.0):
+        """Increment one series of a LABELED counter family — exported
+        as ``<prefix>_<name>_total{label="value",...}``. Callers own
+        label cardinality (the SLO ledger caps its class count)."""
+        with self._families_lock:
+            self._labeled[name][_label_tuple(labels)] += value
+
+    def observe_hist(self, name, value, labels=None, buckets=None):
+        """Record one observation into a TRUE cumulative Prometheus
+        histogram (per label set): bucket counts + ``_sum``/``_count``,
+        unbounded over the process lifetime — aggregable across replicas
+        and windowable by the scraper, unlike the bounded-window summary
+        quantiles `observe` exports. Bucket bounds are fixed by the
+        family's first observation."""
+        with self._families_lock:
+            h = self._hist.get(name)
+            if h is None:
+                h = self._hist[name] = {
+                    "buckets": tuple(DEFAULT_LATENCY_BUCKETS
+                                     if buckets is None else sorted(buckets)),
+                    "series": {},
+                }
+            lt = _label_tuple(labels)
+            s = h["series"].get(lt)
+            if s is None:
+                s = h["series"][lt] = {
+                    "counts": [0] * (len(h["buckets"]) + 1), "sum": 0.0}
+            # le is an INCLUSIVE upper bound: first bucket with bound
+            # >= value
+            s["counts"][bisect.bisect_left(h["buckets"], float(value))] += 1
+            s["sum"] += float(value)
 
     def set_gauge(self, name, value):
         self.gauges[name] = value
@@ -206,11 +305,33 @@ class ServingMetrics:
         return out
 
     def snapshot(self):
-        return {
+        out = {
             "counters": dict(self.counters),
             "gauges": dict(self.gauges),
             "latency": self.latency_summary(),
         }
+        with self._families_lock:
+            if self._labeled:
+                # label tuples are not JSON keys: flatten to rows (the
+                # postmortem bundle is the consumer)
+                out["labeled"] = {
+                    name: [{"labels": dict(lt), "value": v}
+                           for lt, v in sorted(series.items())]
+                    for name, series in self._labeled.items()
+                }
+            if self._hist:
+                out["histograms"] = {
+                    name: {
+                        "buckets": list(h["buckets"]),
+                        "series": [{"labels": dict(lt),
+                                    "counts": list(s["counts"]),
+                                    "sum": s["sum"]}
+                                   for lt, s in sorted(
+                                       h["series"].items())],
+                    }
+                    for name, h in self._hist.items()
+                }
+        return out
 
     def prometheus_text(self, prefix="paddle_tpu_serving"):
         """Prometheus text-format exposition (version 0.0.4): counters as
@@ -238,29 +359,57 @@ class ServingMetrics:
         # mid-scrape (first step after warmup); iterating the live dicts
         # from the event loop could raise "changed size during iteration"
         counters = dict(self.counters)
+        with self._families_lock:
+            labeled = {n: dict(v) for n, v in self._labeled.items()}
+            hists = {n: {"buckets": h["buckets"],
+                         "series": {lt: {"counts": list(s["counts"]),
+                                         "sum": s["sum"]}
+                                    for lt, s in h["series"].items()}}
+                     for n, h in self._hist.items()}
         gauges = dict(self.gauges)
         durations = dict(self._durations)
         for name in sorted(counters):
             m = _n(name) + "_total"
             _header(m, name, "counter")
             lines.append(f"{m} {counters[name]:g}")
+        for name in sorted(labeled):
+            m = _n(name) + "_total"
+            _header(m, name, "counter")
+            for lt in sorted(labeled[name]):
+                lines.append(f"{m}{{{_label_body(lt)}}} "
+                             f"{labeled[name][lt]:g}")
         for name in sorted(gauges):
             m = _n(name)
             _header(m, name, "gauge")
             lines.append(f"{m} {float(gauges[name]):g}")
-        def _lv(v):
-            # exposition-format label escaping: a raw quote/backslash/
-            # newline in a label value would invalidate the WHOLE scrape
-            return (v.replace("\\", r"\\").replace('"', r"\"")
-                    .replace("\n", r"\n"))
-
         for name in sorted(dict(self.infos)):
             labels = self.infos[name]
             m = _n(name) + "_info"
             _header(m, name, "gauge")
-            body = ",".join(f'{_NAME_RE.sub("_", k)}="{_lv(v)}"'
-                            for k, v in sorted(labels.items()))
-            lines.append(f"{m}{{{body}}} 1")
+            lines.append(f"{m}{{{_label_body(sorted(labels.items()))}}} 1")
+        for name in sorted(hists):
+            # exposition-spec histograms: cumulative `le` buckets in
+            # ascending order ending at +Inf, `_count` == the +Inf
+            # bucket, `_sum` alongside — all rendered from ONE snapshot
+            # of the series so a mid-scrape observation cannot make the
+            # family internally inconsistent
+            h = hists[name]
+            m = _n(name)
+            _header(m, name, "histogram")
+            for lt in sorted(h["series"]):
+                s = h["series"][lt]
+                total = sum(s["counts"])
+                cum = 0
+                for ub, c in zip(h["buckets"], s["counts"]):
+                    cum += c
+                    lines.append(
+                        f'{m}_bucket{{{_label_body(lt, (("le", f"{ub:g}"),))}}}'
+                        f" {cum}")
+                lines.append(
+                    f'{m}_bucket{{{_label_body(lt, (("le", "+Inf"),))}}}'
+                    f" {total}")
+                lines.append(f"{m}_sum{{{_label_body(lt)}}} {s['sum']:g}")
+                lines.append(f"{m}_count{{{_label_body(lt)}}} {total}")
         for name in sorted(durations):
             d = durations[name]
             m = _n(name) + "_seconds"

@@ -88,7 +88,8 @@ class Request:
 
     def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                  eos_token_id=None, request_id=None, top_k=None, top_p=None,
-                 spec_decoding=None, num_spec_tokens=None, trace=None):
+                 spec_decoding=None, num_spec_tokens=None, trace=None,
+                 tenant=None, priority=None, deadline_s=None):
         self.request_id = (
             request_id if request_id is not None else next(_rid_counter)
         )
@@ -131,6 +132,24 @@ class Request:
         # engine's sampling fraction), `traced` the engine's decision
         self.trace = None if trace is None else bool(trace)
         self.traced = False
+        # SLO accounting dimensions (serving/slo.py): free-form class
+        # labels (None reads "-" in rollups) and the deadline the ledger
+        # judges attainment against. The frontend stamps its timeout_s
+        # into deadline_s; on a bare engine the deadline is accounting
+        # only (nothing enforces it). Labels are truncated: they are
+        # stored per class and rendered on every /metrics scrape, so an
+        # adversarial multi-MB tenant string must not ride the 8 MB
+        # request-body cap into resident metrics state (the class COUNT
+        # is bounded by the ledger's max_classes fold).
+        self.tenant = None if tenant is None else str(tenant)[:64]
+        self.priority = None if priority is None else str(priority)[:64]
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0 (or None)")
+        # SLO phase clock (serving/slo.py drives these; inert otherwise)
+        self.phase = None
+        self.phase_since = 0.0
+        self.phases = {}
         self.wait_since = self.arrival_time  # start of current wait span
         self.admit_time = None        # FIRST admission (queue-wait anchor)
         self.first_token_time = None
@@ -177,7 +196,7 @@ class Request:
 class Scheduler:
     def __init__(self, pool, max_batch=8, token_budget=2048,
                  prefill_chunk=None, prefill_interval=None, metrics=None,
-                 prefix_cache=True, drafter=None, tracer=None):
+                 prefix_cache=True, drafter=None, tracer=None, slo=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.token_budget = int(token_budget)
@@ -203,6 +222,10 @@ class Scheduler:
         # lifecycle tracer (serving/trace.py EngineTracer) or None; every
         # hook below is gated on `tracer is not None and req.traced`
         self.tracer = tracer
+        # SLO ledger (serving/slo.py SLOLedger) or None — admission and
+        # preemption are two of its phase-clock transitions; same
+        # one-pointer-test discipline as the tracer
+        self.slo = slo
         self.waiting = deque()
         self.running = []
 
@@ -284,6 +307,8 @@ class Scheduler:
         req.state = WAITING
         req.preemptions += 1
         req.wait_since = time.monotonic()
+        if self.slo is not None:
+            self.slo.transition(req, "preempted", req.wait_since)
         if self.tracer is not None and req.traced:
             self.tracer.request_instant(req, "preempt")
         if req in self.running:
@@ -406,6 +431,13 @@ class Scheduler:
         now = time.monotonic()
         if req.admit_time is None:
             req.admit_time = now   # queue wait = first admission only
+        if self.slo is not None:
+            # compute phase opens at admission: prefill while >1 token
+            # is pending (fresh prompts AND post-preemption replays),
+            # decode when only the pending sampled token remains
+            self.slo.transition(
+                req, "prefill_compute" if req.num_pending > 1
+                else "decode_compute", now)
         if self.tracer is not None and req.traced:
             self.tracer.request_admitted(req, now)
         self.running.append(req)

@@ -35,6 +35,17 @@ framework), one process, loopback-friendly for tests. Endpoints:
   404 with a hint unless the engine was built with tracing on
   (``PADDLE_TPU_TRACE=1`` or ``LLMEngine(trace=...)``); a request body
   may set ``"trace": true`` to force itself into a sampled trace.
+- ``GET /debug/slo`` — the SLO ledger's per-(tenant, priority) rollup
+  (serving/slo.py): p50/p95 TTFT and TPOT, tokens/s, preemption share,
+  phase-decomposition totals, deadline attainment. 404 with a hint
+  unless the ledger is on (``PADDLE_TPU_SLO=1`` / ``LLMEngine(slo=True)``
+  / request log / flight recorder). Request bodies may carry ``tenant``
+  (alias ``user``) and ``priority`` to label their class; ``timeout_s``
+  doubles as the deadline-attainment target.
+- ``GET /debug/postmortem`` — manifests of the flight recorder's
+  postmortem bundles (serving/postmortem.py; one bundle per poison
+  isolation, watchdog trip, non-finite row, or engine-thread death).
+  404 with a hint unless ``PADDLE_TPU_POSTMORTEM_DIR`` is configured.
 
 `ServingServer.shutdown(drain=True)` is the graceful path: the listener
 closes (no new connections), the engine stops admitting and finishes or
@@ -225,6 +236,47 @@ class ServingServer:
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             ))
             return await writer.drain()
+        if path == "/debug/slo":
+            ledger = getattr(self.engine.engine, "slo", None)
+            if ledger is None:
+                writer.write(_http_response(
+                    "404 Not Found",
+                    _error_body(
+                        404,
+                        "the SLO ledger is off — start the engine with "
+                        "PADDLE_TPU_SLO=1 (or LLMEngine(slo=True)) for "
+                        "per-class latency attribution rollups",
+                        "not_found"),
+                ))
+                return await writer.drain()
+            # rollup copies + sorts the per-class percentile windows —
+            # off the event loop so a scrape can't stall live SSE
+            # streams (the /debug/trace and /debug/postmortem
+            # discipline; rollup itself is thread-safe)
+            body = await asyncio.to_thread(ledger.rollup)
+            writer.write(_http_response("200 OK", body))
+            return await writer.drain()
+        if path == "/debug/postmortem":
+            rec = getattr(self.engine.engine, "recorder", None)
+            if rec is None:
+                writer.write(_http_response(
+                    "404 Not Found",
+                    _error_body(
+                        404,
+                        "the flight recorder is off — set "
+                        "PADDLE_TPU_POSTMORTEM_DIR (or "
+                        "LLMEngine(postmortem_dir=...)) to write "
+                        "postmortem bundles on fault events",
+                        "not_found"),
+                ))
+                return await writer.drain()
+            # disk reads off the event loop: a slow volume must never
+            # stall live SSE streams (the /debug/trace discipline)
+            body = await asyncio.to_thread(
+                lambda: json.dumps({"dir": rec.dir, "keep": rec.keep,
+                                    "bundles": rec.list_bundles()}).encode())
+            writer.write(_http_response("200 OK", body))
+            return await writer.drain()
         if path == "/debug/trace":
             tracer = getattr(self.engine.engine, "tracer", None)
             if tracer is None:
@@ -336,6 +388,16 @@ class ServingServer:
             trace = spec.get("trace")
             if trace is not None:
                 trace = bool(trace)
+            # SLO accounting dimensions (serving/slo.py): `tenant` (the
+            # OpenAI-style `user` field is accepted as an alias) and
+            # `priority` label the request's class in /debug/slo and the
+            # slo_* metrics; the effective timeout_s is its deadline
+            tenant = spec.get("tenant", spec.get("user"))
+            if tenant is not None:
+                tenant = str(tenant)
+            priority = spec.get("priority")
+            if priority is not None:
+                priority = str(priority)
             stream = bool(spec.get("stream", False))
         except (ValueError, TypeError) as e:
             writer.write(_http_response(
@@ -348,7 +410,7 @@ class ServingServer:
                 eos_token_id=eos, timeout_s=timeout_s, top_k=top_k,
                 top_p=top_p, spec_decoding=spec_decoding,
                 num_spec_tokens=num_spec_tokens, trace=trace,
-                request_id=request_id,
+                request_id=request_id, tenant=tenant, priority=priority,
             )
         except EngineOverloadedError as e:
             writer.write(_http_response(
@@ -521,6 +583,19 @@ def main(argv=None):
     p.add_argument("--request-log", action="store_true",
                    help="log one JSON summary line per finished/aborted "
                         "request (same as PADDLE_TPU_REQUEST_LOG=1)")
+    p.add_argument("--slo", action="store_true",
+                   help="enable the SLO attribution ledger: per-request "
+                        "phase decomposition, per-tenant/priority "
+                        "rollups at GET /debug/slo, and slo_* Prometheus "
+                        "histograms (same as PADDLE_TPU_SLO=1)")
+    p.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                   help="enable the fault flight recorder: write one "
+                        "postmortem bundle per supervisor event to DIR, "
+                        "listable at GET /debug/postmortem (same as "
+                        "PADDLE_TPU_POSTMORTEM_DIR)")
+    p.add_argument("--postmortem-keep", type=int, default=None,
+                   help="bundles kept before oldest-first pruning "
+                        "(default 16; same as PADDLE_TPU_POSTMORTEM_KEEP)")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -536,6 +611,9 @@ def main(argv=None):
         spec_decoding=True if args.spec_decode else None,
         num_spec_tokens=args.num_spec_tokens,
         trace=args.trace, request_log=True if args.request_log else None,
+        slo=True if args.slo else None,
+        postmortem_dir=args.postmortem_dir,
+        postmortem_keep=args.postmortem_keep,
         # pass the degree through untouched: --tp-degree 1 is an EXPLICIT
         # single-chip request and must beat a PADDLE_TPU_TP env default
         # (the engine only consults the env when mesh is None/unset)

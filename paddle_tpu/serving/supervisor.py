@@ -192,6 +192,15 @@ class EngineSupervisor:
         # suspects' FCFS order in the waiting queue.
         for rid in reversed(suspects):
             eng.requeue(rid)
+        if eng.slo is not None:
+            # recovery wait is failure-boundary time, not an ordinary
+            # preemption: re-label the suspects' phase clock so the SLO
+            # decomposition attributes bisection/replay waits to
+            # `stalled` (re-admission flips them back to compute)
+            for rid in suspects:
+                req = eng._requests.get(rid)
+                if req is not None and not req.finished:
+                    eng.slo.transition(req, "stalled")
         if tr is not None:
             tr.supervisor_instant("step_failed", {
                 "step": eng.step_count, "error": detail,
@@ -199,8 +208,15 @@ class EngineSupervisor:
         culprit, outs, probe_failures = self._bisect(suspects)
         failures += probe_failures
         if culprit is not None:
+            victim = eng._requests.get(culprit)
             eng.abort(culprit, reason=f"error:{type(exc).__name__}")
             eng.metrics.inc("poison_requests_isolated")
+            if eng.recorder is not None:
+                # one bundle per isolation, carrying the culprit's final
+                # ledger decomposition (record never raises)
+                eng.recorder.record("poison_isolated", detail=detail,
+                                    victim=victim,
+                                    health=self.health.snapshot())
             if tr is not None:
                 tr.supervisor_instant("poison_isolated", {
                     "request_id": culprit, "error": detail})
@@ -323,6 +339,19 @@ class EngineSupervisor:
             step=eng.step_count)
         eng.metrics.inc("watchdog_trips")
         eng.metrics.set_gauge("engine_unhealthy", 1.0)
+        if eng.slo is not None:
+            # the engine thread is wedged inside the step (by definition
+            # not touching these clocks): attribute the hung-step wait
+            # of every planned request to `stalled` from here on
+            for rid in eng.last_planned:
+                req = eng._requests.get(rid)
+                if req is not None and not req.finished:
+                    eng.slo.transition(req, "stalled")
+        if eng.recorder is not None:
+            eng.recorder.record(
+                "watchdog_trip",
+                detail=f"step stuck for {stuck_for_s:.3f}s",
+                health=self.health.snapshot())
         if eng.tracer is not None:
             eng.tracer.supervisor_instant("watchdog_trip", {
                 "stuck_for_s": round(stuck_for_s, 3),

@@ -310,11 +310,21 @@ def test_request_log_lines(model, caplog):
         assert r["ttft_ms"] > 0 and r["queue_wait_ms"] >= 0
         assert r["ttft_ms"] <= r["total_ms"]
     assert by_id[str(victim)]["reason"] == "aborted"
+    from paddle_tpu.serving import slo as slo_mod
+
+    phase_keys = {f"phase_{p}_ms" for p in slo_mod.PHASES}
     for r in recs:                       # the full greppable schema
         assert {"event", "request_id", "reason", "prompt_tokens",
                 "output_tokens", "prefix_hit_tokens",
                 "spec_accepted_tokens", "preemptions", "queue_wait_ms",
-                "ttft_ms", "total_ms"} <= set(r)
+                "ttft_ms", "tpot_ms", "total_ms", "tenant", "priority",
+                "deadline_s", "deadline"} <= set(r)
+        # the line's phase fields are derived from the ledger's phase
+        # vocabulary (slo.PHASES) — line and ledger cannot drift — and
+        # the decomposition sums to the line's own total_ms
+        assert phase_keys <= set(r)
+        assert sum(r[k] for k in phase_keys) == pytest.approx(
+            r["total_ms"], abs=0.05)
 
 
 def test_request_log_off_by_default(model, caplog, monkeypatch):
