@@ -488,6 +488,131 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
     return out
 
 
+def bench_gpt_serve_router(on_tpu, errors, deadline_s):
+    """Replica-fleet router wave (serving/router.py): a mixed-tenant
+    workload — `chat` (shared system prompt, short tails), `batch`
+    (unique prompts, long generations), `long` (shared long-context
+    prefix) — served through 2 replicas twice: prefix-AFFINITY routing
+    vs the no-affinity (least-loaded) router. One JSON line reports
+    per-class p95 TTFT, deadline attainment, tokens/s, and the prefix-
+    cache hit rate per mode; the affinity router must keep the shared-
+    prefix classes' hit rate ABOVE the no-affinity spread (PR 4's cache
+    win surviving fan-out — the ROADMAP item-1 acceptance)."""
+    import asyncio
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import (AsyncLLMEngine, LLMEngine,
+                                    ReplicaRouter, SLOLedger)
+
+    del on_tpu  # a routing-policy wave: CPU-sized model either way
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=256, attn_impl="xla")
+    model = GPT(cfg)
+    model.eval()
+    rs = np.random.RandomState(0)
+    gen = 8 if _fast() else 16
+    chat_prefix = rs.randint(0, cfg.vocab_size, (64,)).tolist()
+    long_prefix = rs.randint(0, cfg.vocab_size, (128,)).tolist()
+    reqs = []   # (class, prompt, max_new)
+    for _ in range(8):
+        reqs.append(("chat", chat_prefix
+                     + rs.randint(0, cfg.vocab_size, (8,)).tolist(), gen))
+    for _ in range(4):
+        reqs.append(("batch",
+                     rs.randint(0, cfg.vocab_size, (32,)).tolist(), 2 * gen))
+    for _ in range(4):
+        reqs.append(("long", long_prefix
+                     + rs.randint(0, cfg.vocab_size, (16,)).tolist(), gen))
+
+    async def wave(affinity):
+        engines = [AsyncLLMEngine(LLMEngine(
+            model, block_size=16, max_batch=4, slo=True)) for _ in range(2)]
+        router = ReplicaRouter(engines, affinity=affinity,
+                               sweep_interval_s=0.05)
+        await router.start()
+        # warm each replica directly (compile outside the timing; the
+        # warm prompt shares no prefix with the wave)
+        for e in engines:
+            await e.submit(rs.randint(0, cfg.vocab_size, (8,)).tolist(),
+                           max_new_tokens=2, temperature=0.0).collect()
+        for e in engines:
+            e.engine.metrics.reset_schedule()
+            e.engine.slo.reset()
+        t0 = time.perf_counter()
+        streams = []
+        for cls, p, n in reqs:
+            streams.append(await router.submit(
+                p, max_new_tokens=n, temperature=0.0,
+                tenant=cls, deadline_s=120.0))
+            # small inter-arrival gap: a zero-gap burst admits every
+            # shared-prefix request before the first can publish its
+            # blocks, zeroing the hit rate in BOTH modes — real traffic
+            # arrives over time
+            await asyncio.sleep(0.02)
+        outs = [await s.collect() for s in streams]
+        dt = time.perf_counter() - t0
+        generated = sum(len(t) for t, _ in outs)
+        # per-class hit rate: matched prefix tokens / full-block prompt
+        # tokens, off each routed request's own record
+        per_class = {}
+        bs = 16
+        for (cls, p, _n), s in zip(reqs, streams):
+            hit, lookup = per_class.setdefault(cls, [0, 0])
+            per_class[cls] = [hit + (s.req.prefix_hit_tokens or 0),
+                              lookup + (len(p) // bs) * bs]
+        rates = {cls: round(h / lu, 4) if lu else 0.0
+                 for cls, (h, lu) in per_class.items()}
+        merged = SLOLedger.merged_rollup(
+            [e.engine.slo for e in engines])
+        classes = {c["tenant"]: c for c in merged["classes"]}
+        out = {
+            "tok_s": round(generated / dt, 1),
+            "hit_rate_by_class": rates,
+            "deadline_attainment": merged["total"]["deadline"]["attainment"],
+            "ttft_p95_ms_by_class": {
+                cls: classes[cls]["ttft_ms"]["p95"] for cls in rates
+                if cls in classes},
+            "failed": sum(1 for _, r in outs if r not in ("length", "stop")),
+        }
+        await router.shutdown()
+        return out
+
+    async def both():
+        a = await wave(True)
+        if time.monotonic() > deadline_s:
+            errors.append("gpt_serve_router: deadline before no-affinity "
+                          "wave; comparison dropped")
+            return a, None
+        b = await wave(False)
+        return a, b
+
+    aff, noaff = asyncio.run(both())
+    out = {"value": aff["tok_s"], "requests": len(reqs), "replicas": 2,
+           "affinity": aff}
+    if aff["failed"]:
+        errors.append(f"gpt_serve_router: {aff['failed']} affinity-wave "
+                      "requests failed")
+    if noaff is not None:
+        out["no_affinity"] = noaff
+        # the acceptance signal: shared-prefix classes keep their cache
+        # win only when routed by affinity
+        for cls in ("chat", "long"):
+            a, b = (aff["hit_rate_by_class"].get(cls, 0.0),
+                    noaff["hit_rate_by_class"].get(cls, 0.0))
+            out[f"{cls}_affinity_hit_gain"] = round(a - b, 4)
+            if a <= b:
+                errors.append(f"gpt_serve_router: affinity hit rate {a} "
+                              f"not above no-affinity {b} on {cls!r}")
+        out["affinity_preserves_cache_win"] = all(
+            out[f"{c}_affinity_hit_gain"] > 0 for c in ("chat", "long"))
+        _log(f"router serve: affinity {aff['tok_s']} tok/s "
+             f"(hit {aff['hit_rate_by_class']}) vs no-affinity "
+             f"{noaff['tok_s']} tok/s (hit {noaff['hit_rate_by_class']})")
+    return out
+
+
 def _serve_shared_prefix(model, cfg, max_batch, rs, errors, deadline_s,
                          on_tpu):
     """Shared-system-prompt wave: N requests = one long common prefix +
@@ -868,6 +993,7 @@ _BENCHES = {
     "gpt": bench_gpt,
     "gpt_serve": bench_gpt_serve,
     "gpt_serve_multichip": bench_gpt_serve_multichip,
+    "gpt_serve_router": bench_gpt_serve_router,
     "resnet50": bench_resnet50,
     "lenet": bench_lenet,
     "ppyoloe": bench_ppyoloe,
@@ -1040,6 +1166,16 @@ def main():
     if mc:
         completed += 1
         extras["gpt_serve_multichip"] = mc
+
+    # fleet-router wave: mixed tenants over 2 replicas, affinity vs
+    # no-affinity, per-class p95 TTFT / attainment / cache hit rate
+    r = _run_isolated("gpt_serve_router", min(240.0, _remaining()))
+    errors.extend(r.get("errors") or [])
+    rt = _emit_model("gpt_serve_router", r, "tokens/sec",
+                     metric="gpt_serve_router_tokens_per_sec")
+    if rt:
+        completed += 1
+        extras["gpt_serve_router"] = rt
 
     units = {"resnet50": "samples/sec", "ppyoloe": "ms", "lenet": "ms"}
     for name in ("resnet50", "ppyoloe", "lenet"):

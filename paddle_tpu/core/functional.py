@@ -13,6 +13,7 @@ without an interpreter.
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Any, Dict
 
 import jax
@@ -20,6 +21,18 @@ import jax.numpy as jnp
 
 from . import autograd, rng
 from .tensor import Tensor
+
+# Serializes the swap-state window across THREADS: swap_state mutates the
+# layer's own Tensor objects (t._array) for the duration of the forward,
+# so two threads tracing through the SAME layer concurrently (e.g. two
+# serving-engine replicas built over one model — serving/router.py) would
+# interleave swap/restore and each restore the OTHER's tracers into the
+# layer, leaking them into later traces. functional_call only runs at
+# trace time (the compiled program replays without it) and in eager
+# utility paths, so holding one reentrant lock across the swapped forward
+# serializes compiles, never steady-state steps. RLock: pipeline/parallel
+# wrappers nest functional_call within a traced forward on one thread.
+_SWAP_LOCK = threading.RLock()
 
 
 def state_dict_arrays(layer):
@@ -69,18 +82,21 @@ def functional_call(layer, params, buffers, args=(), kwargs=None, rng_key=None, 
         for k, v in kwargs.items()
     }
 
-    prev_training = layer.training
-    if training is not None:
-        layer.train() if training else layer.eval()
-    try:
-        with autograd.trace_mode(), swap_state(layer, params, buffers) as bmap:
-            ctx = rng.key_scope(rng_key) if rng_key is not None else contextlib.nullcontext()
-            with ctx:
-                out = layer(*args, **kwargs)
-            new_buffers = {k: t._array for k, t in bmap.items()}
-    finally:
+    with _SWAP_LOCK:
+        prev_training = layer.training
         if training is not None:
-            layer.train() if prev_training else layer.eval()
+            layer.train() if training else layer.eval()
+        try:
+            with autograd.trace_mode(), \
+                    swap_state(layer, params, buffers) as bmap:
+                ctx = (rng.key_scope(rng_key) if rng_key is not None
+                       else contextlib.nullcontext())
+                with ctx:
+                    out = layer(*args, **kwargs)
+                new_buffers = {k: t._array for k, t in bmap.items()}
+        finally:
+            if training is not None:
+                layer.train() if prev_training else layer.eval()
     out_arrays = jax.tree_util.tree_map(
         lambda x: x._array if isinstance(x, Tensor) else x,
         out,

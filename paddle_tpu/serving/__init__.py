@@ -26,6 +26,16 @@ refcounts host-side and unchanged, still exactly three compiled
 programs. Greedy sharded output is token-for-token identical to the
 single-chip engine. See README "Sharded serving".
 
+**Replica-fleet routing** (serving/router.py): `ReplicaRouter` fronts N
+`AsyncLLMEngine` replicas (each optionally tp-sharded) — shared prefixes
+consistent-hash to a home replica so the prefix-cache win survives
+fan-out, cache-cold traffic spreads least-loaded, and the PR 9 health
+states drive ejection, half-open probe re-admission, retry-elsewhere
+(safe-retry: only zero-token requests replay), deadline-aware early
+rejection, and rolling drain. `RouterServer` (server.py, or
+``python -m paddle_tpu.serving.server --replicas N``) is the fleet HTTP
+surface. See README "Fleet routing".
+
 Quickstart::
 
     from paddle_tpu.models.gpt import gpt_tiny
@@ -80,9 +90,14 @@ from .frontend import (  # noqa: F401
 )
 from .metrics import ServingMetrics  # noqa: F401
 from .postmortem import FlightRecorder  # noqa: F401
+from .router import (  # noqa: F401
+    Replica,
+    ReplicaRouter,
+    RoutedStream,
+)
 from .scheduler import Request, Scheduler  # noqa: F401
 from .slo import SLOLedger  # noqa: F401
-from .server import ServingServer  # noqa: F401
+from .server import RouterServer, ServingServer  # noqa: F401
 from .sharded import (  # noqa: F401
     ServingMesh,
     as_serving_mesh,

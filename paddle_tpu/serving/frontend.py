@@ -169,7 +169,7 @@ class AsyncLLMEngine:
                  default_timeout_s=None, idle_poll_s=0.02,
                  max_step_retries=3, watchdog_step_timeout_s=None,
                  watchdog_poll_s=None, max_kv_commit_blocks=None,
-                 hard_stop_timeout_s=30.0):
+                 hard_stop_timeout_s=30.0, poison_window_s=60.0):
         self.engine = engine
         self.metrics = engine.metrics
         self.max_waiting = int(max_waiting)
@@ -181,7 +181,8 @@ class AsyncLLMEngine:
         # step timeout is configured
         self.health = EngineHealth()
         self._sup = EngineSupervisor(
-            engine, max_step_retries=max_step_retries, health=self.health)
+            engine, max_step_retries=max_step_retries, health=self.health,
+            poison_window_s=poison_window_s)
         self.watchdog_step_timeout_s = watchdog_step_timeout_s
         self._watchdog = (
             None if watchdog_step_timeout_s is None
@@ -235,11 +236,60 @@ class AsyncLLMEngine:
     def inflight(self):
         return self._inflight
 
+    @property
+    def supervisor(self):
+        """The EngineSupervisor running this engine's steps — the health
+        word plus the poison-isolation window the fleet router's
+        ejection policy reads (serving/router.py)."""
+        return self._sup
+
+    def healthz_state(self):
+        """The PR 9 ``/healthz`` word as ``(state, health_snapshot)``
+        without the HTTP layer: ``"ok"`` / ``"draining"`` /
+        ``"unhealthy"`` / ``"engine_dead"``. This is THE one derivation
+        of a replica's externally visible health — `ServingServer`
+        renders it on ``/healthz`` and the fleet router drives its
+        per-replica ejection state machine from it, so the two can never
+        disagree. Precedence: a dead engine thread outranks everything
+        (nothing can serve), sticky-unhealthy (watchdog trip, thread
+        death recorded by the crash handler) outranks draining, and
+        draining (admission closed, or never started) outranks ok."""
+        h = self.health.snapshot()
+        thread_dead = self._thread is not None and not self._thread.is_alive()
+        if thread_dead or (not h["healthy"] and h.get("reason") in
+                           ("engine_thread_died", "engine_thread_wedged")):
+            return "engine_dead", h
+        if not h["healthy"]:
+            return "unhealthy", h
+        if self._closed or self._thread is None:
+            return "draining", h
+        return "ok", h
+
     def stop_admitting(self):
         """Flip admission off (submit raises EngineClosedError) without
         stopping the step loop — the load-balancer drain pattern: stop
         taking traffic first, `shutdown()` once drained."""
         self._closed = True
+
+    def resume_admitting(self):
+        """Reopen admission after `stop_admitting` — the restartless half
+        of a rolling drain (serving/router.py drains one replica, waits
+        for in-flight zero, then reopens instead of restarting when no
+        replica factory is configured). Only a live, healthy engine may
+        reopen: raising here instead of silently staying closed keeps a
+        drain from \"completing\" against a replica that can never serve
+        again."""
+        if self._thread is None or not self._thread.is_alive():
+            raise EngineClosedError(
+                "engine thread is dead; cannot resume admission",
+                reason="engine_dead", retry_after_s=None,
+            )
+        if not self.health.healthy:
+            raise EngineClosedError(
+                f"engine unhealthy: {self.health.reason}; cannot resume "
+                "admission", reason="unhealthy", retry_after_s=None,
+            )
+        self._closed = False
 
     async def shutdown(self, drain=True, timeout_s=30.0):
         """Graceful drain: stop admitting, finish (or, past ``timeout_s``,

@@ -179,6 +179,58 @@ _HELP = {
                           "recorder",
     "postmortem_write_errors": "Flight-recorder bundle writes that "
                                "failed (disk/permission)",
+    "poison_isolated_in_window": "Poison isolations inside the "
+                                 "supervisor's sliding window",
+    "poison_distinct_sources": "Distinct request sources (tenants) with "
+                               "a poison isolation in the window — the "
+                               "router's sick-chip ejection signal",
+    "router_requests": "Requests submitted to the replica-fleet router",
+    "router_requests_completed": "Routed requests that finished "
+                                 "naturally (length/stop)",
+    "router_requests_failed": "Routed requests that ended with a "
+                              "terminal error",
+    "router_routed_affinity": "Admissions routed to the prefix-affinity "
+                              "home replica",
+    "router_routed_load": "Admissions routed by least-loaded spread "
+                          "(cache-cold or diverted traffic)",
+    "router_affinity_diverted": "Affinity-homed requests diverted to a "
+                                "less-loaded replica to protect their "
+                                "deadline",
+    "router_admission_rejects": "Per-replica admission rejections the "
+                                "router absorbed by trying elsewhere",
+    "router_retries": "Backoff rounds after every eligible replica "
+                      "rejected an admission",
+    "router_replays": "Zero-token requests replayed on another replica "
+                      "after a replica-attributed stream error",
+    "router_midstream_errors": "Streams failed mid-flight by a replica "
+                               "fault after tokens were delivered "
+                               "(never replayed — the safe-retry rule)",
+    "router_early_rejections": "Requests rejected because the predicted "
+                               "queue wait already exceeded their "
+                               "deadline (reject-early beats miss-SLO)",
+    "router_ejections": "Replicas ejected from rotation (unhealthy, "
+                        "dead, or poison-rate)",
+    "router_probes": "Half-open re-admission probes run against "
+                     "ejected replicas",
+    "router_readmissions": "Ejected replicas re-admitted after a "
+                           "passing half-open probe",
+    "router_restarts": "Replica engines rebuilt via the replica factory "
+                       "(probe recovery or rolling drain)",
+    "router_drains": "Replicas drained by a rolling drain pass",
+    "router_replica_events": "Per-replica lifecycle events (eject / "
+                             "readmit / restart / drain), by replica",
+    "router_replica_requests": "Admissions per replica, by routing "
+                               "decision (affinity vs load)",
+    "router_replicas_active": "Replicas currently in rotation",
+    "router_replicas_draining": "Replicas draining (router- or "
+                                "replica-initiated)",
+    "router_replicas_ejected": "Replicas out of rotation awaiting a "
+                               "half-open probe",
+    "router_replicas_probing": "Replicas running a half-open "
+                               "re-admission probe",
+    "router_inflight": "Requests in flight across the whole fleet",
+    "router_prefix_cache_hit_rate": "Fleet-aggregate prefix-cache "
+                                    "hit/lookup ratio across replicas",
 }
 
 

@@ -1,7 +1,11 @@
 """HTTP serving frontend: OpenAI-style completions over AsyncLLMEngine.
 
 Stdlib-only (asyncio + hand-rolled HTTP/1.1 — the container adds no web
-framework), one process, loopback-friendly for tests. Endpoints:
+framework), one process, loopback-friendly for tests. Two servers share
+one HTTP base (`_HTTPServerBase`): `ServingServer` fronts ONE replica
+(an `AsyncLLMEngine`), `RouterServer` fronts a replica FLEET
+(`serving/router.py`'s `ReplicaRouter` — prefix-affinity routing,
+health-aware ejection, retry-elsewhere, rolling drain). Endpoints:
 
 - ``POST /v1/completions`` — OpenAI-style body. ``prompt`` is a list of
   token ids (the repo ships no tokenizer; ``token_ids`` come back in every
@@ -11,25 +15,34 @@ framework), one process, loopback-friendly for tests. Endpoints:
   was built with it enabled. ``stream: true`` sends
   server-sent events, one token per ``data:`` chunk, terminated by
   ``data: [DONE]``. Admission control maps straight onto status codes:
-  429 when the bounded wait queue is full (`EngineOverloadedError`), 503
-  while draining (`EngineClosedError`), 400 on invalid requests. A client
-  that disconnects mid-request is detected (EOF on its socket) and its
-  request is aborted — KV blocks return to the pool while the engine keeps
-  serving everyone else.
-- ``GET /healthz`` — 200 ``{"status": "ok"}`` with in-flight gauges plus
-  the engine's saturation stats (`LLMEngine.pool_stats`: truly-free vs
-  cached-free vs allocated KV blocks, running/waiting request counts), so
-  a load balancer or operator can see saturation WITHOUT scraping
-  `/metrics`; 503 ``{"status": "draining"}`` during shutdown; 503
+  429 when the bounded wait queue is full (`EngineOverloadedError`) — or,
+  through the router, when the predicted queue wait on every replica
+  already blows the deadline (``deadline_unattainable``, reject-early
+  beats miss-SLO) — 503 while draining (`EngineClosedError`), 400 on
+  invalid requests. A client that disconnects mid-request is detected
+  (EOF on its socket) and its request is aborted — KV blocks return to
+  the pool while the engine keeps serving everyone else.
+- ``GET /healthz`` — the PR 9 health word, derived ONCE in
+  `AsyncLLMEngine.healthz_state` so the HTTP surface and the router's
+  ejection policy can never disagree: 200 ``{"status": "ok"}`` with
+  in-flight gauges plus the engine's saturation stats
+  (`LLMEngine.pool_stats`) and the supervisor's sliding-window
+  poison-isolation stats (``poison``: isolations + DISTINCT sources in
+  the window — the router's sick-chip ejection signal); 503
+  ``{"status": "draining"}`` during shutdown; 503
   ``{"status": "unhealthy", "reason": "step_stuck", "stuck_for_s": ...}``
-  when the supervision layer tripped (stuck-step watchdog, dead engine
-  thread — serving/supervisor.py). Unhealthy is sticky: the replica
+  when the supervision layer tripped; 503 ``{"status": "engine_dead"}``
+  when the engine thread is gone. Unhealthy is sticky: the replica
   stays out of rotation until restarted. 429/503 rejections from
   `/v1/completions` carry a ``Retry-After`` header and a structured
   ``error.reason`` (``queue_full`` / ``kv_capacity`` / ``draining`` /
-  ``unhealthy`` / ``engine_dead``) so clients and LBs back off correctly.
+  ``unhealthy`` / ``engine_dead`` / ``deadline_unattainable`` /
+  ``no_replica``) so clients and LBs back off correctly. The
+  RouterServer's ``/healthz`` reports the FLEET: per-replica router
+  state + healthz word, 200 while at least one replica is in rotation.
 - ``GET /metrics`` — Prometheus text exposition from ServingMetrics
-  (counters ``_total``, gauges, step/TTFT duration summaries).
+  (counters ``_total``, gauges, step/TTFT duration summaries; the
+  router's scrape adds fleet gauges and per-replica labeled counters).
 - ``GET /debug/trace`` — the engine's lifecycle/step trace as
   Chrome/Perfetto trace-event JSON (open at https://ui.perfetto.dev).
   404 with a hint unless the engine was built with tracing on
@@ -41,17 +54,22 @@ framework), one process, loopback-friendly for tests. Endpoints:
   unless the ledger is on (``PADDLE_TPU_SLO=1`` / ``LLMEngine(slo=True)``
   / request log / flight recorder). Request bodies may carry ``tenant``
   (alias ``user``) and ``priority`` to label their class; ``timeout_s``
-  doubles as the deadline-attainment target.
+  doubles as the deadline-attainment target. On the RouterServer this is
+  the FLEET rollup (`SLOLedger.merged_rollup` across replica ledgers).
 - ``GET /debug/postmortem`` — manifests of the flight recorder's
   postmortem bundles (serving/postmortem.py; one bundle per poison
   isolation, watchdog trip, non-finite row, or engine-thread death).
   404 with a hint unless ``PADDLE_TPU_POSTMORTEM_DIR`` is configured.
+- ``GET /debug/router`` (RouterServer only) — the routing table: every
+  replica's state machine + healthz word, recent lifecycle events
+  (ejections, probes, restarts, drains), and the routing knobs.
 
 `ServingServer.shutdown(drain=True)` is the graceful path: the listener
 closes (no new connections), the engine stops admitting and finishes or
 aborts in-flight work, open SSE streams run to their natural end, then the
 server exits. ``python -m paddle_tpu.serving.server`` boots a demo server
-around a randomly initialized GPT (see README "HTTP serving quickstart").
+around a randomly initialized GPT (see README "HTTP serving quickstart");
+``--replicas N`` boots the fleet router instead.
 """
 from __future__ import annotations
 
@@ -82,9 +100,10 @@ def _http_response(status, body, content_type="application/json",
 def _error_body(status, message, err_type, reason=None):
     err = {"message": message, "type": err_type, "code": status}
     if reason is not None:
-        # machine-readable backoff hint: queue_full / kv_capacity (429 —
-        # retry this replica) vs draining / unhealthy / engine_dead (503 —
-        # the LB should prefer another replica)
+        # machine-readable backoff hint: queue_full / kv_capacity /
+        # deadline_unattainable (429 — back off, retry) vs draining /
+        # unhealthy / engine_dead / no_replica (503 — the LB should
+        # prefer another replica/fleet)
         err["reason"] = reason
     return {"error": err}
 
@@ -97,34 +116,64 @@ def _retry_after(exc, default=None):
     return (f"Retry-After: {max(1, int(round(s)))}",)
 
 
-class ServingServer:
-    def __init__(self, engine, host="127.0.0.1", port=0,
-                 model_name="paddle-tpu-gpt", max_waiting=64,
-                 stream_queue_size=64, default_timeout_s=None,
-                 watchdog_step_timeout_s=None, max_step_retries=3,
-                 max_kv_commit_blocks=None):
-        if isinstance(engine, AsyncLLMEngine):
-            if (max_waiting != 64 or stream_queue_size != 64
-                    or default_timeout_s is not None
-                    or watchdog_step_timeout_s is not None
-                    or max_step_retries != 3
-                    or max_kv_commit_blocks is not None):
-                raise ValueError(
-                    "max_waiting/stream_queue_size/default_timeout_s/"
-                    "watchdog_step_timeout_s/max_step_retries/"
-                    "max_kv_commit_blocks belong to the AsyncLLMEngine "
-                    "you passed — set them there"
-                )
-        else:
-            engine = AsyncLLMEngine(
-                engine, max_waiting=max_waiting,
-                stream_queue_size=stream_queue_size,
-                default_timeout_s=default_timeout_s,
-                watchdog_step_timeout_s=watchdog_step_timeout_s,
-                max_step_retries=max_step_retries,
-                max_kv_commit_blocks=max_kv_commit_blocks,
-            )
-        self.engine = engine
+def _parse_completion_spec(body):
+    """Parse an OpenAI-style ``/v1/completions`` body into canonical
+    submit kwargs plus ``stream`` — ONE parser for both servers, so the
+    single-replica and routed surfaces accept byte-identical bodies.
+    Raises ValueError/TypeError on a bad request (HTTP 400)."""
+    spec = json.loads(body or b"{}")
+    if not isinstance(spec, dict):
+        raise ValueError("body must be a JSON object")
+    prompt = spec.get("prompt", spec.get("prompt_token_ids"))
+    if (not isinstance(prompt, list) or not prompt
+            or not all(isinstance(t, int) for t in prompt)):
+        raise ValueError(
+            "'prompt' must be a non-empty list of token ids "
+            "(no tokenizer ships with the server)"
+        )
+    kw = {"prompt_ids": prompt,
+          "max_new_tokens": int(spec.get("max_tokens", 16)),
+          "temperature": float(spec.get("temperature", 0.0))}
+    top_k = spec.get("top_k")
+    kw["top_k"] = None if top_k is None else int(top_k)
+    top_p = spec.get("top_p")
+    kw["top_p"] = None if top_p is None else float(top_p)
+    spec_decoding = spec.get("spec_decoding")
+    kw["spec_decoding"] = (None if spec_decoding is None
+                           else bool(spec_decoding))
+    num_spec = spec.get("num_spec_tokens")
+    kw["num_spec_tokens"] = None if num_spec is None else int(num_spec)
+    eos = spec.get("eos_token_id", spec.get("stop_token_id"))
+    kw["eos_token_id"] = None if eos is None else int(eos)
+    timeout_s = spec.get("timeout_s")
+    kw["timeout_s"] = None if timeout_s is None else float(timeout_s)
+    request_id = spec.get("request_id")
+    # client-supplied correlation id (shows up in traces, the request
+    # log, and fault-plan pins); duplicates are 400s
+    kw["request_id"] = None if request_id is None else str(request_id)
+    trace = spec.get("trace")
+    kw["trace"] = None if trace is None else bool(trace)
+    # SLO accounting dimensions (serving/slo.py): `tenant` (the
+    # OpenAI-style `user` field is accepted as an alias) and `priority`
+    # label the request's class in /debug/slo and the slo_* metrics;
+    # the effective timeout_s is its deadline
+    tenant = spec.get("tenant", spec.get("user"))
+    kw["tenant"] = None if tenant is None else str(tenant)
+    priority = spec.get("priority")
+    kw["priority"] = None if priority is None else str(priority)
+    return kw, bool(spec.get("stream", False))
+
+
+class _HTTPServerBase:
+    """Shared stdlib HTTP/1.1 plumbing: connection handling, the
+    completions request/response cycle (SSE + non-streaming, disconnect
+    detection, status-code mapping), lifecycle. Subclasses provide the
+    backend through four hooks: `_start_backend`, `_submit(kw)` (returns
+    an async token stream with `finish_reason`/`error`/`request_id`),
+    `_abort_stream(st)`, and `_backend_metrics`."""
+
+    def __init__(self, host="127.0.0.1", port=0,
+                 model_name="paddle-tpu-gpt"):
         self.host = host
         self.port = int(port)
         self.model_name = model_name
@@ -134,35 +183,27 @@ class ServingServer:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self):
-        await self.engine.start()
+        await self._start_backend()
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port, limit=_MAX_HEAD
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self
 
-    def begin_drain(self):
-        """Stop admitting while the listener stays up: `/healthz` flips to
-        503 (so a load balancer pulls this replica) and `/v1/completions`
-        rejects with 503, but in-flight streams keep running. Call
-        `shutdown()` to finish the drain and close."""
-        self._draining = True
-        self.engine.stop_admitting()
-
-    async def shutdown(self, drain=True, timeout_s=30.0):
-        """Graceful: stop accepting, drain (or abort) the engine, let open
-        streams finish, close. Safe to call twice."""
-        self._draining = True
-        if self._server is not None:
-            self._server.close()
-        await self.engine.shutdown(drain=drain, timeout_s=timeout_s)
-        if self._server is not None:
-            await self._server.wait_closed()
-            self._server = None
-
     async def serve_forever(self):
         async with self._server:
             await self._server.serve_forever()
+
+    async def shutdown(self, drain=True, timeout_s=30.0):
+        """Graceful: stop accepting, drain (or abort) the backend, let
+        open streams finish, close. Safe to call twice."""
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+        await self._shutdown_backend(drain=drain, timeout_s=timeout_s)
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
 
     # -- connection handling ----------------------------------------------
 
@@ -219,6 +260,192 @@ class ServingServer:
             except (ConnectionError, RuntimeError):
                 pass
 
+    # -- /v1/completions ---------------------------------------------------
+
+    async def _completions(self, body, reader, writer):
+        try:
+            kw, stream = _parse_completion_spec(body)
+        except (ValueError, TypeError) as e:
+            writer.write(_http_response(
+                "400 Bad Request", _error_body(400, str(e), "bad_request")
+            ))
+            return await writer.drain()
+        prompt_len = len(kw["prompt_ids"])
+        try:
+            st = await self._submit(kw)
+        except EngineOverloadedError as e:
+            writer.write(_http_response(
+                "429 Too Many Requests",
+                _error_body(429, str(e), "overloaded",
+                            reason=getattr(e, "reason", "queue_full")),
+                extra_headers=_retry_after(e, default=1.0),
+            ))
+            return await writer.drain()
+        except EngineClosedError as e:
+            reason = getattr(e, "reason", "draining")
+            writer.write(_http_response(
+                "503 Service Unavailable",
+                # type doubles as the reason (back-compat: clients match
+                # on "draining"); reason is the canonical field
+                _error_body(503, str(e), reason, reason=reason),
+                extra_headers=_retry_after(e),
+            ))
+            return await writer.drain()
+        except ValueError as e:
+            writer.write(_http_response(
+                "400 Bad Request", _error_body(400, str(e), "bad_request")
+            ))
+            return await writer.drain()
+        rid = f"cmpl-{st.request_id}"
+        # the monitor task sees EOF the moment the client goes away — even
+        # while we are parked waiting for tokens — and turns the disconnect
+        # into an engine abort that frees the request's KV blocks. Stray
+        # inbound bytes (trailing CRLF, an optimistic pipelined request —
+        # we answer Connection: close) are drained, NOT treated as a hangup
+        monitor = asyncio.ensure_future(self._watch_eof(reader))
+        work = asyncio.ensure_future(
+            self._stream_sse(st, rid, prompt_len, writer) if stream
+            else self._respond_full(st, rid, prompt_len, writer)
+        )
+        done, _ = await asyncio.wait(
+            {monitor, work}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if work not in done:
+            self._abort_stream(st)
+            self._backend_metrics.inc("client_disconnects")
+        await work
+        monitor.cancel()
+        try:
+            await monitor
+        except (asyncio.CancelledError, ConnectionError, OSError):
+            pass
+
+    @staticmethod
+    async def _watch_eof(reader):
+        while await reader.read(4096):
+            pass
+
+    def _chunk(self, rid, token_ids, finish_reason):
+        return {
+            "id": rid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "text": " ".join(str(t) for t in token_ids),
+                "token_ids": list(token_ids),
+                "finish_reason": finish_reason,
+            }],
+        }
+
+    async def _stream_sse(self, st, rid, prompt_tokens, writer):
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        n = 0
+        try:
+            await writer.drain()
+            async for tok in st:
+                n += 1
+                payload = json.dumps(self._chunk(rid, [tok], None))
+                writer.write(f"data: {payload}\n\n".encode())
+                await writer.drain()
+            final = self._chunk(rid, [], st.finish_reason)
+            final["usage"] = {
+                "prompt_tokens": prompt_tokens, "completion_tokens": n,
+                "total_tokens": prompt_tokens + n,
+            }
+            writer.write(f"data: {json.dumps(final)}\n\ndata: [DONE]\n\n"
+                         .encode())
+            await writer.drain()
+        except ConnectionError:
+            # client went away mid-stream; the monitor (or this) aborts
+            self._abort_stream(st)
+
+    async def _respond_full(self, st, rid, prompt_tokens, writer):
+        toks, reason = await st.collect()
+        if reason == "error":
+            writer.write(_http_response(
+                "500 Internal Server Error",
+                _error_body(500, st.error or "engine error", "engine_error"),
+            ))
+            return await writer.drain()
+        out = self._chunk(rid, toks, reason)
+        out["usage"] = {
+            "prompt_tokens": prompt_tokens, "completion_tokens": len(toks),
+            "total_tokens": prompt_tokens + len(toks),
+        }
+        try:
+            writer.write(_http_response("200 OK", out))
+            await writer.drain()
+        except ConnectionError:
+            pass
+
+
+class ServingServer(_HTTPServerBase):
+    def __init__(self, engine, host="127.0.0.1", port=0,
+                 model_name="paddle-tpu-gpt", max_waiting=64,
+                 stream_queue_size=64, default_timeout_s=None,
+                 watchdog_step_timeout_s=None, max_step_retries=3,
+                 max_kv_commit_blocks=None):
+        super().__init__(host=host, port=port, model_name=model_name)
+        if isinstance(engine, AsyncLLMEngine):
+            if (max_waiting != 64 or stream_queue_size != 64
+                    or default_timeout_s is not None
+                    or watchdog_step_timeout_s is not None
+                    or max_step_retries != 3
+                    or max_kv_commit_blocks is not None):
+                raise ValueError(
+                    "max_waiting/stream_queue_size/default_timeout_s/"
+                    "watchdog_step_timeout_s/max_step_retries/"
+                    "max_kv_commit_blocks belong to the AsyncLLMEngine "
+                    "you passed — set them there"
+                )
+        else:
+            engine = AsyncLLMEngine(
+                engine, max_waiting=max_waiting,
+                stream_queue_size=stream_queue_size,
+                default_timeout_s=default_timeout_s,
+                watchdog_step_timeout_s=watchdog_step_timeout_s,
+                max_step_retries=max_step_retries,
+                max_kv_commit_blocks=max_kv_commit_blocks,
+            )
+        self.engine = engine
+
+    # -- backend hooks -----------------------------------------------------
+
+    async def _start_backend(self):
+        await self.engine.start()
+
+    async def _submit(self, kw):
+        return self.engine.submit(**kw)
+
+    def _abort_stream(self, st):
+        self.engine.abort(st.request_id)
+
+    @property
+    def _backend_metrics(self):
+        return self.engine.metrics
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def begin_drain(self):
+        """Stop admitting while the listener stays up: `/healthz` flips to
+        503 (so a load balancer pulls this replica) and `/v1/completions`
+        rejects with 503, but in-flight streams keep running. Call
+        `shutdown()` to finish the drain and close."""
+        self._draining = True
+        self.engine.stop_admitting()
+
+    async def _shutdown_backend(self, drain, timeout_s):
+        await self.engine.shutdown(drain=drain, timeout_s=timeout_s)
+
+    # -- routes ------------------------------------------------------------
+
     async def _route(self, method, path, body, reader, writer):
         if path == "/healthz":
             return await self._healthz(writer)
@@ -227,10 +454,13 @@ class ServingServer:
             # cached-free vs allocated blocks, running/waiting) refresh
             # from the live engine at scrape time so dashboards never need
             # to scrape a non-Prometheus endpoint — plain int reads,
-            # GIL-consistent, no engine-thread handshake
+            # GIL-consistent, no engine-thread handshake. The poison
+            # window refreshes its gauges the same way (they must decay
+            # with the window, not freeze at the last isolation).
             m = self.engine.metrics
             for k, v in self.engine.engine.pool_stats().items():
                 m.set_gauge(f"pool_{k}", v)
+            self.engine.supervisor.poison_stats()
             writer.write(_http_response(
                 "200 OK", m.prometheus_text(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
@@ -310,17 +540,13 @@ class ServingServer:
         await writer.drain()
 
     async def _healthz(self, writer):
-        health = self.engine.health.snapshot()
-        draining = self._draining or not self.engine.started
-        if not health["healthy"]:
-            # unhealthy outranks draining: the LB must see WHY the replica
-            # is out (step_stuck carries stuck_for_s from the trip; the
-            # watchdog bounds detection at timeout + one poll interval)
-            status, state = "503 Service Unavailable", "unhealthy"
-        elif draining:
-            status, state = "503 Service Unavailable", "draining"
-        else:
-            status, state = "200 OK", "ok"
+        # the ONE health derivation (frontend.healthz_state — the router
+        # ejects off the same word): engine_dead > unhealthy > draining
+        # > ok; the server's own listener drain adds to "draining"
+        state, health = self.engine.healthz_state()
+        if state == "ok" and self._draining:
+            state = "draining"
+        status = "200 OK" if state == "ok" else "503 Service Unavailable"
         payload = {
             "status": state,
             "inflight": self.engine.inflight,
@@ -333,6 +559,10 @@ class ServingServer:
             # split by tier + scheduler queue depths (plain ints read off
             # the live engine — GIL-consistent, no engine-thread handshake)
             "pool": self.engine.engine.pool_stats(),
+            # the poison-isolation window (supervisor.poison_stats): a
+            # fleet router ejects a replica whose attributions span many
+            # DISTINCT sources — a sick chip, not a bad client
+            "poison": self.engine.supervisor.poison_stats(),
             "gauges": {
                 k: v for k, v in dict(self.engine.metrics.gauges).items()
                 if isinstance(v, (int, float))
@@ -346,190 +576,129 @@ class ServingServer:
         writer.write(_http_response(status, payload))
         await writer.drain()
 
-    # -- /v1/completions ---------------------------------------------------
 
-    async def _completions(self, body, reader, writer):
-        try:
-            spec = json.loads(body or b"{}")
-            if not isinstance(spec, dict):
-                raise ValueError("body must be a JSON object")
-            prompt = spec.get("prompt", spec.get("prompt_token_ids"))
-            if (not isinstance(prompt, list) or not prompt
-                    or not all(isinstance(t, int) for t in prompt)):
-                raise ValueError(
-                    "'prompt' must be a non-empty list of token ids "
-                    "(no tokenizer ships with the server)"
-                )
-            max_tokens = int(spec.get("max_tokens", 16))
-            temperature = float(spec.get("temperature", 0.0))
-            top_k = spec.get("top_k")
-            if top_k is not None:
-                top_k = int(top_k)
-            top_p = spec.get("top_p")
-            if top_p is not None:
-                top_p = float(top_p)
-            spec_decoding = spec.get("spec_decoding")
-            if spec_decoding is not None:
-                spec_decoding = bool(spec_decoding)
-            num_spec_tokens = spec.get("num_spec_tokens")
-            if num_spec_tokens is not None:
-                num_spec_tokens = int(num_spec_tokens)
-            eos = spec.get("eos_token_id", spec.get("stop_token_id"))
-            if eos is not None:
-                eos = int(eos)
-            timeout_s = spec.get("timeout_s")
-            if timeout_s is not None:
-                timeout_s = float(timeout_s)
-            request_id = spec.get("request_id")
-            if request_id is not None:
-                # client-supplied correlation id (shows up in traces, the
-                # request log, and fault-plan pins); duplicates are 400s
-                request_id = str(request_id)
-            trace = spec.get("trace")
-            if trace is not None:
-                trace = bool(trace)
-            # SLO accounting dimensions (serving/slo.py): `tenant` (the
-            # OpenAI-style `user` field is accepted as an alias) and
-            # `priority` label the request's class in /debug/slo and the
-            # slo_* metrics; the effective timeout_s is its deadline
-            tenant = spec.get("tenant", spec.get("user"))
-            if tenant is not None:
-                tenant = str(tenant)
-            priority = spec.get("priority")
-            if priority is not None:
-                priority = str(priority)
-            stream = bool(spec.get("stream", False))
-        except (ValueError, TypeError) as e:
-            writer.write(_http_response(
-                "400 Bad Request", _error_body(400, str(e), "bad_request")
-            ))
-            return await writer.drain()
-        try:
-            st = self.engine.submit(
-                prompt, max_new_tokens=max_tokens, temperature=temperature,
-                eos_token_id=eos, timeout_s=timeout_s, top_k=top_k,
-                top_p=top_p, spec_decoding=spec_decoding,
-                num_spec_tokens=num_spec_tokens, trace=trace,
-                request_id=request_id, tenant=tenant, priority=priority,
-            )
-        except EngineOverloadedError as e:
-            writer.write(_http_response(
-                "429 Too Many Requests",
-                _error_body(429, str(e), "overloaded",
-                            reason=getattr(e, "reason", "queue_full")),
-                extra_headers=_retry_after(e, default=1.0),
-            ))
-            return await writer.drain()
-        except EngineClosedError as e:
-            reason = getattr(e, "reason", "draining")
-            writer.write(_http_response(
-                "503 Service Unavailable",
-                # type doubles as the reason (back-compat: clients match
-                # on "draining"); reason is the canonical field
-                _error_body(503, str(e), reason, reason=reason),
-                extra_headers=_retry_after(e),
-            ))
-            return await writer.drain()
-        except ValueError as e:
-            writer.write(_http_response(
-                "400 Bad Request", _error_body(400, str(e), "bad_request")
-            ))
-            return await writer.drain()
-        rid = f"cmpl-{st.request_id}"
-        # the monitor task sees EOF the moment the client goes away — even
-        # while we are parked waiting for tokens — and turns the disconnect
-        # into an engine abort that frees the request's KV blocks. Stray
-        # inbound bytes (trailing CRLF, an optimistic pipelined request —
-        # we answer Connection: close) are drained, NOT treated as a hangup
-        monitor = asyncio.ensure_future(self._watch_eof(reader))
-        work = asyncio.ensure_future(
-            self._stream_sse(st, rid, len(prompt), writer) if stream
-            else self._respond_full(st, rid, len(prompt), writer)
-        )
-        done, _ = await asyncio.wait(
-            {monitor, work}, return_when=asyncio.FIRST_COMPLETED
-        )
-        if work not in done:
-            self.engine.abort(st.request_id)
-            self.engine.metrics.inc("client_disconnects")
-        await work
-        monitor.cancel()
-        try:
-            await monitor
-        except (asyncio.CancelledError, ConnectionError, OSError):
-            pass
+class RouterServer(_HTTPServerBase):
+    """The fleet surface: ``/v1/completions`` routes through a
+    `ReplicaRouter` (prefix affinity, ejection, retry-elsewhere),
+    ``/healthz`` reports every replica's state machine, ``/metrics``
+    exposes the router's own series, ``/debug/slo`` merges the replicas'
+    SLO ledgers into one fleet rollup, and ``/debug/router`` dumps the
+    routing table + lifecycle event log."""
 
-    @staticmethod
-    async def _watch_eof(reader):
-        while await reader.read(4096):
-            pass
+    def __init__(self, router, host="127.0.0.1", port=0,
+                 model_name="paddle-tpu-gpt"):
+        super().__init__(host=host, port=port, model_name=model_name)
+        self.router = router
 
-    def _chunk(self, rid, token_ids, finish_reason):
-        return {
-            "id": rid,
-            "object": "text_completion",
-            "created": int(time.time()),
-            "model": self.model_name,
-            "choices": [{
-                "index": 0,
-                "text": " ".join(str(t) for t in token_ids),
-                "token_ids": list(token_ids),
-                "finish_reason": finish_reason,
-            }],
+    # -- backend hooks -----------------------------------------------------
+
+    async def _start_backend(self):
+        await self.router.start()
+
+    async def _submit(self, kw):
+        return await self.router.submit(**kw)
+
+    def _abort_stream(self, st):
+        st.abort()
+
+    @property
+    def _backend_metrics(self):
+        return self.router.metrics
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def begin_drain(self):
+        """Stop admitting fleet-wide while in-flight streams finish (the
+        LB drain pattern, one level up). For a zero-downtime RESTART use
+        `router.rolling_drain()` instead — it never rejects anybody."""
+        self._draining = True
+        self.router.stop_admitting()
+
+    async def _shutdown_backend(self, drain, timeout_s):
+        await self.router.shutdown(drain=drain, timeout_s=timeout_s)
+
+    # -- routes ------------------------------------------------------------
+
+    async def _route(self, method, path, body, reader, writer):
+        if path == "/healthz":
+            return await self._healthz(writer)
+        if path == "/metrics":
+            self.router.refresh_metrics()
+            writer.write(_http_response(
+                "200 OK", self.router.metrics.prometheus_text(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            ))
+            return await writer.drain()
+        if path == "/debug/router":
+            writer.write(_http_response("200 OK", self.router.snapshot()))
+            return await writer.drain()
+        if path == "/debug/slo":
+            from .slo import SLOLedger
+
+            ledgers = [r.engine.engine.slo for r in self.router.replicas
+                       if r.engine.engine.slo is not None]
+            if not ledgers:
+                writer.write(_http_response(
+                    "404 Not Found",
+                    _error_body(
+                        404,
+                        "no replica runs the SLO ledger — build the "
+                        "replica engines with PADDLE_TPU_SLO=1 (or "
+                        "LLMEngine(slo=True)) for the fleet rollup",
+                        "not_found"),
+                ))
+                return await writer.drain()
+            # merged rollup copies + sorts every replica's percentile
+            # windows — off the event loop (the /debug/slo discipline)
+            body = await asyncio.to_thread(
+                lambda: SLOLedger.merged_rollup(ledgers))
+            writer.write(_http_response("200 OK", body))
+            return await writer.drain()
+        if path == "/v1/completions":
+            if method != "POST":
+                writer.write(_http_response(
+                    "405 Method Not Allowed",
+                    _error_body(405, "use POST", "bad_request"),
+                ))
+                return await writer.drain()
+            return await self._completions(body, reader, writer)
+        writer.write(_http_response(
+            "404 Not Found", _error_body(404, f"no route {path}", "not_found")
+        ))
+        await writer.drain()
+
+    async def _healthz(self, writer):
+        snap = self.router.snapshot()
+        active = sum(1 for r in snap["replicas"] if r["state"] == "active")
+        if self._draining:
+            status, state = "503 Service Unavailable", "draining"
+        elif active:
+            status, state = "200 OK", "ok"
+        else:
+            # the whole fleet is out of rotation: nothing can serve
+            status, state = "503 Service Unavailable", "unavailable"
+        self.router.refresh_metrics()
+        payload = {
+            "status": state,
+            "replicas_active": active,
+            "replicas": snap["replicas"],
+            "events": snap["events"][-16:],
+            "gauges": {
+                k: v for k, v in dict(self.router.metrics.gauges).items()
+                if isinstance(v, (int, float))
+            },
         }
-
-    async def _stream_sse(self, st, rid, prompt_tokens, writer):
-        writer.write(
-            b"HTTP/1.1 200 OK\r\n"
-            b"Content-Type: text/event-stream\r\n"
-            b"Cache-Control: no-cache\r\n"
-            b"Connection: close\r\n\r\n"
-        )
-        n = 0
-        try:
-            await writer.drain()
-            async for tok in st:
-                n += 1
-                payload = json.dumps(self._chunk(rid, [tok], None))
-                writer.write(f"data: {payload}\n\n".encode())
-                await writer.drain()
-            final = self._chunk(rid, [], st.finish_reason)
-            final["usage"] = {
-                "prompt_tokens": prompt_tokens, "completion_tokens": n,
-                "total_tokens": prompt_tokens + n,
-            }
-            writer.write(f"data: {json.dumps(final)}\n\ndata: [DONE]\n\n"
-                         .encode())
-            await writer.drain()
-        except ConnectionError:
-            # client went away mid-stream; the monitor (or this) aborts
-            self.engine.abort(st.request_id)
-
-    async def _respond_full(self, st, rid, prompt_tokens, writer):
-        toks, reason = await st.collect()
-        if reason == "error":
-            writer.write(_http_response(
-                "500 Internal Server Error",
-                _error_body(500, st.error or "engine error", "engine_error"),
-            ))
-            return await writer.drain()
-        out = self._chunk(rid, toks, reason)
-        out["usage"] = {
-            "prompt_tokens": prompt_tokens, "completion_tokens": len(toks),
-            "total_tokens": prompt_tokens + len(toks),
-        }
-        try:
-            writer.write(_http_response("200 OK", out))
-            await writer.drain()
-        except ConnectionError:
-            pass
+        writer.write(_http_response(status, payload))
+        await writer.drain()
 
 
 def main(argv=None):
     """Demo entry point: ``python -m paddle_tpu.serving.server`` boots a
     randomly initialized GPT (no checkpoint ships with the repo) behind the
     HTTP frontend — enough to exercise streaming, metrics, and the
-    backpressure/deadline knobs end to end."""
+    backpressure/deadline knobs end to end. ``--replicas N`` boots N
+    engine replicas behind the fleet router (prefix-affinity routing,
+    ejection, retry-elsewhere; see README "Fleet routing")."""
     import argparse
 
     p = argparse.ArgumentParser(description=main.__doc__)
@@ -540,6 +709,17 @@ def main(argv=None):
     p.add_argument("--max-batch", type=int, default=4)
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument("--prefill-chunk", type=int, default=None)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve N engine replicas behind the fleet router "
+                        "(serving/router.py): prefix-affinity routing, "
+                        "health-aware ejection, retry-elsewhere; 1 = the "
+                        "single-replica server")
+    p.add_argument("--retry-budget", type=int, default=3,
+                   help="router retry budget: backoff rounds + zero-token "
+                        "replays per request before the failure is final")
+    p.add_argument("--no-affinity", action="store_true",
+                   help="disable prefix-affinity routing (least-loaded "
+                        "spread only; for A/B benchmarks)")
     p.add_argument("--tp-degree", type=int, default=None,
                    help="tensor-parallel degree: shard weights + the KV "
                         "arena over a 'tp' mesh of this many devices "
@@ -604,40 +784,69 @@ def main(argv=None):
 
     paddle.seed(0)
     model = (gpt_tiny if args.model == "tiny" else gpt_small)(attn_impl="xla")
-    engine = LLMEngine(
-        model, block_size=args.block_size, max_batch=args.max_batch,
-        max_seq_len=args.max_seq_len, prefill_chunk=args.prefill_chunk,
-        prefix_cache=False if args.no_prefix_cache else None,
-        spec_decoding=True if args.spec_decode else None,
-        num_spec_tokens=args.num_spec_tokens,
-        trace=args.trace, request_log=True if args.request_log else None,
-        slo=True if args.slo else None,
-        postmortem_dir=args.postmortem_dir,
-        postmortem_keep=args.postmortem_keep,
-        # pass the degree through untouched: --tp-degree 1 is an EXPLICIT
-        # single-chip request and must beat a PADDLE_TPU_TP env default
-        # (the engine only consults the env when mesh is None/unset)
-        mesh=args.tp_degree,
-        kv_hbm_bytes=args.kv_hbm_bytes,
-    )
+
+    def build_engine():
+        return LLMEngine(
+            model, block_size=args.block_size, max_batch=args.max_batch,
+            max_seq_len=args.max_seq_len, prefill_chunk=args.prefill_chunk,
+            prefix_cache=False if args.no_prefix_cache else None,
+            spec_decoding=True if args.spec_decode else None,
+            num_spec_tokens=args.num_spec_tokens,
+            trace=args.trace,
+            request_log=True if args.request_log else None,
+            slo=True if args.slo else None,
+            postmortem_dir=args.postmortem_dir,
+            postmortem_keep=args.postmortem_keep,
+            # pass the degree through untouched: --tp-degree 1 is an
+            # EXPLICIT single-chip request and must beat a PADDLE_TPU_TP
+            # env default (the engine only consults the env when mesh is
+            # None/unset)
+            mesh=args.tp_degree,
+            kv_hbm_bytes=args.kv_hbm_bytes,
+        )
+
     if args.request_log:
         import logging
 
         logging.basicConfig(level=logging.INFO, format="%(message)s")
 
     async def run():
-        server = ServingServer(
-            engine, host=args.host, port=args.port,
-            max_waiting=args.max_waiting,
-            stream_queue_size=args.stream_queue_size,
-            default_timeout_s=args.timeout_s,
-            watchdog_step_timeout_s=args.watchdog_step_timeout_s,
-            max_step_retries=args.max_step_retries,
-            max_kv_commit_blocks=args.max_kv_commit_blocks,
-        )
+        if args.replicas > 1:
+            from .router import ReplicaRouter
+
+            def wrap(engine):
+                return AsyncLLMEngine(
+                    engine, max_waiting=args.max_waiting,
+                    stream_queue_size=args.stream_queue_size,
+                    default_timeout_s=args.timeout_s,
+                    watchdog_step_timeout_s=args.watchdog_step_timeout_s,
+                    max_step_retries=args.max_step_retries,
+                    max_kv_commit_blocks=args.max_kv_commit_blocks,
+                )
+
+            router = ReplicaRouter(
+                [wrap(build_engine()) for _ in range(args.replicas)],
+                factory=lambda _i: wrap(build_engine()),
+                affinity=not args.no_affinity,
+                retry_budget=args.retry_budget,
+                default_timeout_s=args.timeout_s,
+            )
+            server = RouterServer(router, host=args.host, port=args.port)
+        else:
+            server = ServingServer(
+                build_engine(), host=args.host, port=args.port,
+                max_waiting=args.max_waiting,
+                stream_queue_size=args.stream_queue_size,
+                default_timeout_s=args.timeout_s,
+                watchdog_step_timeout_s=args.watchdog_step_timeout_s,
+                max_step_retries=args.max_step_retries,
+                max_kv_commit_blocks=args.max_kv_commit_blocks,
+            )
         await server.start()
-        print(f"serving on http://{server.host}:{server.port} "
-              f"(POST /v1/completions, GET /healthz, GET /metrics)",
+        mode = (f"{args.replicas}-replica router" if args.replicas > 1
+                else "single replica")
+        print(f"serving on http://{server.host}:{server.port} ({mode}; "
+              f"POST /v1/completions, GET /healthz, GET /metrics)",
               flush=True)
         try:
             await server.serve_forever()

@@ -247,7 +247,8 @@ class SLOLedger:
 
     # -- export -------------------------------------------------------------
 
-    def _entry(self, tenant, priority, c):
+    @staticmethod
+    def _entry(tenant, priority, c):
         dl = dict(c["deadline"])
         denom = dl["met"] + dl["missed"] + dl["aborted"]
         dl["attainment"] = round(dl["met"] / denom, 4) if denom else None
@@ -275,15 +276,11 @@ class SLOLedger:
             "deadline": dl,
         }
 
-    def rollup(self):
-        """The ``GET /debug/slo`` JSON: one entry per (tenant, priority)
-        class plus a ``total`` aggregate, all from the same finalize
-        stream the ``slo_*`` Prometheus series are built on. Percentiles
-        use the bounded recent window (`window` per class, the
-        metrics.py convention); the histograms are cumulative — the two
-        agree on quiesced traffic and the tests lock the bracket."""
+    def _snapshot_classes(self):
+        """Deep-copied ``[(class_key, aggregates)]`` under the lock — the
+        one snapshot `rollup` and `merged_rollup` both build from."""
         with self._lock:
-            snap = [(k, {
+            return [(k, {
                 **{f: c[f] for f in ("requests", "finished", "aborted",
                                      "preemptions", "output_tokens",
                                      "e2e_total_s", "t_first", "t_last")},
@@ -292,6 +289,9 @@ class SLOLedger:
                 "ttft": list(c["ttft"]), "tpot": list(c["tpot"]),
                 "e2e": list(c["e2e"]),
             }) for k, c in self._classes.items()]
+
+    @classmethod
+    def _rollup_from_snapshot(cls, snap):
         total = _new_class()
         for _, c in snap:
             for f in ("requests", "finished", "aborted", "preemptions",
@@ -309,10 +309,50 @@ class SLOLedger:
                                 else pick(total[t], c[t]))
         return {
             "phases": list(PHASES),
-            "classes": [self._entry(k[0], k[1], c)
+            "classes": [cls._entry(k[0], k[1], c)
                         for k, c in sorted(snap)],
-            "total": self._entry("*", "*", total),
+            "total": cls._entry("*", "*", total),
         }
+
+    def rollup(self):
+        """The ``GET /debug/slo`` JSON: one entry per (tenant, priority)
+        class plus a ``total`` aggregate, all from the same finalize
+        stream the ``slo_*`` Prometheus series are built on. Percentiles
+        use the bounded recent window (`window` per class, the
+        metrics.py convention); the histograms are cumulative — the two
+        agree on quiesced traffic and the tests lock the bracket."""
+        return self._rollup_from_snapshot(self._snapshot_classes())
+
+    @classmethod
+    def merged_rollup(cls, ledgers):
+        """One FLEET-level rollup over several replicas' ledgers — the
+        router's ``GET /debug/slo``. Each ledger is snapshotted under its
+        own lock, same-class aggregates merge by summing counters and
+        concatenating the percentile windows (merged percentiles come
+        from the pooled observations — per-replica p95s cannot be
+        averaged), and the result has exactly `rollup`'s shape, so a
+        dashboard reading one replica reads the fleet unchanged."""
+        merged = {}
+        for ledger in ledgers:
+            for k, c in ledger._snapshot_classes():
+                t = merged.get(k)
+                if t is None:
+                    merged[k] = c
+                    continue
+                for f in ("requests", "finished", "aborted", "preemptions",
+                          "output_tokens", "e2e_total_s"):
+                    t[f] += c[f]
+                for p in PHASES:
+                    t["phase_s"][p] += c["phase_s"][p]
+                for v in ("met", "missed", "aborted"):
+                    t["deadline"][v] += c["deadline"][v]
+                for w in ("ttft", "tpot", "e2e"):
+                    t[w].extend(c[w])
+                for tk, pick in (("t_first", min), ("t_last", max)):
+                    if c[tk] is not None:
+                        t[tk] = (c[tk] if t[tk] is None
+                                 else pick(t[tk], c[tk]))
+        return cls._rollup_from_snapshot(sorted(merged.items()))
 
     def reset(self):
         """Drop the per-class aggregates (e.g. after a bench warmup) —

@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 
 class EngineHealth:
@@ -121,10 +122,21 @@ class EngineSupervisor:
     engine's non-finite containment terminated with an ``error`` finish.
     """
 
-    def __init__(self, engine, max_step_retries=3, health=None):
+    def __init__(self, engine, max_step_retries=3, health=None,
+                 poison_window_s=60.0):
         self.engine = engine
         self.max_step_retries = max(1, int(max_step_retries))
         self.health = EngineHealth() if health is None else health
+        # sliding poison-isolation window (the PR 9 known limit, closed at
+        # the fleet level): every bisection attribution is recorded with
+        # its request SOURCE — the tenant label, or "-" for untenanted
+        # traffic — so `poison_stats` can distinguish one adversarial
+        # client feeding poison (one distinct source, however many
+        # isolations) from a sick chip poisoning everyone's requests
+        # (many distinct sources). The router ejects on the latter only.
+        self.poison_window_s = float(poison_window_s)
+        self._poison_lock = threading.Lock()
+        self._poison_events = deque()   # (monotonic_t, source)
         # read by the watchdog thread (a single attribute load under the
         # GIL): monotonic start of the step in flight, or None
         self.step_started_at = None
@@ -211,6 +223,7 @@ class EngineSupervisor:
             victim = eng._requests.get(culprit)
             eng.abort(culprit, reason=f"error:{type(exc).__name__}")
             eng.metrics.inc("poison_requests_isolated")
+            self._note_poison(victim)
             if eng.recorder is not None:
                 # one bundle per isolation, carrying the culprit's final
                 # ledger decomposition (record never raises)
@@ -326,6 +339,55 @@ class EngineSupervisor:
     def _finished(self, rid):
         req = self.engine._requests.get(rid)
         return req is None or req.finished
+
+    # -- poison-isolation window --------------------------------------------
+
+    def _prune_poison(self, now):
+        # caller holds _poison_lock
+        horizon = now - self.poison_window_s
+        while self._poison_events and self._poison_events[0][0] < horizon:
+            self._poison_events.popleft()
+
+    def _note_poison(self, victim):
+        """Record one bisection attribution in the sliding window, keyed
+        by the victim's SOURCE: its tenant label, or "-" when untenanted.
+        Distinct request ids are deliberately NOT the key — an adversarial
+        client can mint unlimited request ids but only speaks for one
+        tenant, so serial poison from one source can never read as a
+        sick chip."""
+        src = "-" if victim is None or victim.tenant is None \
+            else victim.tenant
+        now = time.monotonic()
+        with self._poison_lock:
+            self._poison_events.append((now, src))
+            self._prune_poison(now)
+            n = len(self._poison_events)
+            k = len({s for _, s in self._poison_events})
+        self.engine.metrics.set_gauge("poison_isolated_in_window", n)
+        self.engine.metrics.set_gauge("poison_distinct_sources", k)
+
+    def poison_stats(self):
+        """Sliding-window poison-isolation view for ``/healthz`` and the
+        fleet router's ejection policy: isolations in the last
+        ``poison_window_s`` seconds and how many DISTINCT sources
+        (tenants) they came from. Attributions spread across several
+        unrelated sources are evidence the replica itself (a sick chip)
+        is poisoning requests — the PR 9 per-replica supervisor cannot
+        tell that apart from serial poison requests, but the fleet can:
+        the router ejects on ``distinct_sources``, which one adversarial
+        client cannot inflate. Refreshes the two gauges so a scrape
+        decays with the window."""
+        now = time.monotonic()
+        with self._poison_lock:
+            self._prune_poison(now)
+            events = list(self._poison_events)
+        n = len(events)
+        k = len({s for _, s in events})
+        self.engine.metrics.set_gauge("poison_isolated_in_window", n)
+        self.engine.metrics.set_gauge("poison_distinct_sources", k)
+        return {"window_s": self.poison_window_s,
+                "isolated_in_window": n,
+                "distinct_sources": k}
 
     # -- watchdog ------------------------------------------------------------
 

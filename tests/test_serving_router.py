@@ -1,0 +1,472 @@
+"""Replica-fleet router (serving/router.py): routing policy + HTTP surface.
+
+Routing correctness on healthy fleets: prefix-affinity (shared prefixes
+co-locate and the aggregate cache hit rate matches a single-replica warm
+serve, strictly above the no-affinity router), retry-elsewhere on
+draining/overloaded replicas, deadline-aware early rejection, rolling
+drain without a factory, the fleet-merged SLO rollup, and the
+RouterServer endpoints — including a full Prometheus exposition
+conformance parse of the router's /metrics (the PR 12 lock applied to
+the new series). Fault-driven chaos is tests/test_serving_router_chaos.py.
+"""
+import asyncio
+import json
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.serving import (
+    AsyncLLMEngine,
+    EngineOverloadedError,
+    LLMEngine,
+    ReplicaRouter,
+    RouterServer,
+    SLOLedger,
+)
+from paddle_tpu.serving.scheduler import Request
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, attn_impl="xla", dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def ref_engine(model):
+    """One shared no-fault engine for reference outputs (the
+    test_serving_chaos.py discipline: compiling fresh step programs per
+    reference run would dominate this file's wall time)."""
+    return LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64)
+
+
+def _prompts(lengths, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, (n,)).tolist() for n in lengths]
+
+
+def _engine(model, **kw):
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq_len", 64)
+    return LLMEngine(model, **kw)
+
+
+def _fleet_idle(router):
+    for r in router.replicas:
+        eng = r.engine.engine
+        assert eng.pool._refcount == {}
+        assert eng.pool.num_free == eng.pool.num_blocks - 1
+
+
+def _homed_prompt(router, home, length=12, seed0=1000):
+    """A fresh random prompt whose affinity key rendezvous-routes to
+    `home` (distinct every call — seeds advance globally)."""
+    seed = seed0
+    while True:
+        seed += 1
+        p = np.random.RandomState(seed).randint(0, 128, (length,)).tolist()
+        if router.home_replica(p) == home:
+            return p
+
+
+# -- routing policy -----------------------------------------------------------
+
+
+def test_affinity_routes_shared_prefixes_to_one_home(model, ref_engine):
+    """Requests sharing a full-block prefix share an affinity key and all
+    land on ONE replica; every output is token-identical to an unrouted
+    serve; home_replica is deterministic and matches where requests go."""
+    shared = _prompts((16,), seed=1)[0]      # two full blocks of 8
+    suffixes = _prompts((3, 5, 7, 4), seed=2)
+    prompts = [shared + s for s in suffixes]
+    refs = ref_engine.generate(prompts, max_new_tokens=6, temperature=0.0)
+
+    async def main():
+        router = ReplicaRouter(
+            [AsyncLLMEngine(_engine(model)) for _ in range(2)],
+            sweep_interval_s=0.02)
+        await router.start()
+        home = router.home_replica(prompts[0])
+        streams = [await router.submit(p, max_new_tokens=6, temperature=0.0)
+                   for p in prompts]
+        outs = [await s.collect() for s in streams]
+        # distinct-prefix traffic is NOT all pinned to one replica: some
+        # fresh key must rendezvous to the other replica
+        other = [r.name for r in router.replicas if r.name != home][0]
+        spread = _homed_prompt(router, other)
+        assert router.home_replica(spread) == other
+        snap = router.snapshot()
+        await router.shutdown()
+        return home, streams, outs, snap
+
+    home, streams, outs, snap = asyncio.run(main())
+    assert all(s.replica == home for s in streams)          # co-located
+    assert all(s.terminal_events == 1 for s in streams)
+    for (toks, reason), ref in zip(outs, refs):
+        assert reason == "length" and toks == ref
+    assert {r["state"] for r in snap["replicas"]} == {"active"}
+
+
+def test_affinity_hit_rate_matches_single_replica_warm_serve(model):
+    """THE affinity acceptance criterion: a shared-prefix wave through 2
+    affinity-routed replicas reaches the same aggregate prefix-cache hit
+    rate as a single-replica warm serve, and strictly beats the
+    no-affinity (least-loaded) router on the same wave."""
+    shared = _prompts((24,), seed=3)[0]      # three full blocks
+    suffixes = _prompts((3, 4, 5, 6, 3, 4, 5, 6), seed=4)
+    prompts = [shared + s for s in suffixes]
+
+    def hit_rate(engines):
+        hit = lookup = 0.0
+        for e in engines:
+            c = e.engine.metrics.counters
+            hit += c.get("prefix_cache_hit_tokens", 0)
+            lookup += c.get("prefix_cache_lookup_tokens", 0)
+        return hit / lookup if lookup else 0.0
+
+    async def wave(n_replicas, affinity):
+        engines = [AsyncLLMEngine(_engine(model)) for _ in range(n_replicas)]
+        router = ReplicaRouter(engines, affinity=affinity,
+                               sweep_interval_s=0.05)
+        await router.start()
+        streams = [await router.submit(p, max_new_tokens=4, temperature=0.0)
+                   for p in prompts]
+        outs = [await s.collect() for s in streams]
+        assert all(r == "length" for _, r in outs)
+        rate = hit_rate(engines)
+        _fleet_idle(router)
+        await router.shutdown()
+        return rate
+
+    async def main():
+        single = await wave(1, True)
+        affin = await wave(2, True)
+        spread = await wave(2, False)
+        return single, affin, spread
+
+    single, affin, spread = asyncio.run(main())
+    assert single > 0.3                       # the wave is genuinely warm
+    # affinity preserves the single-replica hit rate under fan-out...
+    assert affin == pytest.approx(single, abs=0.02)
+    # ...and strictly beats spreading the shared prefix over both caches
+    assert affin > spread
+
+
+def test_retry_elsewhere_on_draining_replica(model, ref_engine):
+    """A request homed to a draining replica is admitted on the other
+    replica in the same submit call (no backoff round needed), token
+    identical; the router observes the replica-side drain state."""
+    async def main():
+        router = ReplicaRouter(
+            [AsyncLLMEngine(_engine(model)) for _ in range(2)],
+            sweep_interval_s=0.02)
+        await router.start()
+        victim = router.replicas[0]
+        p = _homed_prompt(router, victim.name)
+        victim.engine.stop_admitting()       # replica-side drain
+        st = await router.submit(p, max_new_tokens=5, temperature=0.0)
+        toks, reason = await st.collect()
+        c = dict(router.metrics.counters)
+        state = victim.state
+        await router.shutdown()
+        return st, toks, reason, c, state, p
+
+    st, toks, reason, c, state, p = asyncio.run(main())
+    assert reason == "length"
+    assert st.replica == "r1"                # rerouted off the drain
+    assert toks == ref_engine.generate([p], max_new_tokens=5,
+                                       temperature=0.0)[0]
+    assert c.get("router_retries", 0) == 0   # same-round failover, no sleep
+    assert state == "draining"               # observed, not ejected
+
+
+def test_overload_retry_budget_exhausts_to_429(model):
+    """With every replica's wait queue full, the router burns its backoff
+    budget honoring Retry-After and surfaces the replica's 429."""
+    async def main():
+        # 1 lane, no wait queue: the second submit to a replica is a 429
+        engines = [AsyncLLMEngine(_engine(model, max_batch=1), max_waiting=0)
+                   for _ in range(2)]
+        router = ReplicaRouter(engines, retry_budget=1,
+                               backoff_base_s=0.01, sweep_interval_s=0.05)
+        await router.start()
+        occupy = [await router.submit(p, max_new_tokens=40, temperature=0.0)
+                  for p in _prompts((4, 5), seed=5)]
+        assert {s.replica for s in occupy} == {"r0", "r1"}  # both lanes busy
+        with pytest.raises(EngineOverloadedError) as ei:
+            await router.submit(_prompts((6,), seed=6)[0], max_new_tokens=2)
+        err = ei.value
+        for s in occupy:
+            await s.collect()
+        c = dict(router.metrics.counters)
+        await router.shutdown()
+        return err, c
+
+    err, c = asyncio.run(main())
+    assert err.reason == "queue_full"
+    assert c["router_admission_rejects"] >= 2     # tried both replicas
+    assert c["router_retries"] >= 1               # then backed off
+
+
+def test_deadline_aware_early_rejection(model):
+    """Reject-early beats miss-SLO: when the predicted queue wait on the
+    best replica already blows the remaining deadline, submission fails
+     429 deadline_unattainable instead of queueing doomed work — and a
+    deadline-less request is never early-rejected."""
+    async def main():
+        router = ReplicaRouter(
+            [AsyncLLMEngine(_engine(model, max_batch=1), max_waiting=8)],
+            service_time_init_s=10.0, sweep_interval_s=0.05)
+        await router.start()
+        long = await router.submit(_prompts((4,), seed=7)[0],
+                                   max_new_tokens=40, temperature=0.0)
+        # inflight 1 == max_batch -> predicted wait 10s >> 0.2s deadline
+        with pytest.raises(EngineOverloadedError) as ei:
+            await router.submit(_prompts((5,), seed=8)[0],
+                                max_new_tokens=2, deadline_s=0.2)
+        # no deadline -> no prediction gate; it queues and completes
+        ok = await router.submit(_prompts((5,), seed=8)[0],
+                                 max_new_tokens=2, temperature=0.0)
+        await long.collect()
+        toks, reason = await ok.collect()
+        c = dict(router.metrics.counters)
+        await router.shutdown()
+        return ei.value, reason, c
+
+    err, reason, c = asyncio.run(main())
+    assert err.reason == "deadline_unattainable"
+    assert err.retry_after_s is not None and err.retry_after_s > 0.2
+    assert reason == "length"
+    assert c["router_early_rejections"] == 1
+
+
+def test_rolling_drain_without_factory_reopens_admission(model, ref_engine):
+    """Restartless rolling drain: one replica at a time closes admission,
+    drains to zero in-flight, reopens (`resume_admitting`), re-enters
+    rotation — zero failed requests while a wave is live."""
+    prompts = _prompts((6, 9, 12, 7, 10, 8), seed=9)
+    refs = ref_engine.generate(prompts, max_new_tokens=8, temperature=0.0)
+
+    async def main():
+        router = ReplicaRouter(
+            [AsyncLLMEngine(_engine(model)) for _ in range(2)],
+            sweep_interval_s=0.02)
+        await router.start()
+        streams = [await router.submit(p, max_new_tokens=8, temperature=0.0)
+                   for p in prompts]
+        drained = await router.rolling_drain()
+        outs = [await s.collect() for s in streams]
+        # both replicas admit again after the drain
+        post = [await router.generate(p, max_new_tokens=3, temperature=0.0)
+                for p in prompts[:2]]
+        c = dict(router.metrics.counters)
+        states = [r.state for r in router.replicas]
+        _fleet_idle(router)
+        await router.shutdown()
+        return drained, outs, post, c, states
+
+    drained, outs, post, c, states = asyncio.run(main())
+    assert drained == ["r0", "r1"]
+    assert c["router_drains"] == 2
+    assert states == ["active", "active"]
+    for (toks, reason), ref in zip(outs, refs):
+        assert reason == "length" and toks == ref    # zero failures
+    assert all(r == "length" for _, r in post)
+    assert c.get("router_requests_failed", 0) == 0
+
+
+# -- fleet SLO rollup ---------------------------------------------------------
+
+
+def test_merged_rollup_sums_replica_ledgers():
+    """SLOLedger.merged_rollup: per-class counters sum, percentile
+    windows pool, and the shape matches a single ledger's rollup."""
+    import time as _time
+
+    ledgers = [SLOLedger(), SLOLedger()]
+    for i, led in enumerate(ledgers):
+        for j in range(3):
+            req = Request([1, 2, 3], tenant="acme", priority="hi",
+                          deadline_s=30.0)
+            led.begin(req)
+            req.output_ids = [1, 2]
+            req.first_token_time = _time.monotonic()
+            led.finalize(req, "finished")
+        req = Request([1, 2, 3], tenant=f"solo{i}")
+        led.begin(req)
+        led.finalize(req, "aborted")
+    merged = SLOLedger.merged_rollup(ledgers)
+    assert merged["total"]["requests"] == 8
+    by_class = {(c["tenant"], c["priority"]): c for c in merged["classes"]}
+    acme = by_class[("acme", "hi")]
+    assert acme["requests"] == 6 and acme["finished"] == 6
+    assert acme["e2e_ms"]["count"] == 6          # pooled windows
+    assert acme["deadline"]["met"] == 6
+    assert by_class[("solo0", "-")]["aborted"] == 1
+    assert by_class[("solo1", "-")]["aborted"] == 1
+    assert merged.keys() == ledgers[0].rollup().keys()
+
+
+# -- RouterServer HTTP surface + exposition conformance -----------------------
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_prom(text):
+    """Exposition conformance (the PR 12 lock, applied to the router's
+    scrape): every non-comment line must parse and every label body must
+    be fully consumed by valid pairs."""
+    types, samples = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m is not None, f"unparseable sample line: {line!r}"
+        labels = {}
+        if m.group(2):
+            body = m.group(2)[1:-1]
+            rebuilt = ",".join(f'{k}="{v}"'
+                               for k, v in _LABEL_RE.findall(body))
+            assert rebuilt == body, f"bad label body: {body!r}"
+            labels = dict(_LABEL_RE.findall(body))
+        samples.append((m.group(1), labels, float(m.group(3))))
+    return types, samples
+
+
+async def _http(port, method, path, obj=None):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    data = json.dumps(obj).encode() if obj is not None else b""
+    writer.write(
+        (f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+         f"Content-Type: application/json\r\n"
+         f"Content-Length: {len(data)}\r\n\r\n").encode() + data
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except (ConnectionError, OSError):
+        pass
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ")[1]), body
+
+
+def test_router_server_endpoints_and_metrics_conformance(model, ref_engine):
+    """The fleet HTTP surface: /v1/completions (SSE + full) routes and
+    serves token-identical output, /healthz reports every replica's
+    state machine, /debug/router dumps the table, /debug/slo merges the
+    replica ledgers, and the router /metrics scrape passes the
+    exposition-conformance parse with the new router families present
+    and HELP'd."""
+    prompts = _prompts((9, 13, 11), seed=10)
+    refs = ref_engine.generate(prompts, max_new_tokens=5, temperature=0.0)
+
+    async def main():
+        router = ReplicaRouter(
+            [AsyncLLMEngine(_engine(model, slo=True)) for _ in range(2)],
+            sweep_interval_s=0.02)
+        server = RouterServer(router, port=0)
+        await server.start()
+        full = await _http(server.port, "POST", "/v1/completions",
+                           {"prompt": prompts[0], "max_tokens": 5,
+                            "tenant": "acme", "timeout_s": 30.0})
+        sse = await _http(server.port, "POST", "/v1/completions",
+                          {"prompt": prompts[1], "max_tokens": 5,
+                           "stream": True, "tenant": "free"})
+        bad = await _http(server.port, "POST", "/v1/completions",
+                          {"prompt": "nope"})
+        await _http(server.port, "POST", "/v1/completions",
+                    {"prompt": prompts[2], "max_tokens": 5})
+        health = await _http(server.port, "GET", "/healthz")
+        table = await _http(server.port, "GET", "/debug/router")
+        slo = await _http(server.port, "GET", "/debug/slo")
+        metrics = await _http(server.port, "GET", "/metrics")
+        await server.shutdown()
+        return full, sse, bad, health, table, slo, metrics
+
+    full, sse, bad, health, table, slo, metrics = asyncio.run(main())
+    assert full[0] == 200
+    assert json.loads(full[1])["choices"][0]["token_ids"] == refs[0]
+    assert sse[0] == 200 and b"[DONE]" in sse[1]
+    sse_toks = []
+    for line in sse[1].decode().splitlines():
+        if line.startswith("data: ") and line != "data: [DONE]":
+            sse_toks.extend(json.loads(line[6:])["choices"][0]["token_ids"])
+    assert sse_toks == refs[1]
+    assert bad[0] == 400
+
+    assert health[0] == 200
+    h = json.loads(health[1])
+    assert h["status"] == "ok" and h["replicas_active"] == 2
+    assert {r["name"] for r in h["replicas"]} == {"r0", "r1"}
+    assert all(r["state"] == "active" and r["healthz"] == "ok"
+               for r in h["replicas"])
+
+    assert table[0] == 200
+    snap = json.loads(table[1])
+    assert snap["affinity"] is True and len(snap["replicas"]) == 2
+
+    assert slo[0] == 200
+    roll = json.loads(slo[1])
+    assert roll["total"]["requests"] == 3      # fleet-merged, all 3 classes
+    tenants = {c["tenant"] for c in roll["classes"]}
+    assert {"acme", "free", "-"} <= tenants
+
+    assert metrics[0] == 200
+    text = metrics[1].decode()
+    types, samples = _parse_prom(text)         # every line parses
+    pre = "paddle_tpu_serving_"
+    names = {n for n, _, _ in samples}
+    for fam, kind in (("router_requests_total", "counter"),
+                      ("router_replica_requests_total", "counter"),
+                      ("router_replicas_active", "gauge"),
+                      ("router_inflight", "gauge"),
+                      ("router_prefix_cache_hit_rate", "gauge")):
+        assert pre + fam in names, fam
+        base = pre + fam
+        assert types[base] == kind
+        assert f"# HELP {base} " in text
+    # per-replica labeled family carries both routing decisions' labels
+    replica_labels = {tuple(sorted(lab.items()))
+                      for n, lab, _ in samples
+                      if n == pre + "router_replica_requests_total"}
+    assert all(dict(lt).get("replica") in ("r0", "r1")
+               for lt in replica_labels)
+
+
+def test_router_healthz_poison_field_on_single_server(model):
+    """Satellite lock: the single-replica /healthz now carries the
+    supervisor's sliding-window poison stats (the router's sick-chip
+    signal), zeroed on a healthy replica."""
+    from paddle_tpu.serving import ServingServer
+
+    async def main():
+        server = ServingServer(_engine(model), port=0)
+        await server.start()
+        status, body = await _http(server.port, "GET", "/healthz")
+        mstatus, mbody = await _http(server.port, "GET", "/metrics")
+        await server.shutdown()
+        return status, json.loads(body), mstatus, mbody.decode()
+
+    status, health, mstatus, metrics = asyncio.run(main())
+    assert status == 200
+    assert health["poison"] == {"window_s": 60.0, "isolated_in_window": 0,
+                                "distinct_sources": 0}
+    assert mstatus == 200
+    assert "paddle_tpu_serving_poison_distinct_sources 0" in metrics

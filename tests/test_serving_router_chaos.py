@@ -1,0 +1,428 @@
+"""Router chaos: injected replica faults driven through the fleet.
+
+THE acceptance criterion lives here: with one of 3 replicas killed
+mid-wave, every request not in flight on the dead replica completes
+token-identical to an unrouted reference serve, zero-token in-flight
+requests retry elsewhere successfully, and mid-stream victims get exactly
+ONE structured terminal error (`RoutedStream.terminal_events == 1`).
+Plus: a watchdog-stuck replica is ejected while hung and re-admitted
+after a half-open probe passes (factory restart — PR 9 unhealthy is
+sticky), and the poison-rate satellite — a replica whose isolations span
+distinct tenants is ejected as a sick chip while one adversarial tenant
+can never trip it. The randomized drain-under-load soak is ``slow``.
+"""
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.serving import (
+    AsyncLLMEngine,
+    LLMEngine,
+    ReplicaRouter,
+    faults,
+)
+from paddle_tpu.serving.faults import FaultPlan
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, attn_impl="xla", dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    plan = faults.active()
+    if plan is not None:
+        plan.release_hangs()
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def ref_engine(model):
+    return LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64)
+
+
+def _prompt(seed, n=10):
+    return np.random.RandomState(seed).randint(0, 128, (n,)).tolist()
+
+
+def _replica(model, **kw):
+    return AsyncLLMEngine(
+        LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64),
+        max_waiting=8, **kw)
+
+
+def _homed_prompt(router, home, seed0, n=12):
+    seed = seed0
+    while True:
+        seed += 1
+        p = _prompt(seed, n)
+        if router.home_replica(p) == home:
+            return p
+
+
+def test_replica_thread_die_mid_wave(model, ref_engine):
+    """Kill one of 3 replicas mid-wave (thread_die, times=1): the dead
+    replica's running requests fail with exactly one structured error
+    each, its queued zero-token requests replay elsewhere and complete
+    token-identical, everyone else is untouched, and the replica is
+    ejected."""
+    async def main():
+        router = ReplicaRouter([_replica(model) for _ in range(3)],
+                               sweep_interval_s=0.02,
+                               probe_interval_s=60.0)
+        await router.start()
+        # 4 prompts homed to each replica: with max_batch=2, two run
+        # mid-stream and two wait queued (zero tokens) at kill time
+        buckets = {r.name: [] for r in router.replicas}
+        seed = 0
+        while any(len(v) < 4 for v in buckets.values()):
+            seed += 1
+            p = _prompt(seed)
+            h = router.home_replica(p)
+            if len(buckets[h]) < 4:
+                buckets[h].append(p)
+        prompts = [p for i in range(4)
+                   for p in (buckets["r0"][i], buckets["r1"][i],
+                             buckets["r2"][i])]
+        refs = ref_engine.generate(prompts, max_new_tokens=24,
+                                   temperature=0.0)
+        streams = [await router.submit(p, max_new_tokens=24,
+                                       temperature=0.0) for p in prompts]
+
+        def per_replica_started():
+            counts = {}
+            for s in streams:
+                if s.n_tokens >= 1:
+                    counts[s.replica] = counts.get(s.replica, 0) + 1
+            return all(counts.get(f"r{i}", 0) >= 2 for i in range(3))
+
+        t0 = time.monotonic()
+        while not per_replica_started():
+            assert time.monotonic() - t0 < 30, "wave never started"
+            await asyncio.sleep(0.005)
+        faults.install(FaultPlan([{"point": "thread_die", "times": 1}]))
+        results = await asyncio.wait_for(
+            asyncio.gather(*[s.collect() for s in streams]), 60.0)
+        dead = [r for r in router.replicas
+                if r.engine.healthz_state()[0] == "engine_dead"]
+        # let the sweep observe the death too (the forwarding error path
+        # usually ejects first; either path must leave it ejected)
+        t0 = time.monotonic()
+        while dead and dead[0].state != "ejected":
+            assert time.monotonic() - t0 < 10
+            await asyncio.sleep(0.02)
+        c = dict(router.metrics.counters)
+        states = {r.name: r.state for r in router.replicas}
+        await router.shutdown()
+        return streams, results, refs, dead, c, states
+
+    streams, results, refs, dead, c, states = asyncio.run(main())
+    assert len(dead) == 1                       # exactly one replica died
+    dead_name = dead[0].name
+    assert states[dead_name] == "ejected"
+    assert sum(1 for s in states.values() if s == "active") == 2
+    n_ok = n_err = 0
+    for s, (toks, reason), ref in zip(streams, results, refs):
+        assert s.terminal_events == 1, (s.request_id, s.terminal_events)
+        if reason == "length":
+            assert toks == ref                  # token-identical survivor
+            n_ok += 1
+        else:
+            # mid-stream victim: structured terminal error, tokens were
+            # already delivered, never replayed
+            assert reason == "error" and s.error and s.n_tokens > 0
+            assert s.replays == 0
+            n_err += 1
+    # 8 untouched + 2 zero-token replays completed; 2 mid-stream victims
+    assert n_ok == 10 and n_err == 2
+    assert c["router_replays"] == 2
+    assert c["router_midstream_errors"] == 2
+    assert c["router_ejections"] == 1
+    # the replayed pair must be the dead replica's queued requests, now
+    # finished on a DIFFERENT replica
+    replayed = [s for s in streams if s.replays]
+    assert len(replayed) == 2
+    assert all(s.replica != dead_name and s.finish_reason == "length"
+               for s in replayed)
+
+
+def test_watchdog_stuck_replica_ejected_then_readmitted(model, ref_engine):
+    """A hung step trips the replica's watchdog: the router ejects it
+    while it is STILL hung (innocents on the healthy replica keep
+    serving, the hung replica's zero-token victim replays), then the
+    half-open probe restarts it through the factory and re-admits it."""
+    def mk():
+        eng = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64)
+        # warm: compile mixed+decode BEFORE arming a 0.3s watchdog — the
+        # first-step XLA compile is a legitimately slow step, not a hang
+        eng.generate([list(range(1, 10))], max_new_tokens=2,
+                     temperature=0.0)
+        return AsyncLLMEngine(eng, max_waiting=8,
+                              watchdog_step_timeout_s=0.3,
+                              hard_stop_timeout_s=2.0)
+
+    async def main():
+        router = ReplicaRouter([mk(), mk()], factory=lambda i: mk(),
+                               sweep_interval_s=0.02, probe_interval_s=0.2,
+                               probe_timeout_s=15.0)
+        await router.start()
+        plan = faults.install(FaultPlan([
+            {"point": "step_hang", "request_id": "hangme", "times": 1}]))
+        p = _prompt(50)
+        hang_st = await router.submit(p, max_new_tokens=8, temperature=0.0,
+                                      request_id="hangme")
+        victim_name = hang_st.replica
+        other = [r for r in router.replicas if r.name != victim_name][0]
+        p2 = _homed_prompt(router, other.name, seed0=100)
+        inno = await router.submit(p2, max_new_tokens=6, temperature=0.0)
+        toks_h, reason_h = await asyncio.wait_for(hang_st.collect(), 30.0)
+        toks_i, reason_i = await asyncio.wait_for(inno.collect(), 30.0)
+        victim = [r for r in router.replicas if r.name == victim_name][0]
+        # ejected while the step is STILL hung (hang released only below)
+        t0 = time.monotonic()
+        while victim.state not in ("ejected", "probing"):
+            assert time.monotonic() - t0 < 10, victim.state
+            await asyncio.sleep(0.02)
+        stuck_eject_state = victim.state
+        plan.release_hangs()
+        t0 = time.monotonic()
+        while victim.state != "active" or victim.restarts < 1:
+            assert time.monotonic() - t0 < 60, (victim.state,
+                                                victim.restarts)
+            await asyncio.sleep(0.05)
+        faults.clear()
+        # the re-admitted (restarted) replica serves again
+        post = await router.generate(
+            _homed_prompt(router, victim_name, seed0=200),
+            max_new_tokens=3, temperature=0.0)
+        c = dict(router.metrics.counters)
+        await router.shutdown()
+        return (hang_st, toks_h, reason_h, toks_i, reason_i, p, p2,
+                stuck_eject_state, victim, post, c, other.name)
+
+    (hang_st, toks_h, reason_h, toks_i, reason_i, p, p2,
+     stuck_eject_state, victim, post, c, other_name) = asyncio.run(main())
+    # the hung request had zero tokens -> replayed on the healthy
+    # replica, token-identical to an unrouted serve
+    assert reason_h == "length" and hang_st.replays == 1
+    assert hang_st.replica == other_name
+    assert toks_h == ref_engine.generate([p], max_new_tokens=8,
+                                         temperature=0.0)[0]
+    # the innocent on the healthy replica was untouched
+    assert reason_i == "length"
+    assert toks_i == ref_engine.generate([p2], max_new_tokens=6,
+                                         temperature=0.0)[0]
+    assert stuck_eject_state in ("ejected", "probing")
+    assert victim.restarts == 1
+    assert post[1] == "length"
+    assert c["router_ejections"] == 1
+    assert c["router_readmissions"] == 1
+    assert c["router_restarts"] == 1
+
+
+def test_poison_rate_ejects_sick_chip_not_adversarial_tenant(model):
+    """The PR 9 known limit closed at the fleet level: serial poison
+    isolations spanning DISTINCT tenants read as a sick chip and eject
+    the replica; the same isolations from one tenant (an adversarial
+    client) never do — each poison request is aborted alone, never
+    replayed onto a second replica."""
+    async def run(tenants):
+        router = ReplicaRouter([_replica(model) for _ in range(2)],
+                               sweep_interval_s=0.02, probe_interval_s=60.0,
+                               poison_source_threshold=3)
+        await router.start()
+        shared = _prompt(300, n=8)           # one full block: one home
+        home = router.home_replica(shared + [1])
+        faults.install(FaultPlan([
+            {"point": "step_raise", "request_id": f"poison{i}"}
+            for i in range(len(tenants))]))
+        for i, tenant in enumerate(tenants):
+            st = await router.submit(
+                shared + [i], max_new_tokens=4, temperature=0.0,
+                request_id=f"poison{i}", tenant=tenant)
+            assert st.replica == home
+            toks, reason = await asyncio.wait_for(st.collect(), 30.0)
+            # request-attributed failure: terminal error, no replay —
+            # a poison request must never get a shot at a second replica
+            assert reason == "error" and st.replays == 0
+            assert st.terminal_events == 1
+        await asyncio.sleep(0.3)             # several sweep passes
+        victim = [r for r in router.replicas if r.name == home][0]
+        state = victim.state
+        reason = victim.eject_reason
+        stats = victim.engine.supervisor.poison_stats()
+        faults.clear()
+        # the OTHER replica still serves either way
+        ok = await router.generate(_prompt(400), max_new_tokens=3,
+                                   temperature=0.0)
+        await router.shutdown()
+        return state, reason, stats, ok
+
+    state, reason, stats, ok = asyncio.run(
+        run(["tenant-a", "tenant-b", "tenant-c"]))
+    assert state == "ejected" and reason.startswith("poison_rate:")
+    assert stats["distinct_sources"] == 3
+    assert ok[1] == "length"
+
+    state, reason, stats, ok = asyncio.run(
+        run(["mallory", "mallory", "mallory"]))
+    assert state == "active" and reason is None     # one source: no eject
+    assert stats["isolated_in_window"] == 3
+    assert stats["distinct_sources"] == 1
+    assert ok[1] == "length"
+
+
+def test_poison_on_draining_replica_is_request_attributed(model):
+    """Attribution regression: a poison isolation on a replica whose
+    healthz reads "draining" is still the REQUEST's own failure — the
+    replica must not be ejected and the poison must not be replayed
+    onto a second replica."""
+    async def main():
+        router = ReplicaRouter([_replica(model) for _ in range(2)],
+                               sweep_interval_s=0.02, probe_interval_s=60.0)
+        await router.start()
+        home_name = router.home_replica(_prompt(600))
+        home = [r for r in router.replicas if r.name == home_name][0]
+        innocent = await router.submit(_prompt(600), max_new_tokens=20,
+                                       temperature=0.0)
+        assert innocent.replica == home_name
+        poison = await router.submit(
+            _homed_prompt(router, home_name, seed0=700),
+            max_new_tokens=20, temperature=0.0, request_id="latepoison")
+        assert poison.replica == home_name
+        # drain the replica replica-side, THEN arm the fault: the
+        # isolation happens while its healthz reads "draining"
+        home.engine.stop_admitting()
+        assert home.engine.healthz_state()[0] == "draining"
+        faults.install(FaultPlan([
+            {"point": "step_raise", "request_id": "latepoison"}]))
+        toks_p, reason_p = await asyncio.wait_for(poison.collect(), 30.0)
+        toks_i, reason_i = await asyncio.wait_for(innocent.collect(), 30.0)
+        await asyncio.sleep(0.2)               # several sweeps
+        state = home.state
+        c = dict(router.metrics.counters)
+        faults.clear()
+        home.engine.resume_admitting()
+        await router.shutdown()
+        return poison, reason_p, reason_i, state, c
+
+    poison, reason_p, reason_i, state, c = asyncio.run(main())
+    assert reason_p == "error" and poison.replays == 0
+    assert poison.terminal_events == 1
+    assert reason_i == "length"                # the innocent rode it out
+    assert state == "draining"                 # routed around, NOT ejected
+    assert c.get("router_ejections", 0) == 0
+    assert c.get("router_replays", 0) == 0
+
+
+def test_poison_ejected_replica_stays_out_until_window_clears(model):
+    """Flap regression: a poison-ejected replica still reports healthz
+    "ok", so the half-open probe must consult the SAME poison window —
+    no re-admission while the evidence is fresh, re-admission once the
+    sliding window drains."""
+    def mk():
+        return AsyncLLMEngine(
+            LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64),
+            max_waiting=8, poison_window_s=1.5)
+
+    async def main():
+        router = ReplicaRouter([mk(), mk()], sweep_interval_s=0.02,
+                               probe_interval_s=0.05,
+                               poison_source_threshold=2)
+        await router.start()
+        shared = _prompt(500, n=8)
+        home = router.home_replica(shared + [1])
+        faults.install(FaultPlan([
+            {"point": "step_raise", "request_id": f"poison{i}"}
+            for i in range(2)]))
+        for i, tenant in enumerate(["ta", "tb"]):
+            st = await router.submit(
+                shared + [i], max_new_tokens=4, temperature=0.0,
+                request_id=f"poison{i}", tenant=tenant)
+            await asyncio.wait_for(st.collect(), 30.0)
+        faults.clear()
+        victim = [r for r in router.replicas if r.name == home][0]
+        t0 = time.monotonic()
+        while victim.state not in ("ejected", "probing"):
+            assert time.monotonic() - t0 < 10
+            await asyncio.sleep(0.02)
+        # probes run every ~50ms but must NOT re-admit while the window
+        # still holds the 2-source evidence
+        await asyncio.sleep(0.5)
+        held_out = victim.state in ("ejected", "probing")
+        readmissions_during = router.metrics.counters.get(
+            "router_readmissions", 0)
+        # once the 1.5s window slides empty, a probe re-admits
+        t0 = time.monotonic()
+        while victim.state != "active":
+            assert time.monotonic() - t0 < 30, victim.state
+            await asyncio.sleep(0.05)
+        post = await router.generate(shared + [9], max_new_tokens=3,
+                                     temperature=0.0)
+        c = dict(router.metrics.counters)
+        await router.shutdown()
+        return held_out, readmissions_during, post, c
+
+    held_out, readmissions_during, post, c = asyncio.run(main())
+    assert held_out and readmissions_during == 0
+    assert post[1] == "length"
+    assert c["router_readmissions"] == 1
+    assert c["router_probes"] >= 2          # failed probes backed off first
+
+
+@pytest.mark.slow
+def test_soak_rolling_drain_with_restarts_under_load(model, ref_engine):
+    """Soak: three rolling-drain passes WITH factory restarts while a
+    continuous wave is in flight — zero failed requests, every survivor
+    token-identical, the fleet ends active and idle."""
+    def mk():
+        return AsyncLLMEngine(
+            LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64),
+            max_waiting=16)
+
+    async def main():
+        router = ReplicaRouter([mk(), mk()], factory=lambda i: mk(),
+                               sweep_interval_s=0.02)
+        await router.start()
+        failures = []
+        for round_i in range(3):
+            prompts = [_prompt(1000 + 10 * round_i + j, n=6 + j % 5)
+                       for j in range(8)]
+            refs = ref_engine.generate(prompts, max_new_tokens=8,
+                                       temperature=0.0)
+            streams = [await router.submit(p, max_new_tokens=8,
+                                           temperature=0.0)
+                       for p in prompts]
+            drained = await router.rolling_drain()
+            assert drained == ["r0", "r1"]
+            for s, ref in zip(streams, refs):
+                toks, reason = await asyncio.wait_for(s.collect(), 60.0)
+                if reason != "length" or toks != ref:
+                    failures.append((s.request_id, reason, toks, ref))
+        c = dict(router.metrics.counters)
+        states = [r.state for r in router.replicas]
+        restarts = [r.restarts for r in router.replicas]
+        for r in router.replicas:
+            eng = r.engine.engine
+            assert eng.pool._refcount == {}
+            assert eng.pool.num_free == eng.pool.num_blocks - 1
+        await router.shutdown()
+        return failures, c, states, restarts
+
+    failures, c, states, restarts = asyncio.run(main())
+    assert failures == []                        # zero failed requests
+    assert states == ["active", "active"]
+    assert all(n == 3 for n in restarts)
+    assert c["router_drains"] == 6
+    assert c.get("router_requests_failed", 0) == 0

@@ -483,3 +483,69 @@ def test_supervisor_events_reach_the_trace(model):
     assert {"fault[step_raise]", "step_failed", "bisect_probe",
             "poison_isolated"} <= names
     assert _idle(eng)
+
+
+def test_poison_window_counts_distinct_sources(model):
+    """The sliding poison-isolation window (the fleet router's sick-chip
+    signal): every bisection attribution records its request SOURCE —
+    the tenant, "-" when untenanted — and `poison_stats` reports both
+    the isolation count and the DISTINCT source count. Serial poison
+    from one tenant (or one untenanted client minting request ids) stays
+    ONE source; isolations across tenants accumulate sources."""
+    prompts = _prompts((5, 7, 9, 6), seed=20)
+    eng = _engine(model)
+    sup = EngineSupervisor(eng)
+    assert sup.poison_stats() == {"window_s": 60.0,
+                                  "isolated_in_window": 0,
+                                  "distinct_sources": 0}
+    faults.install(FaultPlan([
+        {"point": "step_raise", "request_id": f"p{i}"} for i in range(3)]))
+    # two isolations from tenant "mallory", one untenanted, one "acme":
+    # 3 distinct sources total (mallory, -, acme) over 4 isolations
+    plan = [("mallory", "p0"), ("mallory", "p1"), (None, "p2")]
+    for i, (tenant, rid) in enumerate(plan):
+        eng.add_request(prompts[i], max_new_tokens=4, temperature=0.0,
+                        request_id=rid, tenant=tenant)
+        _run(sup)
+        stats = sup.poison_stats()
+        assert stats["isolated_in_window"] == i + 1
+    assert sup.poison_stats()["distinct_sources"] == 2   # mallory + "-"
+    faults.clear()
+    faults.install(FaultPlan([
+        {"point": "step_raise", "request_id": "p3"}]))
+    eng.add_request(prompts[3], max_new_tokens=4, temperature=0.0,
+                    request_id="p3", tenant="acme")
+    _run(sup)
+    stats = sup.poison_stats()
+    assert stats == {"window_s": 60.0, "isolated_in_window": 4,
+                     "distinct_sources": 3}
+    # the gauges track the stats (refreshed by poison_stats itself)
+    assert eng.metrics.gauges["poison_isolated_in_window"] == 4
+    assert eng.metrics.gauges["poison_distinct_sources"] == 3
+    assert _idle(eng)
+
+
+def test_poison_window_slides(model):
+    """Events age out of the window: with a tiny window, earlier
+    isolations stop counting and the gauges decay on the next read."""
+    prompts = _prompts((5, 7), seed=21)
+    eng = _engine(model)
+    sup = EngineSupervisor(eng, poison_window_s=0.2)
+    faults.install(FaultPlan([
+        {"point": "step_raise", "request_id": f"p{i}"} for i in range(2)]))
+    eng.add_request(prompts[0], max_new_tokens=4, temperature=0.0,
+                    request_id="p0", tenant="a")
+    _run(sup)
+    assert sup.poison_stats()["isolated_in_window"] == 1
+    time.sleep(0.25)
+    stats = sup.poison_stats()
+    assert stats["isolated_in_window"] == 0
+    assert stats["distinct_sources"] == 0
+    assert eng.metrics.gauges["poison_distinct_sources"] == 0
+    eng.add_request(prompts[1], max_new_tokens=4, temperature=0.0,
+                    request_id="p1", tenant="b")
+    _run(sup)
+    stats = sup.poison_stats()
+    assert stats == {"window_s": 0.2, "isolated_in_window": 1,
+                     "distinct_sources": 1}
+    assert _idle(eng)
