@@ -265,9 +265,13 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     """Continuous-batching decode throughput: overlapping requests with
     mixed prompt lengths through LLMEngine's paged KV cache and chunked
     prefill. Reports generated tokens/sec across the whole serve, TTFT
-    percentiles, the mixed/decode step split, and the jit trace count —
-    the whole serve compiles exactly two programs (mixed + decode), which
-    `jit_traces_measured == 0` makes checkable from the BENCH json.
+    percentiles, the mixed/decode step split, decode-step p50/p95 and
+    `host_syncs_per_step` (the unified ragged program makes exactly ONE
+    device->host transfer per step — this line catches a reintroduced
+    sync, not just throughput drift), and the jit trace count — the
+    whole serve compiles one program per ragged width bucket (two on
+    this spec-off engine), which `jit_traces_measured == 0` makes
+    checkable from the BENCH json.
 
     A second, shared-system-prompt wave measures AUTOMATIC PREFIX CACHING
     (production traffic's dominant shape): identical workloads served with
@@ -313,6 +317,10 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     ))
     warm_tokens = engine.metrics.counters["generated_tokens"]
     warm_traces = engine.metrics.counters["jit_traces"]
+    warm_syncs = engine.metrics.counters.get("host_syncs", 0)
+    warm_steps = (engine.metrics.counters.get("mixed_steps", 0)
+                  + engine.metrics.counters.get("decode_steps", 0)
+                  + engine.metrics.counters.get("verify_steps", 0))
     # drop warmup step timings (they include the jit traces/compiles) so the
     # reported engine_utilization/TTFT/TPOT describe the measured wave only
     engine.metrics.reset_schedule()
@@ -367,6 +375,10 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     counters = engine.metrics.counters
     slo_total = engine.slo.rollup()["total"]
     tpot = slo_total["tpot_ms"]
+    steps = (counters.get("mixed_steps", 0) + counters.get("decode_steps", 0)
+             + counters.get("verify_steps", 0) - warm_steps)
+    syncs = counters.get("host_syncs", 0) - warm_syncs
+    dec = lat.get("decode_step", {})
     return {
         "value": round(generated / dt, 1),
         "requests": len(lens),
@@ -382,8 +394,12 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
         "decode_steps": int(counters["decode_steps"]),
         "mixed_step_mean_ms": round(
             lat.get("mixed_step", {}).get("mean_ms", 0.0), 3),
-        "decode_step_mean_ms": round(
-            lat.get("decode_step", {}).get("mean_ms", 0.0), 3),
+        "decode_step_mean_ms": round(dec.get("mean_ms", 0.0), 3),
+        "decode_step_p50_ms": round(dec.get("p50_ms", 0.0), 3),
+        "decode_step_p95_ms": round(dec.get("p95_ms", 0.0), 3),
+        # exactly ONE device->host transfer per step (trace sync phase);
+        # a regression here is a reintroduced per-step host round-trip
+        "host_syncs_per_step": round(syncs / steps, 3) if steps else None,
         "preemptions": int(counters["preemptions"]),
         "jit_traces": int(counters["jit_traces"]),
         "jit_traces_measured": int(counters["jit_traces"] - warm_traces),
@@ -425,15 +441,31 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
 
     def wave(mesh):
         eng = LLMEngine(model, block_size=16, max_batch=4, mesh=mesh)
-        # warm: compiles the mixed + decode programs outside the timing
+        # warm: compiles the touched width-bucket programs outside the
+        # timing, then reset step timings so decode p50/p95 describe the
+        # measured wave only
         eng.generate([prompts[0]], max_new_tokens=2, temperature=0.0)
+        eng.metrics.reset_schedule()
         t0_tok = eng.metrics.counters["generated_tokens"]
+        t0_syncs = eng.metrics.counters.get("host_syncs", 0)
+        t0_steps = sum(eng.metrics.counters.get(k, 0) for k in
+                       ("mixed_steps", "decode_steps", "verify_steps"))
         t0 = time.perf_counter()
         outs = eng.generate(prompts, max_new_tokens=max_new,
                             temperature=0.0)
         dt = time.perf_counter() - t0
         toks = eng.metrics.counters["generated_tokens"] - t0_tok
-        return outs, (toks / dt if dt > 0 else 0.0), eng
+        steps = sum(eng.metrics.counters.get(k, 0) for k in
+                    ("mixed_steps", "decode_steps", "verify_steps")) - t0_steps
+        syncs = eng.metrics.counters.get("host_syncs", 0) - t0_syncs
+        dec = eng.metrics.latency_summary().get("decode_step", {})
+        facts = {
+            "decode_step_p50_ms": round(dec.get("p50_ms", 0.0), 3),
+            "decode_step_p95_ms": round(dec.get("p95_ms", 0.0), 3),
+            "host_syncs_per_step": (round(syncs / steps, 3) if steps
+                                    else None),
+        }
+        return outs, (toks / dt if dt > 0 else 0.0), eng, facts
 
     def program_collectives(eng):
         """hlolint collective counts per program kind — the bench line
@@ -452,24 +484,26 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
 
     # mesh=1 is the EXPLICIT single-chip request: a PADDLE_TPU_TP env
     # left set must not shard the reference and make parity vacuous
-    ref_outs, ref_tok_s, ref_eng = wave(1)
+    ref_outs, ref_tok_s, ref_eng, ref_facts = wave(1)
     out = {"n_devices": len(jax.devices()),
            "max_new_tokens": max_new,
            "requests": len(lens),
            "tok_s_single": round(ref_tok_s, 1)}
+    out.update({f"tp1_{k}": v for k, v in ref_facts.items()})
     engines = {"tp1": ref_eng}
     parity_all = "ok"
     for tp in (2, 4):
         if time.monotonic() > deadline_s:
             errors.append(f"gpt_serve_multichip: deadline before tp={tp}")
             break
-        outs, tok_s, eng = wave(tp)
+        outs, tok_s, eng, facts = wave(tp)
         parity = "ok" if outs == ref_outs else "mismatch"
         if parity != "ok":
             parity_all = "mismatch"
             errors.append(f"gpt_serve_multichip: tp={tp} greedy output "
                           "diverged from single-chip")
         out[f"tp{tp}_tok_s"] = round(tok_s, 1)
+        out.update({f"tp{tp}_{k}": v for k, v in facts.items()})
         out[f"tp{tp}_sharded_parity"] = parity
         out[f"tp{tp}_mesh"] = eng.mesh_info()
         engines[f"tp{tp}"] = eng

@@ -29,6 +29,13 @@ The catalog:
                             in baseline (ir_baseline.json); update it
                             deliberately with ``--ir --update-baseline``
                             when a change legitimately moves a budget
+- IR005 sampler-fused       the serving step's tail — everything after
+                            the last matmul (attention + LM head) — is
+                            free of host boundaries: sampling, the
+                            speculative accept decision, and the packed
+                            token emission stay compiled inside the one
+                            ragged program (one device→host transfer
+                            per step)
 """
 from __future__ import annotations
 
@@ -273,6 +280,46 @@ class ProgramShapeBaseline(IRContract):
                     f" of baseline {want:.6g} — if intentional, refresh "
                     "with --ir --update-baseline",
                 )
+
+
+@register_contract
+class SamplerFused(IRContract):
+    """No host custom-call between attention and token emission: the
+    region after the serving step's last matmul (every attention and
+    projection matmul, the LM head included, precedes sampling) must
+    contain no host-boundary op — only GSPMD annotation calls are
+    tolerated. The unified ragged step program compiled sampling, the
+    speculative accept/rollback decision, and the packed token emission
+    into that tail precisely so a step makes ONE device→host transfer;
+    a callback-based sampler (or any host round-trip between the LM
+    head and the packed output) would silently reintroduce a per-step
+    host sync that IR003's whitelist could mask."""
+
+    id = "IR005"
+    name = "sampler-fused"
+    incident = ("this PR's tentpole: pre-unification the engine sampled "
+                "on host for the draft/verify/accept loop — a per-step "
+                "device→host→device round trip that multiplied across "
+                "tp shards, supervisor probes, and router replicas")
+
+    # GSPMD layout annotations are compile-time plumbing, not host syncs
+    TOLERATED = frozenset({"Sharding", "SPMDFullToShardShape",
+                           "SPMDShardToFullShape"})
+
+    def check(self, artifact, context):
+        if not artifact.expected.get("sampler_region"):
+            return            # train programs have no sampler tail
+        tail = _ir.sampler_region_ops(artifact.ops)
+        bad = [op for op in _ir.host_boundary_ops(tail)
+               if op.custom_call_target not in self.TOLERATED]
+        if bad:
+            yield self.violation(
+                artifact,
+                "host-boundary op(s) between attention and token "
+                f"emission: {_describe_ops(bad)} — sampling and the "
+                "speculative accept decision must stay compiled in the "
+                "step program (one device→host transfer per step)",
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -12,12 +12,13 @@ parses the post-SPMD HLO text plus ``compiled.cost_analysis()`` /
 ``memory_analysis()``, and hands the resulting `ProgramArtifact`s to the
 declarative contracts in `contracts.py`.
 
-The program set (`default_artifacts`): the serving engine's exactly-3
-compiled programs (mixed / decode / verify) at tp=1 and tp=2 on the
-8-fake-device host mesh, plus the spmd train step on a dp2 x mp2 mesh —
-all on the smallest GPT config that still exercises tp sharding, so the
-whole pass lowers + compiles in seconds and can gate tier-1
-(tests/test_ir_contracts.py).
+The program set (`default_artifacts`): the serving engine's unified
+ragged step program at every width bucket (``w1`` / ``w4`` / ``w8`` on
+the harness config — decode, spec, and chunk widths of ONE kind-free
+program) at tp=1 and tp=2 on the 8-fake-device host mesh, plus the spmd
+train step on a dp2 x mp2 mesh — all on the smallest GPT config that
+still exercises tp sharding, so the whole pass lowers + compiles in
+seconds and can gate tier-1 (tests/test_ir_contracts.py).
 
 Everything here imports jax lazily: ``paddle_tpu.analysis`` itself stays
 stdlib-pure (the AST layer must run before the heavyweight runtime even
@@ -185,6 +186,28 @@ def host_boundary_ops(ops):
     ]
 
 
+# matmul-class opcodes: the LAST one in a serving step is the LM head
+# projection — everything after it is the on-device sampler / spec-accept
+# / emission-packing tail (IR005's "between attention and token
+# emission" region)
+_MATMUL_OPS = ("dot", "dot-general", "convolution")
+
+
+def sampler_region_ops(ops):
+    """Ops after the program's LAST matmul-class op (text order). In a
+    serving step every attention and projection matmul — the LM head
+    included — precedes sampling, so this tail is exactly the compiled
+    sampler + speculative accept + packed-output assembly. The unified
+    ragged program moved that whole region on-device; a host callback
+    reintroduced there (e.g. ``jax.pure_callback`` sampling) lowers to a
+    custom-call at its use site, which IR005 flags."""
+    last = -1
+    for idx, op in enumerate(ops):
+        if _base_opcode(op.opcode) in _MATMUL_OPS:
+            last = idx
+    return ops[last + 1:]
+
+
 # ---------------------------------------------------------------------------
 # program artifacts
 
@@ -193,8 +216,8 @@ def host_boundary_ops(ops):
 class ProgramArtifact:
     """One lowered+compiled program plus every fact the contracts check."""
 
-    name: str                     # "serve/tp2/decode", "train/dp2_mp2"
-    kind: str                     # "mixed" | "decode" | "verify" | "train"
+    name: str                     # "serve/tp2/w1", "train/dp2_mp2"
+    kind: str                     # "w<width>" (serving) | "train"
     tp_degree: int
     backend: str
     hlo_text: str
@@ -326,9 +349,10 @@ def tiny_gpt_config():
 
 
 def build_serving_engine(model, tp_degree):
-    """The harness engine: spec decoding ON so all three programs exist;
-    mesh=1 is the explicit single-chip request (beats a stray
-    PADDLE_TPU_TP env, serving/sharded.py)."""
+    """The harness engine: spec decoding ON so every default width
+    bucket exists (w1 decode, w4 spec, w8 chunk); mesh=1 is the explicit
+    single-chip request (beats a stray PADDLE_TPU_TP env,
+    serving/sharded.py)."""
     from ..serving.engine import LLMEngine
 
     return LLMEngine(model, block_size=8, max_batch=2, prefill_chunk=8,
@@ -336,9 +360,9 @@ def build_serving_engine(model, tp_degree):
 
 
 def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
-    """Lower + compile the engine's programs at each tp degree; returns
-    [ProgramArtifact]. `kinds` restricts to a subset (the seeded-
-    regression tests lower just "decode")."""
+    """Lower + compile the engine's width-bucket programs at each tp
+    degree; returns [ProgramArtifact]. `kinds` restricts to a name
+    subset (the seeded-regression tests lower just "w1")."""
     import jax
 
     from ..models.gpt import GPT
@@ -351,19 +375,24 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
         eng = build_serving_engine(model, tp)
         spec = eng.step_program_spec()
         budget = serving_collective_budget(model.cfg, tp)
-        for kind, lowered in eng.lowered_step_programs(kinds=kinds).items():
+        for name, lowered in eng.lowered_step_programs(kinds=kinds).items():
             expected = {
                 "collective_budget": budget,
                 "donation": {
                     "expected": spec["donation_expected"],
                     "param_indices": spec["arena_param_indices"],
-                    "output_indices": spec["arena_output_indices"][kind],
+                    "output_indices": spec["arena_output_indices"][name],
                     "what": "KV arena (k, v)",
                 },
                 "custom_call_whitelist": DEFAULT_CUSTOM_CALL_WHITELIST,
+                # IR005: the program tail (post-attention sampling, spec
+                # accept, emission packing) must stay free of host
+                # boundaries — serving steps only; the train artifact
+                # has no sampler region
+                "sampler_region": True,
             }
             arts.append(artifact_from_compiled(
-                f"serve/tp{tp}/{kind}", kind, tp,
+                f"serve/tp{tp}/{name}", name, tp,
                 jax.default_backend(), lowered.compile(), expected))
     return arts
 
@@ -417,7 +446,8 @@ def train_artifact(mesh_degrees=None):
 
 def default_artifacts():
     """The registered program set the CLI and the tier-1 gate evaluate:
-    3 serving programs x {tp=1, tp=2} + the dp2 x mp2 train step."""
+    the unified step at every width bucket x {tp=1, tp=2} + the
+    dp2 x mp2 train step."""
     arts = serving_artifacts()
     arts.append(train_artifact())
     return arts

@@ -276,8 +276,10 @@ class GPT(nn.Layer):
         if paged and getattr(caches, "mesh", None) is not None:
             # tensor-parallel serving (serving/sharded.py): keep the LM
             # head column-parallel — logits stay vocab-sharded on tp out
-            # of the matmul; the sampler's argmax/top-k gather is the one
-            # place the full vocab row materializes
+            # of the matmul; the unified step program's boundary gather
+            # (engine.py pins the scored window replicated, the ONE
+            # sanctioned all-gather of IR001) is the only place full
+            # vocab rows materialize
             logits = Tensor._from_op(
                 caches.constrain(logits._array, None, None, "tp")
             )

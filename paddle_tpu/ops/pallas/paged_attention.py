@@ -8,14 +8,23 @@ prefill-chunk rows (up to `prefill_chunk` tokens) — which is what lets the
 engine run chunked prefill and decode in a single XLA program.
 
 Kernel design (TPU):
-- Grid ``(rows, heads, max_blocks)`` with the KV-block dimension innermost.
-  The block index map reads the row's block table through scalar prefetch
-  (SMEM), so each grid step DMAs exactly ONE live KV block ``[block_size,
-  head_dim]`` from the arena in HBM — the padded tail of the block table is
-  never fetched: dead iterations clamp the index map to the last live block
-  (Mosaic elides the re-fetch of an unchanged block) and `pl.when` skips
-  their compute. This is the whole point vs. the XLA fallback below, which
-  gathers the full padded ``[rows, max_blocks]`` table every layer.
+- Grid ``(rows, heads, q_blocks, kv_blocks)`` with the KV-block dimension
+  innermost. The block index map reads the row's block table through
+  scalar prefetch (SMEM), so each grid step DMAs exactly ONE live KV
+  block ``[block_size, head_dim]`` from the arena in HBM — the padded
+  tail of the block table is never fetched: dead iterations clamp the
+  index map to the last live block (Mosaic elides the re-fetch of an
+  unchanged block) and `pl.when` skips their compute. This is the whole
+  point vs. the XLA fallback below, which gathers the full padded
+  ``[rows, max_blocks]`` table every layer.
+- Query lengths are ragged PER ROW (``q_lens``): the query axis is tiled
+  and each row declares how many tiles are live, so a decode row (1 live
+  token) riding a wide mixed/verify-width program computes one query
+  tile while a full prefill chunk in the same launch walks them all —
+  dead q blocks clamp their index map (no DMA) and skip compute exactly
+  like dead KV iterations. This is what lets ONE program shape serve
+  decode, prefill-chunk, and speculative-verify rows (the unified
+  ragged step program in serving/engine.py).
 - Online-softmax state (m, l, acc) lives in VMEM scratch across the KV
   iterations, exactly like flash_attention.py; fp32 accumulation on the MXU.
 - Causal masking is positional: query positions are ``q_start[row] + iota``
@@ -88,26 +97,34 @@ def paged_attention_xla(q, k_arena, v_arena, layer, block_tables, qpos,
 # Pallas ragged kernel
 # ---------------------------------------------------------------------------
 
-def _ragged_kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, bs, sq, scale):
-    """One (row, head) pair's online-softmax walk over its live KV blocks.
+def _ragged_kernel(bt_ref, qs_ref, kl_ref, qb_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_ref, l_ref, acc_ref, *, bs, qt, scale):
+    """One (row, head, q-block) tile's online-softmax walk over its live
+    KV blocks.
 
-    bt_ref/qs_ref/kl_ref are the scalar-prefetched block tables, per-row
-    query start positions, and per-row live KV block counts (SMEM)."""
+    bt_ref/qs_ref/kl_ref/qb_ref are the scalar-prefetched block tables,
+    per-row query start positions, per-row live KV block counts, and
+    per-row live QUERY block counts (SMEM). The q-block grid dimension is
+    what makes query length ragged PER ROW: a decode row (1 live query
+    token) riding a wide mixed/verify program computes only its first
+    ``qt``-wide query tile — dead q blocks re-address the last live tile
+    (no DMA) and skip all compute, exactly like the dead KV iterations."""
     from jax.experimental import pallas as pl
 
     i = pl.program_id(0)   # batch row
-    j = pl.program_id(2)   # kv block step (innermost)
+    qb = pl.program_id(2)  # query block
+    j = pl.program_id(3)   # kv block step (innermost)
+    q_live = qb < qb_ref[i]
 
-    @pl.when(j == 0)
+    @pl.when(q_live & (j == 0))
     def _():
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j < kl_ref[i])
+    @pl.when(q_live & (j < kl_ref[i]))
     def _():
-        q = q_ref[0, 0]        # [sq, D]
+        q = q_ref[0, 0]        # [qt, D]
         kt = k_ref[0, 0, 0]    # [bs, D]
         s = jax.lax.dot_general(
             q, kt, (((1,), (1,)), ((), ())),
@@ -116,8 +133,9 @@ def _ragged_kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref,
         # chunk query positions are consecutive from q_start; key positions
         # follow from the block index. qpos >= kpos is both the causal mask
         # and the guard over a partially filled last block's stale tail.
-        qp = qs_ref[i] + jax.lax.broadcasted_iota(jnp.int32, (sq, bs), 0)
-        kp = j * bs + jax.lax.broadcasted_iota(jnp.int32, (sq, bs), 1)
+        qp = (qs_ref[i] + qb * qt
+              + jax.lax.broadcasted_iota(jnp.int32, (qt, bs), 0))
+        kp = j * bs + jax.lax.broadcasted_iota(jnp.int32, (qt, bs), 1)
         s = jnp.where(qp >= kp, s, _NEG_INF)
         m_prev = m_ref[:]
         l_prev = l_ref[:]
@@ -132,11 +150,21 @@ def _ragged_kernel(bt_ref, qs_ref, kl_ref, q_ref, k_ref, v_ref, o_ref,
         )
         m_ref[:] = m_new
 
-    @pl.when(j == kl_ref[i] - 1)
+    @pl.when(q_live & (j == kl_ref[i] - 1))
     def _():
         o_ref[0, 0] = (
             acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
         ).astype(o_ref.dtype)
+
+
+def _q_tile(S):
+    """Query-tile width: the whole width for narrow programs, 8-wide
+    sublane-aligned tiles when the width divides (fp32 Mosaic tiling —
+    minor-two dims of a block must be (8, 128)-divisible or equal to the
+    array dims). A width that is neither <= 8 nor 8-divisible keeps one
+    full-width tile (per-row raggedness then costs nothing extra: it
+    degrades to the pre-ragged single-tile layout)."""
+    return 8 if S > 8 and S % 8 == 0 else S
 
 
 @functools.lru_cache(maxsize=None)
@@ -145,34 +173,41 @@ def _build_ragged(B, H, sq, d, bs, nk, layer, dtype_name, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     scale = 1.0 / np.sqrt(d)
+    qt = _q_tile(sq)
+    nq = sq // qt
 
-    def q_index(i, h, j, bt, qs, kl):
-        return (i, h, 0, 0)
+    def q_index(i, h, qb, j, bt, qs, kl, qlb):
+        # dead q blocks re-address the row's last live tile: Mosaic
+        # elides the DMA for an unchanged index, pl.when skips compute
+        return (i, h, jnp.minimum(qb, qlb[i] - 1), 0)
 
-    def kv_index(i, h, j, bt, qs, kl):
-        # dead iterations (j >= live count) re-address the last live block:
-        # Mosaic skips the DMA for an unchanged index and pl.when skips the
-        # compute, so the padded tail of the table costs nothing
-        jc = jnp.minimum(j, kl[i] - 1)
+    def kv_index(i, h, qb, j, bt, qs, kl, qlb):
+        # dead iterations (j >= live count) re-address the last live
+        # block; dead q TILES freeze the whole KV walk there too — the
+        # index must stay UNCHANGED across their inner j steps or Mosaic
+        # re-fetches every live KV block once per dead tile (kl[i]-1 is
+        # also where the preceding live tile's walk ended, so the freeze
+        # elides the DMA across the tile boundary as well)
+        jc = jnp.where(qb < qlb[i], jnp.minimum(j, kl[i] - 1), kl[i] - 1)
         return (layer, h, bt[i, jc], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(B, H, nk),
+        num_scalar_prefetch=4,
+        grid=(B, H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, sq, d), q_index),
+            pl.BlockSpec((1, 1, qt, d), q_index),
             pl.BlockSpec((1, 1, 1, bs, d), kv_index),
             pl.BlockSpec((1, 1, 1, bs, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, sq, d), q_index),
+        out_specs=pl.BlockSpec((1, 1, qt, d), q_index),
         scratch_shapes=[
-            pltpu.VMEM((sq, 1), jnp.float32),   # running max m
-            pltpu.VMEM((sq, 1), jnp.float32),   # running normalizer l
-            pltpu.VMEM((sq, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((qt, 1), jnp.float32),   # running max m
+            pltpu.VMEM((qt, 1), jnp.float32),   # running normalizer l
+            pltpu.VMEM((qt, d), jnp.float32),   # output accumulator
         ],
     )
     return pl.pallas_call(
-        functools.partial(_ragged_kernel, bs=bs, sq=sq, scale=scale),
+        functools.partial(_ragged_kernel, bs=bs, qt=qt, scale=scale),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, sq, d), jnp.dtype(dtype_name)),
         interpret=interpret,
@@ -180,25 +215,37 @@ def _build_ragged(B, H, sq, d, bs, nk, layer, dtype_name, interpret):
 
 
 def ragged_paged_attention(q, k_arena, v_arena, layer, block_tables,
-                           q_start, kv_live, interpret=False):
-    """Pallas ragged paged attention over live KV blocks only.
+                           q_start, kv_live, q_lens=None, interpret=False):
+    """Pallas ragged paged attention over live KV blocks — and live
+    QUERY tiles — only.
 
     q: [B, S, H, D]; arenas: [layers, H, num_blocks, bs, D];
     block_tables: [B, max_blocks]; q_start: [B] first query position per
-    row; kv_live: [B] number of live KV blocks per row (>= 1).
-    Returns [B, S, H, D]. Rows/columns beyond each row's live tokens hold
-    garbage — the engine discards them.
+    row; kv_live: [B] number of live KV blocks per row (>= 1); q_lens:
+    [B] live query tokens per row (ragged widths — a decode row riding a
+    wide program declares 1 and pays one query tile; None means every
+    row is full-width). Returns [B, S, H, D]. Rows/columns beyond each
+    row's live tokens hold garbage — the engine discards them.
     """
     B, S, H, D = q.shape
     bs = k_arena.shape[3]
     nk = block_tables.shape[1]
     fn = _build_ragged(B, H, S, D, bs, nk, int(layer), str(q.dtype),
                        bool(interpret))
+    qt = _q_tile(S)
+    if q_lens is None:
+        qb_live = jnp.full((B,), S // qt, jnp.int32)
+    else:
+        # live query TILES per row (>= 1: padding lanes walk one tile of
+        # the null block, like kv_live's clamp)
+        ql = jnp.maximum(q_lens.astype(jnp.int32), 1)
+        qb_live = (ql + qt - 1) // qt
     qh = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, D]
     o = fn(
         block_tables.astype(jnp.int32),
         q_start.astype(jnp.int32),
         jnp.maximum(kv_live.astype(jnp.int32), 1),
+        qb_live,
         qh, k_arena, v_arena,
     )
     return jnp.transpose(o, (0, 2, 1, 3))
@@ -209,7 +256,8 @@ def ragged_paged_attention(q, k_arena, v_arena, layer, block_tables,
 # ---------------------------------------------------------------------------
 
 def ragged_paged_attention_sharded(q, k_arena, v_arena, layer, block_tables,
-                                   q_start, kv_live, mesh, tp_axis="tp",
+                                   q_start, kv_live, q_lens=None,
+                                   mesh=None, tp_axis="tp",
                                    interpret=False):
     """Per-shard dispatch of the single-device ragged kernel on a tp mesh.
 
@@ -227,30 +275,38 @@ def ragged_paged_attention_sharded(q, k_arena, v_arena, layer, block_tables,
 
     from ...parallel._compat import shard_map
 
-    def local(qh, ka, va, bt, qs, kl):
+    if q_lens is None:
+        q_lens = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
+
+    def local(qh, ka, va, bt, qs, kl, ql):
         return ragged_paged_attention(qh, ka, va, layer, bt, qs, kl,
-                                      interpret=interpret)
+                                      q_lens=ql, interpret=interpret)
 
     fn = shard_map(
         local, mesh=mesh,
         in_specs=(P(None, None, tp_axis, None), P(None, tp_axis),
-                  P(None, tp_axis), P(), P(), P()),
+                  P(None, tp_axis), P(), P(), P(), P()),
         out_specs=P(None, None, tp_axis, None),
     )
     # raw metadata passes through; ragged_paged_attention normalizes
-    # (int32 casts + the >=1 kv_live clamp) per shard — one canonical site
-    return fn(q, k_arena, v_arena, block_tables, q_start, kv_live)
+    # (int32 casts + the >=1 kv_live/q_lens clamps) per shard — one
+    # canonical site
+    return fn(q, k_arena, v_arena, block_tables, q_start, kv_live, q_lens)
 
 
 def paged_attention_arrays(q, k_arena, v_arena, layer, block_tables, qpos,
-                           q_start=None, kv_live=None, scale=None,
-                           mesh=None, tp_axis="tp"):
+                           q_start=None, kv_live=None, q_lens=None,
+                           scale=None, mesh=None, tp_axis="tp"):
     """Attend q through the block table: Pallas ragged kernel when the
     backend gate and the ragged metadata allow it, XLA gather otherwise.
-    With a `mesh` (tensor-parallel serving, serving/sharded.py) the Pallas
-    path runs per-shard over the head axis via `shard_map`; the XLA
-    fallback needs no wrapper — GSPMD partitions the padded gather over
-    the arena's head sharding on its own."""
+    `q_lens` (per-row live query counts) makes the kernel ragged in the
+    QUERY dimension too — the unified step program's decode rows pay one
+    query tile inside a wide mixed/verify-width launch. With a `mesh`
+    (tensor-parallel serving, serving/sharded.py) the Pallas path runs
+    per-shard over the head axis via `shard_map`; the XLA fallback needs
+    no wrapper — GSPMD partitions the padded gather over the arena's
+    head sharding on its own (and its causal qpos mask already discards
+    dead query rows, so it ignores q_lens)."""
     if (
         q_start is not None and kv_live is not None
         and scale is None  # kernel bakes 1/sqrt(D); custom scales fall back
@@ -259,11 +315,12 @@ def paged_attention_arrays(q, k_arena, v_arena, layer, block_tables, qpos,
         if mesh is not None and mesh.shape.get(tp_axis, 1) > 1:
             return ragged_paged_attention_sharded(
                 q, k_arena, v_arena, layer, block_tables, q_start, kv_live,
-                mesh, tp_axis=tp_axis, interpret=interpret_mode(),
+                q_lens=q_lens, mesh=mesh, tp_axis=tp_axis,
+                interpret=interpret_mode(),
             )
         return ragged_paged_attention(
             q, k_arena, v_arena, layer, block_tables, q_start, kv_live,
-            interpret=interpret_mode(),
+            q_lens=q_lens, interpret=interpret_mode(),
         )
     return paged_attention_xla(q, k_arena, v_arena, layer, block_tables,
                                qpos, scale)

@@ -22,7 +22,7 @@ outputs stay token-for-token identical to non-speculative decode.
 `build_serving_mesh` handle, or ``PADDLE_TPU_TP=N``) to shard weights and
 the head-major KV arena over a ``tp`` NamedSharding mesh — attention
 heads and FFN columns on ``tp``, block tables/scheduler/prefix-cache
-refcounts host-side and unchanged, still exactly three compiled
+refcounts host-side and unchanged, still one unified ragged program compiled per width bucket
 programs. Greedy sharded output is token-for-token identical to the
 single-chip engine. See README "Sharded serving".
 

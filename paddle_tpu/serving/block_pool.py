@@ -109,6 +109,10 @@ class PagedState:
                     kernel metadata; chunk tokens are consecutive)
       kv_live       [B] int32 — live KV blocks per row (>= 1); the ragged
                     kernel walks exactly this many blocks
+      q_lens        [B] int32 — live query tokens per row (ragged widths:
+                    a decode row riding a wide unified-step launch
+                    declares 1 and the kernel computes one query tile;
+                    None = every row full-width)
 
     `mesh` (static, not an array) is the tensor-parallel serving mesh
     (serving/sharded.py) or None: it selects the per-shard Pallas dispatch
@@ -118,7 +122,7 @@ class PagedState:
     is_paged = True
 
     def __init__(self, k, v, block_tables, slots, offs, qpos,
-                 q_start=None, kv_live=None, mesh=None):
+                 q_start=None, kv_live=None, q_lens=None, mesh=None):
         self.k = k
         self.v = v
         self.block_tables = block_tables
@@ -127,6 +131,7 @@ class PagedState:
         self.qpos = qpos
         self.q_start = q_start
         self.kv_live = kv_live
+        self.q_lens = q_lens
         self.mesh = mesh
 
     def layer(self, i):
@@ -173,7 +178,8 @@ def paged_attention(q, k_new, v_new, view, scale=None):
     st.v = st.v.at[layer, :, st.slots, st.offs].set(v_new.astype(st.v.dtype))
     return paged_attention_arrays(
         q, st.k, st.v, layer, st.block_tables, st.qpos,
-        q_start=st.q_start, kv_live=st.kv_live, scale=scale, mesh=st.mesh,
+        q_start=st.q_start, kv_live=st.kv_live, q_lens=st.q_lens,
+        scale=scale, mesh=st.mesh,
     )
 
 

@@ -2,32 +2,45 @@
 
 `add_request` enqueues, `step` runs ONE mixed device step (decode rows plus
 chunked-prefill rows, planned by the scheduler), `stream` yields a request's
-tokens as they land. The whole serve compiles to at most THREE programs no
-matter how requests arrive:
+tokens as they land. The whole serve compiles ONE kind-free ragged step
+program — the scheduler's mixed plan is the only program shape — keyed by
+``(max_batch, width)`` where ``width`` is drawn from a small set of
+**ragged width buckets** (`expected_program_count` is their count):
 
-- the **mixed step** at ``(max_batch, prefill_chunk)`` — every running
-  sequence is one row; decode rows carry 1 live token, prefill rows carry
-  their next chunk, padding goes to the null block;
-- the **decode step** at ``(max_batch, 1)`` — the same program specialized
-  to the (dominant) all-decode case so steady-state decoding never pays the
-  chunk-width compute;
-- the **verify step** at ``(max_batch, 1 + num_spec_tokens)`` (speculative
-  decoding only, off by default) — a decode row carries its pending token
-  AND up to `num_spec_tokens` prompt-lookup drafted candidates
-  (serving/spec.py); all positions are scored in one invocation and the
-  accepted prefix advances the sequence by up to ``k + 1`` tokens. Enable
-  with ``spec_decoding=True`` or ``PADDLE_TPU_SPEC_DECODE=1``; with greedy
+- every planned row is ragged: a decode row feeds its 1 pending token, a
+  prefill row its next ``<= prefill_chunk``-token chunk, a speculative row
+  its pending token plus up to ``num_spec_tokens`` prompt-lookup drafted
+  candidates (serving/spec.py), padding walks the null block. The step's
+  compiled width is the smallest bucket covering its widest row — by
+  default ``{1, 1 + num_spec_tokens (spec engines), prefill_chunk}``, so
+  the dominant all-decode steps run at width 1 and never pay chunk-width
+  compute, while the Pallas kernel's per-row ragged query lengths keep a
+  narrow row cheap inside a wide launch;
+- **sampling runs inside the program**: temperature / top-k / top-p via
+  the one-descending-sort formulation in serving/spec.py (greedy argmax
+  and the per-row isfinite containment check included), on logit rows
+  pinned replicated at the program boundary under tp;
+- **the speculative accept/rollback decision is compiled too**
+  (`spec.spec_emit_arrays`): the program returns ONE packed int32 array —
+  emitted-run tokens, accept lengths, row-finite flags — so every step
+  makes exactly one device→host transfer (the ``host_syncs`` counter /
+  the step trace's ``sync`` phase). Enable speculation with
+  ``spec_decoding=True`` or ``PADDLE_TPU_SPEC_DECODE=1``; with greedy
   sampling the output is token-for-token identical to non-speculative
-  decode, and with temperature sampling the verify step runs rejection
+  decode, and with temperature sampling verification runs rejection
   sampling against the same temperature/top-k/top-p-processed
   distribution, so the output distribution is unchanged.
 
 Prefill buckets are gone: a prompt of ANY length streams into the arena
 `prefill_chunk` tokens at a time while the running batch keeps decoding in
 the same steps, so time-to-first-token of in-flight requests no longer
-spikes when a long prompt arrives. The `jit_traces` counter in `metrics`
-increments inside the traced body (trace time only) and is the test's
-recompile alarm.
+spikes when a long prompt arrives. ``width_buckets`` adds intermediate
+ragged widths (e.g. ``[8, 32]``) so short prefill tails stop paying full
+chunk width — each extra bucket is one more compiled program. The
+`jit_traces` counter in `metrics` increments inside the traced body (trace
+time only) and is the test's recompile alarm; step KINDS (mixed / decode /
+verify) survive as metrics/trace labels only — they no longer key
+programs, so coinciding widths dedup into one executable.
 
 Decode outputs are token-for-token identical to `GPT.generate`'s greedy
 path: the same attention math runs through the block-table gather instead
@@ -46,7 +59,7 @@ would recompute, and writes into shared blocks copy-on-write first.
 
 **Tensor-parallel serving** (``mesh=...`` / ``PADDLE_TPU_TP``,
 serving/sharded.py): weights and the head-major KV arena shard over a
-``tp`` NamedSharding mesh — the same three programs compile mesh-aware
+``tp`` NamedSharding mesh — the same width-bucket programs compile mesh-aware
 (weights/arena pinned to their tp layouts, host-marshalled step inputs
 replicated, arena donation through the ``mesh_donate_argnums`` gate),
 while block tables, scheduler, prefix cache, and refcounts stay host-side
@@ -132,7 +145,7 @@ class LLMEngine:
                  spec_max_ngram=3, spec_min_ngram=1, trace=None,
                  trace_buffer=None, request_log=None, mesh=None,
                  kv_hbm_bytes=None, slo=None, postmortem_dir=None,
-                 postmortem_keep=None):
+                 postmortem_keep=None, width_buckets=None):
         import jax
 
         from .sharded import as_serving_mesh, kv_capacity_blocks
@@ -236,6 +249,31 @@ class LLMEngine:
                 num_spec_tokens=self.num_spec_tokens,
                 max_ngram=spec_max_ngram, min_ngram=spec_min_ngram,
             )
+        # ragged width buckets: the ONLY program shapes this engine ever
+        # compiles — (max_batch, W) for W in this sorted set. Defaults:
+        # width 1 (the dominant all-decode steps), 1 + num_spec_tokens
+        # (spec engines: a drafted pure-decode step), prefill_chunk (the
+        # widest possible chunk). `width_buckets` / PADDLE_TPU_WIDTH_BUCKETS
+        # ("8,32") adds intermediate widths so short prefill tails stop
+        # paying chunk width — each bucket is one more compiled program,
+        # which is why the default set stays minimal. Coinciding widths
+        # (e.g. 1 + num_spec == prefill_chunk) dedup: the table is keyed
+        # by width, not by step kind.
+        if width_buckets is None:
+            wb = os.environ.get("PADDLE_TPU_WIDTH_BUCKETS", "")
+            width_buckets = [int(w) for w in wb.split(",") if w.strip()]
+        buckets = {1, self.prefill_chunk}
+        if self.spec_decoding:
+            buckets.add(min(1 + self.num_spec_tokens, self.max_seq_len))
+        top = max(buckets)
+        for w in width_buckets:
+            w = int(w)
+            if w < 1:
+                raise ValueError(f"width_buckets entries must be >= 1; "
+                                 f"got {w}")
+            if 1 <= w <= top:
+                buckets.add(w)   # wider than any plannable row: useless
+        self.width_buckets = sorted(buckets)
         self.metrics = ServingMetrics()
         # tracing: off unless trace/PADDLE_TPU_TRACE asks for it. A value
         # in (0, 1) samples that fraction of requests; the step timeline
@@ -335,6 +373,7 @@ class LLMEngine:
             prefill_interval=prefill_interval, metrics=self.metrics,
             prefix_cache=self.prefix_cache, drafter=drafter,
             tracer=self.tracer, slo=self.slo,
+            width_buckets=self.width_buckets,
         )
         self._requests = {}
         self._step_fns = {}
@@ -522,29 +561,38 @@ class LLMEngine:
 
     # -- compiled step -----------------------------------------------------
 
-    def _get_step_fn(self, B, S, kind="step"):
-        """One jitted program per (batch, width, kind) — at most three
-        exist: the mixed step (max_batch, prefill_chunk), the decode step
-        (max_batch, 1), and (speculative engines only) the verify step
-        (max_batch, 1 + num_spec_tokens)."""
-        if (B, S, kind) in self._step_fns:
-            return self._step_fns[(B, S, kind)]
+    def _get_step_fn(self, B, W):
+        """The unified ragged step program at width bucket ``W`` — one
+        jitted executable per (batch, width); kinds no longer key
+        programs. Every row feeds ``count`` chunk tokens plus ``k``
+        drafted candidates (``count + k <= W``); the program runs the
+        forward, gathers the ``K + 1`` scored positions starting at each
+        row's ``last_idx`` (K = the width's draft capacity), and finishes
+        the WHOLE per-token decision on device — sampling, speculative
+        accept/rollback, non-finite containment — returning one packed
+        int32 array ``[B, K + 3]``: emitted-run tokens ``[:, :K + 1]``,
+        accept length ``[:, K + 1]``, row-finite flag ``[:, K + 2]``.
+        The host reads it with a single device→host transfer."""
+        if (B, W) in self._step_fns:
+            return self._step_fns[(B, W)]
         import jax
         import jax.numpy as jnp
 
-        from .spec import apply_top_k_top_p, spec_accept_arrays
+        from .spec import spec_emit_arrays
 
         model = self.model
         metrics = self.metrics
 
         smesh = self._smesh
+        K = self._draft_capacity(W)
 
         def forward(params, buffers, k_arena, v_arena, ids, block_tables,
-                    slots, offs, qpos, q_start, kv_live):
+                    slots, offs, qpos, q_start, kv_live, q_lens):
             # runs at TRACE time only — the test's recompile alarm
             metrics.inc("jit_traces")
             state = PagedState(k_arena, v_arena, block_tables, slots, offs,
                                qpos, q_start=q_start, kv_live=kv_live,
+                               q_lens=q_lens,
                                mesh=None if smesh is None else smesh.mesh)
             # mask the process-global TRAINING mesh for the trace (thread-
             # local — a concurrent training trace on another thread keeps
@@ -565,69 +613,62 @@ class LLMEngine:
             return logits, state
 
         def step(params, buffers, k_arena, v_arena, ids, block_tables,
-                 slots, offs, qpos, q_start, kv_live, last_idx, temps,
-                 top_ks, top_ps, key):
+                 slots, offs, qpos, q_start, kv_live, last_idx, spec_lens,
+                 temps, top_ks, top_ps, key):
+            # per-row live width for the ragged kernel: chunk tokens
+            # through last_idx plus the drafted candidates
+            q_lens = last_idx + 1 + spec_lens
             logits, state = forward(params, buffers, k_arena, v_arena, ids,
                                     block_tables, slots, offs, qpos,
-                                    q_start, kv_live)
-            lg = logits[jnp.arange(ids.shape[0]), last_idx].astype(jnp.float32)
+                                    q_start, kv_live, q_lens)
+            # the scored window: K + 1 consecutive positions starting at
+            # each row's last chunk token — position last_idx + j scores
+            # the distribution following fed token last_idx + j, which is
+            # exactly what sampling (j = 0) and draft verification
+            # (j >= 1) need. Rows without drafts just use slot 0.
+            win = last_idx[:, None] + jnp.arange(K + 1)[None, :]
+            win = jnp.clip(win, 0, W - 1)
+            lg = jnp.take_along_axis(
+                logits, win[..., None], axis=1).astype(jnp.float32)
+            win_ids = jnp.take_along_axis(ids, win, axis=1)
             if smesh is not None:
                 # THE one sanctioned boundary all-gather (analysis
-                # contract IR001): materialize the sampled positions'
+                # contract IR001): materialize the scored positions'
                 # full vocab rows replicated ONCE, so every sampler
                 # reduction below (argmax, top-k/top-p, categorical,
-                # isfinite) runs collective-free instead of each paying
-                # its own partial-gather pair on vocab-sharded rows
+                # rejection accept, isfinite) runs collective-free
+                # instead of each paying its own partial-gather pair on
+                # vocab-sharded rows — and the sampled tokens are
+                # bit-identical across tp degrees (same key, same rows)
                 lg = jax.lax.with_sharding_constraint(lg, smesh.replicated())
             # non-finite containment (the TrainMonitor discipline applied
-            # to serving): a NaN/Inf in the sampled-position logits means
-            # this row's forward is numerically poisoned — report it per
-            # row so the host aborts the one request instead of sampling
-            # garbage. One reduction over [B, vocab]; padding lanes are
-            # never inspected on the host side.
-            row_ok = jnp.isfinite(lg).all(axis=-1)
-            greedy = jnp.argmax(lg, axis=-1)
-            scaled = lg / jnp.maximum(temps[:, None], 1e-6)
-            scaled = apply_top_k_top_p(scaled, top_ks, top_ps)
-            sampled = jax.random.categorical(key, scaled, axis=-1)
-            tok = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
-            return tok, row_ok, state.k, state.v
-
-        def verify(params, buffers, k_arena, v_arena, ids, block_tables,
-                   slots, offs, qpos, q_start, kv_live, spec_lens, temps,
-                   top_ks, top_ps, key):
-            logits, state = forward(params, buffers, k_arena, v_arena, ids,
-                                    block_tables, slots, offs, qpos,
-                                    q_start, kv_live)
-            if smesh is not None:
-                # the verify-step boundary gather (contract IR001): all
-                # 1 + num_spec_tokens positions are sampled/compared, so
-                # the whole [B, S, vocab] row block replicates here once
-                # and the accept/rejection sampler below stays
-                # collective-free
-                logits = jax.lax.with_sharding_constraint(
-                    logits, smesh.replicated())
-            # non-finite containment over the row's LIVE positions only
-            # (the pending token + its drafted candidates); padded tail
-            # positions attend through the null block and are never
-            # sampled, so their logits must not poison the row
-            S = ids.shape[1]
-            live = jnp.arange(S)[None, :] <= spec_lens[:, None]
-            pos_ok = jnp.isfinite(logits.astype(jnp.float32)).all(axis=-1)
+            # to serving) over the row's LIVE window positions only (the
+            # pending token + its drafted candidates); padded tail slots
+            # attend through the null block and are never emitted, so
+            # their logits must not poison the row
+            live = jnp.arange(K + 1)[None, :] <= spec_lens[:, None]
+            pos_ok = jnp.isfinite(lg).all(axis=-1)
             row_ok = jnp.where(live, pos_ok, True).all(axis=-1)
-            accept, out_tok = spec_accept_arrays(
-                logits, ids, spec_lens, temps, top_ks, top_ps, key
+            # sampling + the speculative accept/rollback decision, all
+            # compiled (serving/spec.py is the spec): the emitted run and
+            # its length come back ready to publish
+            run, n_acc = spec_emit_arrays(
+                lg, win_ids, spec_lens, temps, top_ks, top_ps, key
             )
-            return accept, out_tok, row_ok, state.k, state.v
+            packed = jnp.concatenate(
+                [run, n_acc[:, None], row_ok.astype(jnp.int32)[:, None]],
+                axis=1,
+            )
+            return packed, state.k, state.v
 
         if smesh is None:
-            fn = jax.jit(verify if kind == "verify" else step,
+            fn = jax.jit(step,
                          # jaxlint: disable=JL004 -- single-device arena donation, deliberately ungated (gating would copy the whole arena every step on CPU); the aliasing it relies on is machine-checked by IR contract IR002 (analysis/contracts.py) on the lowered tp=1 programs
                          donate_argnums=(2, 3))
         else:
-            # mesh-aware program, same (B, S, kind) keying: weights and
-            # arenas pinned to their tp shardings, every host-marshalled
-            # step input (and the sampled tokens out) replicated. Arena
+            # mesh-aware program, same (B, W) keying: weights and arenas
+            # pinned to their tp shardings, every host-marshalled step
+            # input (and the packed result out) replicated. Arena
             # donation routes through the JL004 gate — the host-platform
             # CPU mesh miscompiles donated sharded buffers, so donation
             # is off exactly there and in-place on real accelerators.
@@ -635,34 +676,59 @@ class LLMEngine:
 
             rep = smesh.replicated()
             arena = smesh.arena_sharding()
-            host_in = (rep,) * 12  # ids..key marshalling args + PRNG key
+            host_in = (rep,) * 13  # ids..top_ps marshalling + PRNG key
             in_sh = (self._param_shardings, self._buffer_shardings,
                      arena, arena) + host_in
-            out_sh = ((rep, rep, rep, arena, arena) if kind == "verify"
-                      else (rep, rep, arena, arena))
-            fn = jax.jit(verify if kind == "verify" else step,
-                         in_shardings=in_sh, out_shardings=out_sh,
+            out_sh = (rep, arena, arena)
+            fn = jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                          donate_argnums=mesh_donate_argnums((2, 3)))
-        self._step_fns[(B, S, kind)] = fn
+        self._step_fns[(B, W)] = fn
         return fn
+
+    def _draft_capacity(self, W):
+        """Draft capacity compiled into a width-``W`` program — the ONE
+        formula behind both the traced packed layout ``[B, K + 3]`` and
+        the host-side parse of it (a drift between the two would read
+        accept lengths out of token columns). Wide programs always carry
+        the full verify window (a drafted row can ride a mixed step),
+        narrow ones what fits; width 1 degenerates K to 0 and the window
+        to the plain one-token sampler."""
+        return min(self.num_spec_tokens if self.spec_decoding else 0, W - 1)
+
+    def expected_program_count(self):
+        """THE program-count contract, in one place: the engine compiles
+        at most one executable per ragged width bucket — steady state
+        traces each touched bucket exactly once, so ``jit_traces <=
+        expected_program_count()`` with equality once traffic has
+        exercised every width. Tests and the retrace sentinel both
+        derive from this instead of hardcoding per-kind counts."""
+        return len(self.width_buckets)
+
+    def _width_for(self, w):
+        """Smallest ragged width bucket covering a plan whose widest row
+        feeds ``w`` tokens (the scheduler caps row widths at the top
+        bucket, so this always resolves)."""
+        for b in self.width_buckets:
+            if b >= w:
+                return b
+        raise AssertionError(
+            f"step width {w} exceeds the top width bucket "
+            f"{self.width_buckets[-1]} — scheduler width capping broke"
+        )
 
     # -- lowered-program surface (analysis/ir.py "hlolint") ----------------
 
     def step_program_shapes(self):
-        """{kind: (B, S)} for every program this engine would compile —
-        the mixed step, the decode step, and (speculative engines) the
-        verify step. The IR contract checker lowers exactly these."""
-        shapes = {"mixed": (self.max_batch, self.prefill_chunk),
-                  "decode": (self.max_batch, 1)}
-        if self.spec_decoding:
-            shapes["verify"] = (self.max_batch, 1 + self.num_spec_tokens)
-        return shapes
+        """{name: (B, W)} for every program this engine would compile —
+        one unified ragged step per width bucket, named ``w<width>``.
+        The IR contract checker lowers exactly these."""
+        return {f"w{W}": (self.max_batch, W) for W in self.width_buckets}
 
     def lowered_step_programs(self, kinds=None):
         """AOT-lower the engine's compiled-step programs WITHOUT serving
-        traffic: {kind: jax.stages.Lowered} for each program in
-        `step_program_shapes` (or the `kinds` subset). Weights and the
-        KV arenas pass as their real placed arrays (so shardings and
+        traffic: {name: jax.stages.Lowered} for each width bucket in
+        `step_program_shapes` (or the `kinds` name subset). Weights and
+        the KV arenas pass as their real placed arrays (so shardings and
         donation lower exactly as a served step would); the host-
         marshalled inputs pass as ShapeDtypeStructs. Nothing executes —
         ``.compile()`` on a result yields the artifact hlolint parses
@@ -680,16 +746,14 @@ class LLMEngine:
         h = lambda shape, dt=jnp.int32: jax.ShapeDtypeStruct(shape, dt)
         lowered = {}
         try:
-            for kind, (B, S) in shapes.items():
-                fn = self._get_step_fn(B, S, "verify" if kind == "verify"
-                                       else "step")
-                lowered[kind] = fn.lower(
+            for name, (B, W) in shapes.items():
+                fn = self._get_step_fn(B, W)
+                lowered[name] = fn.lower(
                     self._params, self._buffers, self.pool.k, self.pool.v,
-                    h((B, S)), h((B, self.max_blocks)), h((B, S)), h((B, S)),
-                    h((B, S)), h((B,)), h((B,)),
-                    # last_idx for step programs, spec_lens for verify —
-                    # same (B,) int32 slot either way
-                    h((B,)),
+                    h((B, W)), h((B, self.max_blocks)), h((B, W)), h((B, W)),
+                    h((B, W)), h((B,)), h((B,)),
+                    h((B,)),                      # last_idx
+                    h((B,)),                      # spec_lens
                     h((B,), jnp.float32), h((B,)), h((B,), jnp.float32),
                     jax.ShapeDtypeStruct(self._key.shape, self._key.dtype),
                 )
@@ -706,7 +770,9 @@ class LLMEngine:
         the flat outputs, and whether arena donation is expected to alias
         on this engine (single-chip engines donate unconditionally; mesh
         engines route through `parallel.spmd.mesh_donate_argnums`, which
-        turns donation off on the cpu host platform)."""
+        turns donation off on the cpu host platform). The unified program
+        returns ``(packed, k_arena, v_arena)``, so the arenas land at
+        outputs (1, 2) for every width."""
         import jax
 
         n_state = (len(jax.tree_util.tree_leaves(self._params))
@@ -724,8 +790,9 @@ class LLMEngine:
             donation_on = jax.default_backend() != "cpu"
         return {
             "arena_param_indices": (n_state, n_state + 1),
-            "arena_output_indices": {"mixed": (2, 3), "decode": (2, 3),
-                                     "verify": (3, 4)},
+            "arena_output_indices": {
+                name: (1, 2) for name in self.step_program_shapes()
+            },
             "donation_expected": donation_on,
         }
 
@@ -743,42 +810,26 @@ class LLMEngine:
         return jax.profiler.TraceAnnotation(
             self.tracer.step_annotation(step_id))
 
-    def _run_step(self, fn, ids, tables, slots, offs, qpos, q_start, kv_live,
-                  last_idx, temps, top_ks, top_ps, step_id=0):
-        """Dispatch the step program; returns the DEVICE token array (the
-        caller's np.asarray on it is the step's one host sync)."""
+    def _run_step(self, fn, a, last_idx, spec_lens, step_id=0):
+        """Dispatch the unified step program; returns the DEVICE packed
+        array (the caller's single np.asarray on it is the step's ONE
+        host sync)."""
         import jax
         import jax.numpy as jnp
 
         self._key, sub = jax.random.split(self._key)
         args = (
             self._params, self._buffers, self.pool.k, self.pool.v,
-            jnp.asarray(ids), jnp.asarray(tables), jnp.asarray(slots),
-            jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(q_start),
-            jnp.asarray(kv_live), jnp.asarray(last_idx), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps), sub,
+            jnp.asarray(a["ids"]), jnp.asarray(a["tables"]),
+            jnp.asarray(a["slots"]), jnp.asarray(a["offs"]),
+            jnp.asarray(a["qpos"]), jnp.asarray(a["q_start"]),
+            jnp.asarray(a["kv_live"]), jnp.asarray(last_idx),
+            jnp.asarray(spec_lens), jnp.asarray(a["temps"]),
+            jnp.asarray(a["top_ks"]), jnp.asarray(a["top_ps"]), sub,
         )
         with self._annotation(step_id):
-            tok, row_ok, self.pool.k, self.pool.v = fn(*args)
-        return tok, row_ok
-
-    def _run_verify(self, fn, ids, tables, slots, offs, qpos, q_start,
-                    kv_live, spec_lens, temps, top_ks, top_ps, step_id=0):
-        import jax
-        import jax.numpy as jnp
-
-        self._key, sub = jax.random.split(self._key)
-        args = (
-            self._params, self._buffers, self.pool.k, self.pool.v,
-            jnp.asarray(ids), jnp.asarray(tables), jnp.asarray(slots),
-            jnp.asarray(offs), jnp.asarray(qpos), jnp.asarray(q_start),
-            jnp.asarray(kv_live), jnp.asarray(spec_lens),
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            sub,
-        )
-        with self._annotation(step_id):
-            accept, out_tok, row_ok, self.pool.k, self.pool.v = fn(*args)
-        return accept, out_tok, row_ok
+            packed, self.pool.k, self.pool.v = fn(*args)
+        return packed
 
     # -- fault hooks (serving/faults.py; armed plans only) -----------------
 
@@ -878,25 +929,27 @@ class LLMEngine:
         self.last_planned = [row.req.request_id for row in rows]
         if faults._PLAN is not None:
             self._fire_step_faults()
-        # the dominant all-decode steps run at width 1; a decode step where
-        # the drafter proposed candidates runs at the fixed verify width;
-        # any step carrying a prefill chunk runs at the fixed chunk width —
-        # three shapes total
+        # ONE program shape per step — the smallest ragged width bucket
+        # covering the widest planned row (chunk tokens + drafts). The
+        # dominant all-decode steps resolve to width 1; step KINDS are
+        # metrics/trace labels only and no longer key programs.
+        W = self._width_for(max(r.count + len(r.draft) for r in rows))
         if any(r.count > 1 for r in rows):
-            S, kind = self.prefill_chunk, "mixed"
+            kind = "mixed"
         elif any(r.draft for r in rows):
-            S, kind = 1 + self.num_spec_tokens, "verify"
+            kind = "verify"
         else:
-            S, kind = 1, "decode"
+            kind = "decode"
         step_id = tr.next_step_id() if tr is not None else 0
         if tr is not None:
             self._phases = {"plan": (t_plan0, time.monotonic())}
         with self.metrics.timed(f"{kind}_step"):
-            outs = (self._verify_rows(rows, S, step_id) if kind == "verify"
-                    else self._step_rows(rows, S, step_id))
+            outs = self._run_rows(rows, W, step_id)
         if tr is not None:
             tr.record_step(step_id, kind, self._phases, {
                 "rows": len(rows),
+                "width": W,
+                "host_syncs": 1,
                 "decode_rows": sum(1 for r in rows
                                    if r.count == 1 and not r.draft),
                 "prefill_rows": sum(1 for r in rows if r.count > 1),
@@ -917,20 +970,27 @@ class LLMEngine:
         self.metrics.set_gauge("num_waiting", len(self.scheduler.waiting))
         c = self.metrics.counters
         # recompile sentinel: steady state means jit_traces == compiled
-        # programs (each of the at-most-3 programs traces exactly once).
-        # A surplus trace is a RE-trace of an existing program — some
-        # input's shape/dtype is drifting per step, and every retrace
-        # pays a full XLA compile on the serving hot path.
+        # programs (each width bucket's program traces exactly once, and
+        # the table can never outgrow expected_program_count() — THE
+        # one-place program-count contract). A surplus trace is a
+        # RE-trace of an existing program — some input's shape/dtype is
+        # drifting per step, and every retrace pays a full XLA compile
+        # on the serving hot path.
         retraces = int(c.get("jit_traces", 0)) - len(self._step_fns)
         self.metrics.set_gauge("jit_retraces", max(retraces, 0))
-        if retraces > 0 and not self._retrace_warned:
+        if (retraces > 0 or
+                len(self._step_fns) > self.expected_program_count()) \
+                and not self._retrace_warned:
             self._retrace_warned = True
             warnings.warn(
-                f"LLMEngine recompile sentinel: {retraces} re-trace(s) of "
-                f"already-compiled step programs ({len(self._step_fns)} "
-                f"programs, {int(c['jit_traces'])} traces) — a step input's "
-                "shape or dtype is varying between steps; steady-state "
-                "serving should compile each program exactly once",
+                f"LLMEngine recompile sentinel: {max(retraces, 0)} "
+                f"re-trace(s) of already-compiled step programs "
+                f"({len(self._step_fns)} programs compiled, "
+                f"{self.expected_program_count()} width buckets, "
+                f"{int(c['jit_traces'])} traces) — a step input's shape "
+                "or dtype is varying between steps; steady-state serving "
+                "compiles at most one program per ragged width bucket, "
+                "each exactly once",
                 RuntimeWarning, stacklevel=2,
             )
         n_steps = (c.get("mixed_steps", 0) + c.get("decode_steps", 0)
@@ -962,9 +1022,9 @@ class LLMEngine:
         return outs
 
     def _row_arrays(self, S):
-        """Zeroed per-step host marshalling arrays shared by the step and
-        verify paths (one dict so the two fill loops cannot drift apart
-        on a future per-row field)."""
+        """Zeroed per-step host marshalling arrays for the unified
+        ragged step (one dict so fill sites cannot drift apart on a
+        future per-row field)."""
         B = self.max_batch
         return {
             "ids": np.zeros((B, S), np.int32),
@@ -995,98 +1055,49 @@ class LLMEngine:
         a["q_start"][i] = start
         a["kv_live"][i] = (start + w - 1) // self.block_size + 1
 
-    def _step_rows(self, rows, S, step_id=0):
-        """Run one ragged step: every scheduled row feeds `count` tokens at
-        positions [start, start+count); rows whose chunk reaches the
-        sequence's last pending token sample its next one."""
+    def _run_rows(self, rows, W, step_id=0):
+        """Run one unified ragged step at width bucket `W`: every
+        scheduled row feeds its `count` chunk tokens at positions
+        [start, start+count) plus its (possibly empty) drafted
+        candidates after them; the program samples each emitting row's
+        next token, verifies its drafts, and decides the accepted run ON
+        DEVICE — the host reads ONE packed array (the step's single
+        device→host transfer) and publishes. Rejected speculative tails
+        roll back: their KV slots are stale (overwritten before they are
+        ever attended, exactly like any future position) and their
+        reserved blocks return to the pool via `reclaim_spec_blocks`."""
         tr = self.tracer
         t_build = time.monotonic() if tr is not None else 0.0
-        a = self._row_arrays(S)
+        a = self._row_arrays(W)
         last_idx = np.zeros(self.max_batch, np.int32)
+        spec_lens = np.zeros(self.max_batch, np.int32)
         for i, row in enumerate(rows):
-            req, start, count = row.req, row.start, row.count
+            req, start, count, k = row.req, row.start, row.count, len(row.draft)
             if start == req.num_tokens - 1:
                 # decode fast path: the single pending token is always the
                 # last one — skip rebuilding prompt+outputs every step
                 a["ids"][i, 0] = req.last_token
             else:
                 a["ids"][i, :count] = req.all_ids[start:start + count]
-            last_idx[i] = count - 1
-            self._fill_row(a, i, req, start, count, S)
-        fn = self._get_step_fn(self.max_batch, S)
-        t_disp = time.monotonic() if tr is not None else 0.0
-        tok_dev, ok_dev = self._run_step(
-            fn, a["ids"], a["tables"], a["slots"], a["offs"],
-            a["qpos"], a["q_start"], a["kv_live"], last_idx,
-            a["temps"], a["top_ks"], a["top_ps"], step_id=step_id)
-        t_sync = time.monotonic() if tr is not None else 0.0
-        tok = np.asarray(tok_dev)  # host sync: the step lands here
-        row_ok = np.asarray(ok_dev)
-        if faults._PLAN is not None:
-            row_ok = self._corrupt_row_ok(rows, row_ok)
-        t_emit = time.monotonic() if tr is not None else 0.0
-        outs = []
-        for i, row in enumerate(rows):
-            if not row_ok[i]:
-                # NaN/Inf logits: abort this row only — its KV and token
-                # are garbage; everyone else's step output is unaffected
-                self._poison(row.req, "nonfinite_logits")
-                continue
-            row.req.num_cached += row.count
-            if row.emit:
-                outs.append(self._emit(row.req, int(tok[i])))
-        if tr is not None:
-            t_end = time.monotonic()
-            self._phases.update(build=(t_build, t_disp),
-                                dispatch=(t_disp, t_sync),
-                                sync=(t_sync, t_emit),
-                                emit=(t_emit, t_end))
-            for row in rows:
-                if row.req.traced:
-                    tr.row_span(
-                        row.req,
-                        "prefill_chunk" if row.count > 1 else "decode",
-                        t_disp, t_emit,
-                        {"step": step_id, "start": row.start,
-                         "count": row.count, "emit": row.emit})
-        return outs
-
-    def _verify_rows(self, rows, S, step_id=0):
-        """Run one speculative verify step: every row feeds its pending
-        token plus its (possibly empty) drafted candidates, the jitted
-        verify program scores all positions at once, and the accepted
-        prefix — drafts up to the first rejection, then the model's own
-        token for the stop slot — is emitted. Rejected tails roll back:
-        their KV slots are stale (overwritten before they are ever
-        attended, exactly like any future position) and their reserved
-        blocks return to the pool via `reclaim_spec_blocks`."""
-        tr = self.tracer
-        t_build = time.monotonic() if tr is not None else 0.0
-        a = self._row_arrays(S)
-        spec_lens = np.zeros(self.max_batch, np.int32)
-        for i, row in enumerate(rows):
-            req, start, k = row.req, row.start, len(row.draft)
-            w = 1 + k
-            # drafts only ever attach to emitting decode rows, so the fed
-            # token at `start` is the pending last token; a non-emitting
-            # 1-token chunk row (mid-prefill under budget=1) rides along
-            # draftless and feeds its chunk token
-            a["ids"][i, 0] = (req.last_token if start == req.num_tokens - 1
-                              else req.all_ids[start])
             if k:
-                a["ids"][i, 1:w] = row.draft
+                # drafts only attach to emitting rows, fed right after
+                # the row's pending (last chunk) token
+                a["ids"][i, count:count + k] = row.draft
+            last_idx[i] = count - 1
             spec_lens[i] = k
-            self._fill_row(a, i, req, start, w, S)
-        fn = self._get_step_fn(self.max_batch, S, kind="verify")
+            self._fill_row(a, i, req, start, count + k, W)
+        fn = self._get_step_fn(self.max_batch, W)
+        K = self._draft_capacity(W)
         t_disp = time.monotonic() if tr is not None else 0.0
-        accept, out_tok, ok_dev = self._run_verify(
-            fn, a["ids"], a["tables"], a["slots"], a["offs"], a["qpos"],
-            a["q_start"], a["kv_live"], spec_lens, a["temps"], a["top_ks"],
-            a["top_ps"], step_id=step_id,
-        )
+        packed_dev = self._run_step(fn, a, last_idx, spec_lens,
+                                    step_id=step_id)
         t_sync = time.monotonic() if tr is not None else 0.0
-        accept, out_tok = np.asarray(accept), np.asarray(out_tok)
-        row_ok = np.asarray(ok_dev)
+        # THE host sync: one packed [B, K+3] transfer carries the emitted
+        # runs, accept lengths, and row-finite flags for the whole step
+        packed = np.asarray(packed_dev)
+        self.metrics.inc("host_syncs")
+        run, n_accs, row_ok = (packed[:, :K + 1], packed[:, K + 1],
+                               packed[:, K + 2])
         if faults._PLAN is not None:
             row_ok = self._corrupt_row_ok(rows, row_ok)
         t_emit = time.monotonic() if tr is not None else 0.0
@@ -1094,39 +1105,40 @@ class LLMEngine:
         for i, row in enumerate(rows):
             req, k = row.req, len(row.draft)
             if not row_ok[i]:
+                # NaN/Inf logits: abort this row only — its KV and token
+                # are garbage; everyone else's step output is unaffected
                 self._poison(req, "nonfinite_logits")
                 continue
-            if not row.emit:
-                req.num_cached += 1
-                if tr is not None and req.traced:
-                    # a draftless chunk row riding a verify step still
-                    # rode the step — its lifecycle must show it
-                    tr.row_span(req, "prefill_chunk", t_disp, t_emit,
-                                {"step": step_id, "start": row.start,
-                                 "count": 1, "emit": False})
-                continue
-            n_acc = 0
-            while n_acc < k and accept[i, n_acc]:
-                n_acc += 1
+            n_acc = min(int(n_accs[i]), k)
             if k:
                 self.metrics.inc("spec_drafted_rows")
                 self.metrics.inc("spec_proposed_tokens", k)
                 self.metrics.inc("spec_accepted_tokens", n_acc)
                 req.spec_accepted += n_acc
-            # the fed run [pending, accepted drafts] is real sequence
-            # content, so its KV is valid — advance num_cached BEFORE
-            # emitting (an eos inside the run finishes the request, and
-            # release publishes full prompt blocks off num_cached)
-            req.num_cached += 1 + n_acc
+            # the fed run [chunk tokens, accepted drafts] is real
+            # sequence content, so its KV is valid — advance num_cached
+            # BEFORE emitting (an eos inside the run finishes the
+            # request, and release publishes full prompt blocks off
+            # num_cached)
+            req.num_cached += row.count + n_acc
             if tr is not None and req.traced:
-                tr.row_span(req, "verify", t_disp, t_emit,
-                            {"step": step_id, "drafted": k,
-                             "accepted": n_acc})
-            for t in list(row.draft[:n_acc]) + [int(out_tok[i, n_acc])]:
+                tr.row_span(
+                    req,
+                    ("verify" if k else
+                     "prefill_chunk" if row.count > 1 else "decode"),
+                    t_disp, t_emit,
+                    {"step": step_id, "start": row.start,
+                     "count": row.count, "emit": row.emit,
+                     **({"drafted": k, "accepted": n_acc} if k else {})})
+            if not row.emit:
+                continue
+            # emitted run: accepted drafts then the stop-slot token,
+            # already assembled on device
+            for t in run[i, :n_acc + 1]:
                 outs.append(self._emit(req, int(t)))
                 if req.finished:
                     break
-            if not req.finished:
+            if k and not req.finished:
                 self.scheduler.reclaim_spec_blocks(req)
         if tr is not None:
             self._phases.update(build=(t_build, t_disp),

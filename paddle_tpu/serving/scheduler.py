@@ -45,17 +45,21 @@ sequence, which fails loudly as a config error.
   prompt blocks — freed blocks land in the pool's cached-free tier and
   stay matchable until evicted.
 
-**Speculative decoding** (serving/spec.py) extends a pure-decode step's
-rows in a post-planning pass: when every planned row is a 1-token decode
-row and a drafter is configured, `_attach_drafts` asks the prompt-lookup
-drafter for up to ``num_spec_tokens`` candidate continuations per row and
-reserves KV blocks for them through `_reserve_spec`. The reservation is
-deliberately second-class memory traffic: it only takes TRULY-free blocks
-(never evicts cached prefixes, never preempts another sequence —
-speculation must not steal from real work), drafted tokens are charged to
-the step's ``token_budget``, and a short pool simply trims the draft.
-After verification the engine calls `reclaim_spec_blocks`, which frees
-the reservation's rejected tail (always private, never published) so any
+**Speculative decoding** (serving/spec.py) extends a step's EMITTING rows
+in a post-planning pass: when a drafter is configured, `_attach_drafts`
+asks the prompt-lookup drafter for up to ``num_spec_tokens`` candidate
+continuations per row and reserves KV blocks for them through
+`_reserve_spec`. Row widths are ragged (the unified step program), so
+drafts ride chunk-carrying steps for free inside the step's width bucket,
+and a pure-decode step widens to the spec bucket only when the total
+proposed work amortizes the growth (the width gate — the old majority
+gate re-derived, see `_attach_drafts`). The reservation is deliberately
+second-class memory traffic: it only takes TRULY-free blocks (never
+evicts cached prefixes, never preempts another sequence — speculation
+must not steal from real work), drafted tokens are charged to the step's
+``token_budget``, and a short pool simply trims the draft. After
+verification the engine calls `reclaim_spec_blocks`, which frees the
+reservation's rejected tail (always private, never published) so any
 interleaving of accepts, rejections, preemptions, and aborts returns the
 pool to its idle free count.
 """
@@ -196,7 +200,8 @@ class Request:
 class Scheduler:
     def __init__(self, pool, max_batch=8, token_budget=2048,
                  prefill_chunk=None, prefill_interval=None, metrics=None,
-                 prefix_cache=True, drafter=None, tracer=None, slo=None):
+                 prefix_cache=True, drafter=None, tracer=None, slo=None,
+                 width_buckets=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.token_budget = int(token_budget)
@@ -226,8 +231,26 @@ class Scheduler:
         # preemption are two of its phase-clock transitions; same
         # one-pointer-test discipline as the tracer
         self.slo = slo
+        # the engine's ragged width buckets (the only program shapes it
+        # compiles): draft attachment consults them so speculation can
+        # neither exceed the widest program nor bump a step into a wider
+        # bucket than its drafted work amortizes. None (bare-scheduler
+        # unit tests) means "no bucketing": widths are taken at face
+        # value.
+        self.width_buckets = (sorted(int(w) for w in width_buckets)
+                              if width_buckets else None)
         self.waiting = deque()
         self.running = []
+
+    def _bucket(self, w):
+        """Smallest ragged width bucket covering `w` (identity with no
+        bucket table)."""
+        if self.width_buckets is None:
+            return w
+        for b in self.width_buckets:
+            if b >= w:
+                return b
+        return self.width_buckets[-1]
 
     # -- queue ops ---------------------------------------------------------
 
@@ -491,12 +514,12 @@ class Scheduler:
                 # so a deferred/preempted chunk's share flows to later rows
                 budget -= count
             rows.append(ScheduledRow(req, start, count, emit=count == pending))
-        if (self.drafter is not None and only is None and rows
-                and all(r.count == 1 for r in rows)):
-            # pure-decode step: every row feeds exactly one token, so the
-            # verify program's (max_batch, 1 + num_spec) width can carry
-            # drafted candidates. Steps with prefill chunks never draft —
-            # the mixed program stays one of exactly three.
+        if self.drafter is not None and only is None and rows:
+            # the unified ragged step program carries drafted candidates
+            # at ANY width: emitting rows in a chunk-carrying step draft
+            # for free (the step already pays its bucket's width), and a
+            # pure-decode step may widen to the spec bucket when the
+            # proposed work amortizes it (see _attach_drafts)
             rows = self._attach_drafts(rows, budget)
         return rows
 
@@ -504,18 +527,30 @@ class Scheduler:
 
     def _attach_drafts(self, rows, budget):
         """Ask the drafter for candidate continuations of each emitting
-        decode row and reserve KV for them. Drafted tokens are charged to
-        the remaining step `budget` (a verify step's extra width is real
-        compute); rows keep their plain-decode shape when the request opted
-        out, nothing matched, or memory/budget ran dry.
+        row and reserve KV for them. Drafted tokens are charged to the
+        remaining step `budget` (extra step width is real compute); rows
+        keep their plain shape when the request opted out, nothing
+        matched, or memory/budget ran dry.
 
-        Majority gate: a verify step pays its full ``1 + num_spec`` width
-        for EVERY lane, drafted or not, so when fewer than half the rows
-        have a proposal the whole step stays plain decode — the occasional
-        lone draft cannot tax the batch (proposals are host-side and free;
-        nothing is reserved before the gate passes)."""
+        Width gate — the old majority gate, re-derived for ragged
+        widths. A chunk-carrying (mixed) step already pays its width
+        bucket for every lane, so emitting rows there draft FREE as long
+        as ``count + k`` stays inside that bucket (drafts never widen a
+        mixed step). A pure-decode step would widen from bucket 1 to
+        ``bucket(1 + max k)``, so drafts attach only when the total
+        proposed work amortizes the growth: ``sum(k_i) >= bucket - 1``
+        (at least one lane's worth of drafted tokens per extra width).
+        Unlike the majority gate, a LONE full-window draft now passes —
+        the ragged kernel keeps the other lanes at one query tile, so a
+        single strong proposal no longer taxes the whole batch with a
+        uniform verify width — while a lone short draft still cannot
+        drag everyone to the spec bucket. Proposals are host-side and
+        free; nothing is reserved before the gate passes."""
+        mixed = any(r.count > 1 for r in rows)
+        base_w = self._bucket(max(r.count for r in rows))
+        top_w = (self.width_buckets[-1] if self.width_buckets is not None
+                 else None)
         proposals = []
-        n_proposing = 0
         for row in rows:
             req = row.req
             cap = self.drafter.num_spec_tokens
@@ -524,18 +559,29 @@ class Scheduler:
             # the accepted run emits up to k+1 tokens; never draft past the
             # request's remaining token allowance
             cap = min(cap, req.remaining_new_tokens() - 1)
+            if mixed:
+                # free riders only: never widen a chunk-carrying step
+                cap = min(cap, base_w - row.count)
+            elif top_w is not None:
+                # never exceed the widest compiled program
+                cap = min(cap, top_w - row.count)
             draft = []
             if row.emit and req.spec_decoding is not False and cap >= 1:
                 draft = self.drafter.propose(req.all_ids, cap)
             proposals.append(draft)
-            n_proposing += bool(draft)
-        if 2 * n_proposing < len(rows):
-            return rows
+        if not mixed:
+            w_new = self._bucket(1 + max((len(d) for d in proposals),
+                                         default=0))
+            if sum(len(d) for d in proposals) < w_new - 1:
+                return rows
         out = []
         for row, draft in zip(rows, proposals):
             draft = draft[:budget]
             if draft:
-                draft = self._reserve_spec(row.req, row.start, draft)
+                # reserve after the row's PENDING token (its last chunk
+                # token — for decode rows that is row.start itself)
+                draft = self._reserve_spec(
+                    row.req, row.start + row.count - 1, draft)
             if draft:
                 budget -= len(draft)
                 row = row._replace(draft=tuple(draft))

@@ -755,7 +755,7 @@ def main(argv=None):
                         "PADDLE_TPU_SPEC_DECODE=1)")
     p.add_argument("--num-spec-tokens", type=int, default=4,
                    help="drafted tokens per decode row when speculative "
-                        "decoding is on (fixes the verify program width)")
+                        "decoding is on (sets the spec width bucket)")
     p.add_argument("--trace", type=float, default=None, metavar="FRACTION",
                    help="enable lifecycle/step tracing for this fraction "
                         "of requests (1.0 = all; export at GET "

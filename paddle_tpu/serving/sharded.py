@@ -7,8 +7,8 @@ paged KV arena shard over a ``tp`` mesh axis while every scheduling
 decision — block tables, prefix cache, refcounts, admission, preemption —
 stays host-side and byte-identical to the single-chip engine. Build a
 mesh with `build_serving_mesh` (or just pass ``mesh=2`` to `LLMEngine`)
-and the engine's three compiled programs (mixed / decode / verify) become
-mesh-aware with the same ``(B, S, kind)`` keying.
+and the engine's unified ragged step program becomes mesh-aware at every
+width bucket with the same ``(B, width)`` keying.
 
 The tp layout (the Megatron partitioning the training side already
 encodes in ``Parameter.sharding_axes``, here renamed onto the serving
@@ -50,11 +50,14 @@ host-platform mesh miscompiles donated sharded buffers (outputs alias
 freed inputs), so donation stays off on the cpu backend and on for real
 accelerators.
 
-Single-chip parity guarantee: with greedy sampling, a tp-sharded serve is
-token-for-token identical to the single-chip engine on the same model —
-the mesh changes WHERE flops run, never which tokens come out
-(tests/test_serving_sharded.py locks this on the 8-fake-device CPU mesh,
-prefix-cache hits and speculative decoding included).
+Single-chip parity guarantee: a tp-sharded serve is token-for-token
+identical to the single-chip engine on the same model — greedy AND
+temperature>0 sampling (same PRNG key, same tokens): sampling runs
+inside the compiled step on logit rows pinned replicated at the program
+boundary, so every sampler reduction sees the same replicated values on
+every chip. The mesh changes WHERE flops run, never which tokens come
+out (tests/test_serving_sharded.py locks both on the 8-fake-device CPU
+mesh, prefix-cache hits and speculative decoding included).
 
 Known limit: the engine places SHARDED COPIES of the model's weights
 (`jax.device_put` per `serving_param_specs`) and serves from those; the

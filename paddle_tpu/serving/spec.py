@@ -32,9 +32,12 @@ halves the engine/scheduler wire together:
     rejection-sampling identity, here with a deterministic proposer).
 
 The accepted prefix advances the sequence by up to ``k+1`` tokens per
-step; the host loop (engine `_verify_rows`) truncates at the first
-rejection and rolls back the speculative KV-block reservation for the
-rejected tail (scheduler `reclaim_spec_blocks`).
+step. Since the unified ragged step program, the accept/rollback
+DECISION is compiled too (`spec_emit_arrays`: leading-accept run length
+plus the already-assembled emitted run, both on device), so the host
+side of speculation shrinks to drafting — the engine reads ONE packed
+device array per step and only rolls back the rejected tail's KV-block
+reservation (scheduler `reclaim_spec_blocks`).
 """
 from __future__ import annotations
 
@@ -224,3 +227,43 @@ def spec_accept_arrays(logits, ids, spec_lens, temps, top_ks, top_ps, key):
     )
     out_tok = jnp.where(temps[:, None] > 0.0, sample_tok, greedy)
     return accept, out_tok.astype(jnp.int32)
+
+
+def spec_emit_arrays(logits, ids, spec_lens, temps, top_ks, top_ps, key):
+    """The COMPILED accept/rollback decision (runs inside the unified
+    step program): `spec_accept_arrays` plus the host loop that used to
+    walk it. Same inputs; returns ``(run [B, S] int32, n_acc [B]
+    int32)`` where ``n_acc`` is each row's leading-accept run length and
+    ``run[:, :n_acc + 1]`` is the row's already-assembled emitted run —
+    the accepted drafts followed by the stop-slot token (the greedy
+    argmax / rejection-residual sample at the first rejection, the
+    full-distribution bonus sample when every live draft survived).
+    Slots past ``n_acc`` are dead. With ``spec_lens == 0`` (plain rows,
+    or an engine with speculation off) this degenerates to exactly the
+    one-token sampler: ``n_acc == 0`` and ``run[:, 0]`` is the
+    temperature/top-k/top-p (or greedy) sample — ONE formulation serves
+    decode, prefill-emit, and verify rows, which is what lets the engine
+    compile a single kind-free program and read back one packed array
+    per step instead of re-running accept logic on host."""
+    import jax.numpy as jnp
+
+    B, S, _ = logits.shape
+    accept, out_tok = spec_accept_arrays(
+        logits, ids, spec_lens, temps, top_ks, top_ps, key
+    )
+    j = jnp.arange(S - 1)[None, :]
+    # leading-accept run length: position j survives iff every accept
+    # flag through j is set AND j is a live draft slot (cumprod stops at
+    # the first rejection; dead padded slots never extend the run)
+    alive = accept & (j < spec_lens[:, None])
+    n_acc = (jnp.sum(jnp.cumprod(alive.astype(jnp.int32), axis=1), axis=1)
+             if S > 1 else jnp.zeros((B,), jnp.int32)).astype(jnp.int32)
+    # assemble the emitted run on device: accepted drafts (the fed ids,
+    # shifted — draft j sits at ids[:, j+1]) up to n_acc, then the
+    # stop-slot token. Slots past n_acc keep the stop token (dead; the
+    # host reads run[:n_acc + 1] only).
+    stop_tok = jnp.take_along_axis(out_tok, n_acc[:, None], axis=1)
+    drafts = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))  # [B, S]; pad col dead
+    run = jnp.where(jnp.arange(S)[None, :] < n_acc[:, None],
+                    drafts, stop_tok)
+    return run.astype(jnp.int32), n_acc

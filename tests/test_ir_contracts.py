@@ -1,8 +1,9 @@
 """Tier-1 CI gate: the hlolint IR contracts hold on the compiled programs.
 
-Lowers the serving engine's exactly-3 programs (mixed/decode/verify) at
-tp=1 and tp=2 on the 8-fake-device host mesh plus the spmd train step —
-all on the smallest GPT that still exercises tp sharding — and checks:
+Lowers the serving engine's unified ragged step program at every width
+bucket (w1/w4/w8 on the harness config) at tp=1 and tp=2 on the
+8-fake-device host mesh plus the spmd train step — all on the smallest
+GPT that still exercises tp sharding — and checks:
 
 - zero contract violations on main (collective budget, donation
   aliasing, host-sync hygiene, program-shape baseline);
@@ -54,10 +55,17 @@ def test_main_is_contract_clean(artifacts):
 
 
 def test_program_set_covers_the_registry(artifacts):
+    from paddle_tpu.analysis.ir import build_serving_engine, tiny_gpt_config
+    from paddle_tpu.models.gpt import GPT
+
+    eng = build_serving_engine(GPT(tiny_gpt_config()), 1)
     names = {a.name for a in artifacts}
-    want = {f"serve/tp{tp}/{kind}"
-            for tp in (1, 2) for kind in ("mixed", "decode", "verify")}
+    want = {f"serve/tp{tp}/{name}"
+            for tp in (1, 2) for name in eng.step_program_shapes()}
     want.add("train/dp2_mp2")
+    # one artifact per ragged width bucket — the engine helper is the
+    # ONE place the program-count contract lives
+    assert len(want) == 2 * eng.expected_program_count() + 1
     assert names == want, names
 
 
@@ -70,17 +78,17 @@ def test_gate_stays_under_budget(artifacts):
 
 def test_tp2_collectives_match_the_layout_budget(artifacts):
     by_name = {a.name: a for a in artifacts}
-    tp2 = by_name["serve/tp2/decode"]
+    tp2 = by_name["serve/tp2/w1"]
     assert tp2.collectives == serving_collective_budget(
         ir.tiny_gpt_config(), 2)
     # 2 output projections per layer + the vocab-parallel embedding psum
     assert tp2.collectives["all-reduce"] == 2 * 2 + 1
     # exactly ONE all-gather: the sampler-boundary logit materialization
     assert tp2.collectives["all-gather"] == 1
-    for kind in ("mixed", "verify"):
-        assert by_name[f"serve/tp2/{kind}"].collectives == tp2.collectives
-    for kind in ("mixed", "decode", "verify"):
-        assert not any(by_name[f"serve/tp1/{kind}"].collectives.values())
+    for name in ("w4", "w8"):
+        assert by_name[f"serve/tp2/{name}"].collectives == tp2.collectives
+    for name in ("w1", "w4", "w8"):
+        assert not any(by_name[f"serve/tp1/{name}"].collectives.values())
 
 
 def test_donation_aliases_match_the_gate(artifacts):
@@ -124,7 +132,7 @@ def test_qkv_major_layout_trips_the_all_gather_budget(monkeypatch):
     from paddle_tpu.models import gpt as gpt_mod
 
     monkeypatch.setattr(gpt_mod, "_split_fused_qkv", _qkv_major_split)
-    arts = ir.serving_artifacts(tp_degrees=(2,), kinds=["decode"])
+    arts = ir.serving_artifacts(tp_degrees=(2,), kinds=["w1"])
     (art,) = arts
     assert art.collectives["all-gather"] > 1, art.collectives
     violations = contracts.evaluate(arts, select=["IR001"])
@@ -142,7 +150,7 @@ def test_ungated_donation_trips_the_donation_contract(monkeypatch):
 
     monkeypatch.setattr(spmd, "mesh_donate_argnums",
                         lambda argnums: tuple(argnums))
-    arts = ir.serving_artifacts(tp_degrees=(2,), kinds=["decode"])
+    arts = ir.serving_artifacts(tp_degrees=(2,), kinds=["w1"])
     (art,) = arts
     assert art.aliases, "ungated donation should alias on the host mesh"
     violations = contracts.evaluate(arts, select=["IR002"])
@@ -179,6 +187,48 @@ def test_host_sync_hygiene_contract_flags_unsanctioned_custom_call():
                   text="custom-call(...)")
     assert contracts.evaluate([_fake_artifact(ops=[ok])],
                               select=["IR003"], baseline={}) == []
+
+
+def test_sampler_fused_contract_flags_host_call_after_lm_head():
+    """IR005: a host custom-call BETWEEN attention/LM-head and token
+    emission (a callback-based sampler, say) trips the contract; the
+    same call before the last matmul — or in a program with no sampler
+    region (train) — does not."""
+    def mm(line):
+        return ir.HloOp(opcode="dot-general", result_type="f32[2,2]",
+                        line=line, op_name="jit(step)/dot_general",
+                        custom_call_target=None, text="dot-general(...)")
+
+    def cb(line):
+        return ir.HloOp(opcode="custom-call", result_type="s32[2]",
+                        line=line,
+                        op_name="jit(step)/jit(main)/pure_callback",
+                        custom_call_target="xla_python_cpu_callback",
+                        text="custom-call(...)")
+
+    sampler_tail_call = _fake_artifact(
+        ops=[mm(1), mm(2), cb(3)], expected={"sampler_region": True})
+    violations = contracts.evaluate([sampler_tail_call], select=["IR005"],
+                                    baseline={})
+    assert len(violations) == 1
+    msg = violations[0].format()
+    assert "IR005" in msg and "sampler-fused" in msg
+    assert "between attention and token emission" in msg
+    # the same call BEFORE the last matmul is attention-side plumbing,
+    # not a sampler host sync (IR003's whitelist governs it)
+    pre = _fake_artifact(ops=[mm(1), cb(2), mm(3)],
+                         expected={"sampler_region": True})
+    assert contracts.evaluate([pre], select=["IR005"], baseline={}) == []
+    # GSPMD annotation calls in the tail are tolerated
+    ann = ir.HloOp(opcode="custom-call", result_type="f32[2]", line=3,
+                   op_name="x", custom_call_target="Sharding",
+                   text="custom-call(...)")
+    tol = _fake_artifact(ops=[mm(1), mm(2), ann],
+                         expected={"sampler_region": True})
+    assert contracts.evaluate([tol], select=["IR005"], baseline={}) == []
+    # programs without a sampler region (train) skip the contract
+    train = _fake_artifact(ops=[mm(1), cb(2)], expected={})
+    assert contracts.evaluate([train], select=["IR005"], baseline={}) == []
 
 
 def test_donation_contract_flags_wrong_output_alias():
@@ -343,14 +393,14 @@ def test_cli_select_and_ignore_span_both_layers(capsys, monkeypatch,
     assert doc["summary"]["files"] == 0
     # per-program facts + collectives ride on the JSON line
     names = {p["name"] for p in doc["ir"]["programs"]}
-    assert "serve/tp2/decode" in names
+    assert "serve/tp2/w1" in names
     p = next(p for p in doc["ir"]["programs"]
-             if p["name"] == "serve/tp2/decode")
+             if p["name"] == "serve/tp2/w1")
     assert p["collectives"]["all-reduce"] == 5
     assert {"flops", "bytes_accessed", "peak_bytes"} <= set(p["facts"])
     # ignoring every contract leaves the IR layer green trivially
     assert cli.main(["--ir", "--ignore",
-                     "IR001,IR002,IR003,IR004"]) == 0
+                     "IR001,IR002,IR003,IR004,IR005"]) == 0
     capsys.readouterr()
     # a JL-only select skips the IR layer even with --ir: no "ir" key
     assert cli.main(["--ir", "--select", "JL008", "--json"]) == 0

@@ -86,6 +86,42 @@ def test_kernel_single_row_partial_last_block():
     _check([(9, 1)], block_size=8)
 
 
+def _check_ragged_q(lengths_counts, pad_to, **kw):
+    """Like _check but with per-row ragged QUERY lengths (`q_lens`): the
+    step width pads to `pad_to` and every row declares its own live
+    count — the unified step program's shape (a decode row inside a wide
+    launch). Live outputs must match the reference; dead q tiles may
+    hold garbage."""
+    q, k, v, layer, tables, qpos, q_start, kv_live = _case(
+        lengths_counts, **kw)
+    B, S = q.shape[:2]
+    assert pad_to >= S
+    qw = jnp.zeros((B, pad_to) + q.shape[2:], q.dtype).at[:, :S].set(q)
+    q_lens = jnp.asarray([c for _, c in lengths_counts], jnp.int32)
+    out_k = np.asarray(ragged_paged_attention(
+        qw, k, v, layer, tables, q_start, kv_live, q_lens=q_lens,
+        interpret=True))
+    out_r = np.asarray(paged_attention_xla(q, k, v, layer, tables, qpos))
+    for i, (_, count) in enumerate(lengths_counts):
+        err = np.abs(out_k[i, :count] - out_r[i, :count]).max()
+        assert err < TOL, f"row {i} (count {count}): max err {err}"
+        assert np.isfinite(out_k[i, :count]).all()
+
+
+def test_kernel_ragged_query_lengths_smoke():
+    """Per-row ragged q: a decode row, a short chunk, and a full-width
+    chunk share one 16-wide launch (qt=8, two query tiles — the decode
+    row computes only tile 0); live rows match the reference exactly."""
+    _check_ragged_q([(18, 1), (5, 5), (16, 16)], pad_to=16, block_size=8)
+
+
+def test_kernel_ragged_query_decode_in_wide_launch():
+    """The dominant unified-program case: width-1 decode rows riding a
+    wide (verify/chunk) program width — q_lens=1 everywhere, padding
+    tiles dead."""
+    _check_ragged_q([(9, 1), (23, 1)], pad_to=8, block_size=8)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("block_size", [4, 8, 16])
 @pytest.mark.parametrize("lengths_counts", [

@@ -50,25 +50,88 @@ def test_paged_matches_generate_greedy_overlapping(model):
     outs = engine.generate(prompts, max_new_tokens=6, temperature=0.0)
     for p, o in zip(prompts, outs):
         assert o == _reference(model, p, 6)
-    # all three prompts share the 16-bucket -> 1 prefill + 1 decode program
+    # all three prompts share the chunk-width bucket + the decode bucket
+    # (the one-place program-count contract: engine.expected_program_count)
+    assert engine.expected_program_count() == 2
     assert engine.metrics.counters["jit_traces"] == 2
     assert engine.pool.num_free == engine.pool.num_blocks - 1  # all freed
 
 
 def test_mixed_lengths_compile_two_programs(model):
     """Chunked prefill retired the per-bucket programs: prompts of ANY
-    length share one mixed (max_batch, prefill_chunk) program plus one
-    decode (max_batch, 1) program — re-serving different lengths adds zero
-    traces."""
+    length share one (max_batch, prefill_chunk) instance of the unified
+    ragged step plus its (max_batch, 1) decode-width instance —
+    re-serving different lengths adds zero traces."""
     engine = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64)
     prompts = _prompts((4, 20), seed=1)
     outs = engine.generate(prompts, max_new_tokens=4, temperature=0.0)
     for p, o in zip(prompts, outs):
         assert o == _reference(model, p, 4)
-    assert engine.metrics.counters["jit_traces"] == 2
+    assert (engine.metrics.counters["jit_traces"]
+            == engine.expected_program_count() == 2)
     engine.generate(_prompts((7, 30, 44), seed=2), max_new_tokens=4,
                     temperature=0.0)
     assert engine.metrics.counters["jit_traces"] == 2  # no recompiles
+
+
+def test_width_bucket_collision_dedups_programs(model):
+    """The program table is keyed by (batch, width) only — when the spec
+    width coincides with the chunk width, the old per-kind model's third
+    program simply does not exist: FEWER compiled programs, same
+    tokens."""
+    prompts = _prompts((5, 9, 13), seed=6)
+    base = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64)
+    want = base.generate(prompts, max_new_tokens=8, temperature=0.0)
+    eng = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64,
+                    prefill_chunk=4, spec_decoding=True, num_spec_tokens=3)
+    assert eng.width_buckets == [1, 4]         # 1 + num_spec == chunk
+    assert eng.expected_program_count() == 2   # was 3 kinds pre-unification
+    got = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+    assert got == want
+    assert eng.metrics.counters["jit_traces"] <= 2
+
+
+def test_width_buckets_knob(model, monkeypatch):
+    """`width_buckets` (and PADDLE_TPU_WIDTH_BUCKETS) add intermediate
+    ragged widths: a short prefill rides the smallest covering bucket
+    instead of full chunk width, tokens unchanged."""
+    prompts = _prompts((5, 30), seed=8)
+    base = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64)
+    want = base.generate(prompts, max_new_tokens=4, temperature=0.0)
+    eng = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                    width_buckets=[8])
+    assert eng.width_buckets == [1, 8, 64]
+    assert eng.expected_program_count() == 3
+    (o1,) = eng.generate([prompts[0]], max_new_tokens=4, temperature=0.0)
+    assert o1 == want[0]
+    # the 5-token prefill fit the w8 bucket — chunk width never compiled
+    assert set(eng._step_fns) == {(2, 1), (2, 8)}
+    (o2,) = eng.generate([prompts[1]], max_new_tokens=4, temperature=0.0)
+    assert o2 == want[1]
+    assert set(eng._step_fns) == {(2, 1), (2, 8), (2, 64)}
+    # env spelling + validation
+    monkeypatch.setenv("PADDLE_TPU_WIDTH_BUCKETS", "8,32")
+    env_eng = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64)
+    assert env_eng.width_buckets == [1, 8, 32, 64]
+    monkeypatch.delenv("PADDLE_TPU_WIDTH_BUCKETS")
+    with pytest.raises(ValueError, match="width_buckets"):
+        LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                  width_buckets=[0])
+
+
+def test_one_host_sync_per_step(model):
+    """THE host-sync contract: every step — mixed, decode, spec verify —
+    reads back exactly ONE packed device array, so the `host_syncs`
+    counter equals the step count after any wave."""
+    eng = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64,
+                    prefill_chunk=8, spec_decoding=True, num_spec_tokens=3)
+    eng.generate(_prompts((5, 21, 9), seed=9) + [[7, 3] * 8],
+                 max_new_tokens=8, temperature=0.0)
+    c = eng.metrics.counters
+    steps = (c.get("mixed_steps", 0) + c.get("decode_steps", 0)
+             + c.get("verify_steps", 0))
+    assert steps > 0
+    assert c["host_syncs"] == steps
 
 
 def test_long_prompt_prefills_in_chunks(model):
@@ -324,23 +387,27 @@ def test_scheduler_pool_too_small_fails_loudly():
 
 
 def test_recompile_sentinel_zero_retraces_steady_state(model):
-    """The exactly-3-programs invariant, locked from the sentinel's side:
-    after one warmup wave has compiled the mixed, decode, AND verify
-    programs, an arbitrary steady-state serve (varied prompt lengths,
-    sampling knobs, cache hits) must run with ZERO further XLA traces —
-    `jit_traces` stays equal to the compiled-program count, the
-    `jit_retraces` gauge stays 0, and the sentinel never warns."""
+    """The program-count contract, locked from the sentinel's side via
+    the one shared helper: the compiled table never exceeds
+    `expected_program_count()` (one program per ragged width bucket),
+    and after a warmup wave an arbitrary steady-state serve (varied
+    prompt lengths, sampling knobs, cache hits) runs with ZERO further
+    XLA traces — `jit_traces` stays equal to the compiled-program count,
+    the `jit_retraces` gauge stays 0, and the sentinel never warns."""
     import warnings
 
     engine = LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
                        spec_decoding=True, num_spec_tokens=3)
+    # the default spec engine buckets: decode, 1 + num_spec, chunk
+    assert engine.expected_program_count() == 3
     with warnings.catch_warnings():
         warnings.simplefilter("error")       # any sentinel warning fails
-        # warmup: a repetitive prompt drives mixed + decode + verify
-        engine.generate([[7] * 24], max_new_tokens=6)
-        assert len(engine._step_fns) == 3
+        # warmup: a repetitive prompt drives mixed, decode, AND (via the
+        # pure-decode width gate) spec-bucket steps
+        engine.generate([[7] * 24], max_new_tokens=12)
+        assert len(engine._step_fns) <= engine.expected_program_count()
         warm = engine.metrics.counters["jit_traces"]
-        assert warm == 3                     # one trace per program, ever
+        assert warm == len(engine._step_fns)  # one trace per program, ever
         rs = np.random.RandomState(1)
         for round_ in range(3):
             prompts = [rs.randint(0, 128, (n,)).tolist()
@@ -348,7 +415,9 @@ def test_recompile_sentinel_zero_retraces_steady_state(model):
             engine.generate(prompts[:2], max_new_tokens=8)
             engine.generate([prompts[2]], max_new_tokens=4,
                             temperature=0.8, top_k=5)
-    assert engine.metrics.counters["jit_traces"] == warm  # 0 retraces
+    assert len(engine._step_fns) <= engine.expected_program_count()
+    assert (engine.metrics.counters["jit_traces"]
+            == len(engine._step_fns))        # 0 retraces, ever
     assert engine.metrics.gauges["jit_retraces"] == 0
 
 

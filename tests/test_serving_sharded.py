@@ -2,7 +2,8 @@
 mesh: the acceptance bar is TOKEN parity — a tp-sharded engine serving a
 mixed wave (chunked prefill + decode + speculative drafts + prefix-cache
 hits) emits greedy output token-for-token identical to the single-chip
-engine, still compiles exactly 3 programs with 0 steady-state retraces,
+engine, still compiles at most one program per ragged width bucket
+(`expected_program_count`) with 0 steady-state retraces,
 and keeps every host-side invariant (refcounts drain, pool returns to
 idle). Always-on: the tp=2 smoke plus unit/capacity/topology-surface
 checks; the tp=4/8 sweep, preemption interleaving, and the shard_map'd
@@ -205,15 +206,20 @@ def test_explicit_tp1_beats_env(model, monkeypatch):
 def test_tp2_mixed_wave_token_parity(model, ref_wave):
     """tp=2 serve of the full mixed wave (prefill chunks + decode + spec
     drafts + prefix-cache hits) is greedy token-identical to single-chip,
-    compiles exactly 3 mesh-aware programs with 0 steady-state retraces,
-    and drains the pool to idle."""
+    compiles at most one mesh-aware program per ragged width bucket
+    (`expected_program_count`, the one-place program contract) with 0
+    steady-state retraces, and drains the pool to idle."""
     ref_eng, ref_outs = ref_wave
     eng, outs = _serve_wave(model, mesh=2)
     assert outs == ref_outs
-    # exactly-3-programs + recompile sentinel: every program traced once
-    assert set(k[2] for k in eng._step_fns) == {"step", "verify"}
-    assert len(eng._step_fns) == 3
-    assert int(eng.metrics.counters["jit_traces"]) == 3
+    # program-count contract + recompile sentinel: the table is keyed by
+    # (batch, width) only, never outgrows the bucket set, and every
+    # compiled program traced exactly once
+    assert set(eng._step_fns) <= {(eng.max_batch, w)
+                                  for w in eng.width_buckets}
+    assert len(eng._step_fns) <= eng.expected_program_count()
+    assert (int(eng.metrics.counters["jit_traces"])
+            == len(eng._step_fns))
     assert eng.metrics.gauges.get("jit_retraces", 0) == 0
     # the wave really exercised cache + spec on BOTH engines identically
     for m in (eng.metrics, ref_eng.metrics):
@@ -224,6 +230,28 @@ def test_tp2_mixed_wave_token_parity(model, ref_wave):
     assert (eng.metrics.counters["spec_accepted_tokens"]
             == ref_eng.metrics.counters["spec_accepted_tokens"])
     assert _idle(eng)
+
+
+def test_tp2_temperature_sampling_bit_identical(model):
+    """The PR 10 known limit, closed: with sampling compiled into the
+    step on rows pinned REPLICATED at the program boundary, a tp=2
+    temperature>0 serve draws the same tokens as single-chip from the
+    same PRNG key — bit-identical, not merely same-distribution. The
+    per-step key sequence is host-side and scheduling is deterministic,
+    so every categorical/rejection draw sees the same (replicated) rows
+    and the same key on both engines."""
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, 128, (n,)).tolist() for n in (6, 11, 17)]
+    kw = dict(block_size=8, max_batch=3, max_seq_len=96, prefill_chunk=8,
+              seed=123)
+    ref = LLMEngine(model, mesh=1, **kw)
+    want = ref.generate(prompts, max_new_tokens=12, temperature=0.9,
+                        top_k=20, top_p=0.95)
+    eng = LLMEngine(model, mesh=2, **kw)
+    got = eng.generate(prompts, max_new_tokens=12, temperature=0.9,
+                       top_k=20, top_p=0.95)
+    assert got == want
+    assert _idle(eng) and _idle(ref)
 
 
 def test_tp2_arena_and_param_placement(model, ref_wave):

@@ -8,8 +8,8 @@ Acceptance criteria from the spec-decoding issue:
 - after any interleaving of accepts, full rejections, preemptions, and
   aborts the pool returns to its idle free-block count with all refcounts
   zero (the churn-sweep pattern from tests/test_prefix_cache.py);
-- the compiled-program count stays bounded at exactly THREE (mixed,
-  decode, verify) regardless of request mix;
+- the compiled-program count stays bounded by the engine's ragged
+  width buckets (`expected_program_count()`) regardless of request mix;
 - acceptance-rate metrics are wired: `spec_proposed_tokens` /
   `spec_accepted_tokens` counters, `spec_acceptance_rate` /
   `spec_mean_accepted_len` / `tokens_per_step` gauges, snapshot and
@@ -210,8 +210,8 @@ def test_verify_rejection_sampling_respects_top_k(model):
 def test_spec_greedy_parity_mixed_batch(model):
     """THE acceptance test: the same overlapping request mix served by a
     spec-enabled engine and a plain engine is token-for-token identical,
-    with prefix caching on AND off, and the spec engine compiles exactly
-    three programs."""
+    with prefix caching on AND off, and the spec engine stays inside its
+    `expected_program_count()` width buckets."""
     prompts = _prompts((5, 9, 21, 13), seed=1, shared=4)
     for prefix_cache in (True, False):
         base = LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64,
@@ -225,7 +225,7 @@ def test_spec_greedy_parity_mixed_batch(model):
         got2 = eng.generate(prompts, max_new_tokens=10, temperature=0.0)
         assert got2 == want  # warm pass (cache hits + spec) still exact
         traces = eng.metrics.counters["jit_traces"]
-        assert traces <= 3, traces
+        assert traces <= eng.expected_program_count() == 3, traces
         assert eng.metrics.counters["verify_steps"] > 0
         assert_pool_idle(eng.pool)
     for p, o in zip(prompts, want):
@@ -416,9 +416,13 @@ def _churn(model, rounds, seed, drafter=None):
 
 def test_spec_churn_smoke(model):
     """Always-on tier-1 smoke: n-gram drafting + spec verify under abort
-    churn in a tiny pool, every output exact, pool idle every round."""
+    churn in a tiny pool, every output exact, pool idle every round.
+    Drafted rows may ride mixed steps now (ragged widths), so the
+    exercised-speculation signal is drafted rows, not verify-kind
+    steps."""
     c = _churn(model, rounds=3, seed=0)
-    assert c.get("verify_steps", 0) > 0
+    assert c.get("spec_drafted_rows", 0) > 0
+    assert c.get("spec_proposed_tokens", 0) > 0
     assert c.get("requests_aborted", 0) > 0
 
 
