@@ -21,6 +21,14 @@ AST rules (suppress inline with ``# jaxlint: disable=JLxxx -- reason``):
 - JL006 retrace-hazard        per-call jit rebuilds / unhashable statics
 - JL007 async-hygiene         blocking calls on the event loop
 - JL008 eager-materialize-then-place  device_put(jnp.zeros(...), sharding)
+- JL009 lock-order-cycle      whole-program acquisition-order cycles
+- JL010 cross-thread-shared-state  unguarded state spanning thread roots
+- JL011 event-loop-blocking   blocking calls REACHABLE from async defs
+
+JL009/JL010 run whole-program (threadgraph.py); the runtime lock-order
+witness (witness.py, PADDLE_TPU_LOCK_WITNESS) checks the observed
+acquisition-order graph during the chaos suites and cross-checks it
+against JL009's static model.
 
 IR contracts (``--ir``; submodules `ir` and `contracts`, which lower the
 engine's three serving programs at tp=1/tp=2 plus the spmd train step

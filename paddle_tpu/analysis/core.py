@@ -76,6 +76,21 @@ class Rule:
         )
 
 
+class ProgramRule(Rule):
+    """A rule over the WHOLE parsed module set (interprocedural —
+    e.g. the JL009 lock graph spans modules). Subclasses implement
+    `check_program(modules)`; `check(module)` degrades to the
+    single-module program so `lint_source` fixtures still work."""
+
+    whole_program = True
+
+    def check_program(self, modules):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def check(self, module):
+        return self.check_program([module])
+
+
 RULES: dict[str, Rule] = {}
 
 
@@ -113,6 +128,11 @@ def _parse_suppressions(src):
     """
     line_map = {}
     file_ids = {}
+    if "jaxlint:" not in src:
+        # fast path: no suppression marker anywhere in the file — the
+        # tokenize pass below is the single most expensive part of the
+        # sweep and most files carry no waivers
+        return line_map, file_ids
     standalone = []  # (lineno, ids, justification) pending next code line
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
@@ -341,10 +361,19 @@ def iter_python_files(paths):
 
 def lint_paths(paths, select=None, ignore=None, rel_to=None):
     """Lint files/directories; returns one merged Report. `rel_to` makes
-    reported paths relative (stable CI output)."""
+    reported paths relative (stable CI output).
+
+    Per-module rules run file by file; whole-program rules (ProgramRule)
+    run ONCE over the full parsed module set, so their interprocedural
+    graphs span the sweep instead of stopping at file boundaries."""
     t0 = time.perf_counter()
     findings, errors = [], []
+    modules = []
     files = 0
+    rules = _select_rules(select, ignore)
+    local_rules = [r for r in rules
+                   if not getattr(r, "whole_program", False)]
+    program_rules = [r for r in rules if getattr(r, "whole_program", False)]
     for path in iter_python_files(paths):
         files += 1
         display = os.path.relpath(path, rel_to) if rel_to else path
@@ -354,9 +383,22 @@ def lint_paths(paths, select=None, ignore=None, rel_to=None):
         except OSError as e:
             errors.append((display, f"read error: {e}"))
             continue
-        rep = lint_source(src, path=display, select=select, ignore=ignore)
-        findings.extend(rep.findings)
-        errors.extend(rep.errors)
+        try:
+            mod = Module(path, src, display_path=display)
+        except (SyntaxError, ValueError) as e:
+            errors.append((display, f"parse error: {e}"))
+            continue
+        modules.append(mod)
+        for rule in local_rules:
+            for f in rule.check(mod):
+                findings.append(mod.apply_suppressions(f))
+    if program_rules and modules:
+        by_path = {m.path: m for m in modules}
+        for rule in program_rules:
+            for f in rule.check_program(modules):
+                owner = by_path.get(f.path)
+                findings.append(owner.apply_suppressions(f)
+                                if owner is not None else f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return Report(findings, errors, files=files,
                   duration_s=time.perf_counter() - t0)

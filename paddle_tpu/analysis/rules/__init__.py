@@ -1,2 +1,11 @@
 """Rule modules self-register on import (see core.register)."""
-from . import caching, concurrency, donation, jit_hygiene, placement  # noqa: F401
+from . import (  # noqa: F401
+    caching,
+    concurrency,
+    donation,
+    jit_hygiene,
+    lock_order,
+    loop_blocking,
+    placement,
+    shared_state,
+)

@@ -210,7 +210,11 @@ def _class_attr_types(module, cls):
     """self.<attr> -> (constructor qualname, ctor-had-args), for attrs
     assigned a known blocking type anywhere in the class. Matching is
     EXACT on the alias-resolved qualname: asyncio.Queue/asyncio.Event are
-    loop-native and must not match queue.Queue/threading.Event."""
+    loop-native and must not match queue.Queue/threading.Event.
+    Memoized on the class node — JL007 and JL011 both ask."""
+    cached = getattr(cls, "_jaxlint_attr_types", None)
+    if cached is not None:
+        return cached
     types = {}
     for n in ast.walk(cls):
         if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
@@ -220,6 +224,7 @@ def _class_attr_types(module, cls):
                     attr = _self_attr(t)
                     if attr is not None:
                         types[attr] = (qn, _queue_is_bounded(n.value))
+    cls._jaxlint_attr_types = types
     return types
 
 

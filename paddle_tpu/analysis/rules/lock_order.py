@@ -1,0 +1,78 @@
+"""JL009 lock-order-cycle: whole-program lock acquisition-order graph.
+
+The serving stack holds locks while calling into other subsystems that
+take their own locks (SLO ledger -> metrics families, flight recorder ->
+ledger, functional-call swap -> host RNG). Each such call adds an edge
+"acquires B while holding A" to a program-wide graph; a CYCLE in that
+graph is a deadlock waiting for the right two-thread interleaving — the
+class of bug that freezes a serving replica with zero CPU and no
+traceback. The runtime witness (analysis/witness.py) checks the same
+invariant on the LIVE lock graph during the chaos suites and
+cross-checks the observed edges against this rule's model, so an
+acquisition pattern the parser cannot see fails tier-1 as a parser gap
+instead of shipping unmodeled.
+"""
+from __future__ import annotations
+
+from ..core import ProgramRule, register
+from ..threadgraph import program_for
+
+
+def _fmt_site(site):
+    return f"{site[0]}:{site[1]}"
+
+
+@register
+class LockOrderCycle(ProgramRule):
+    """Cycles in the whole-program 'acquires B while holding A' graph
+    (lock nodes = threading/asyncio locks on self-attrs or module
+    globals; edges propagate through the resolved call graph), plus
+    reacquisition of a non-reentrant lock already held."""
+
+    id = "JL009"
+    name = "lock-order-cycle"
+    incident = ("three of the last nine PRs fixed concurrency bugs "
+                "JL005 could not see past class boundaries; a lock-order "
+                "inversion between two subsystem locks is the same "
+                "blind spot with a worse failure mode — a silent "
+                "two-thread deadlock")
+
+    def check_program(self, modules):
+        prog = program_for(modules)
+        for cycle in prog.lock_cycles():
+            if not cycle:
+                continue
+            if len(cycle) == 1 and cycle[0].a == cycle[0].b:
+                e = cycle[0]
+                yield self._finding_at(
+                    modules, e.b_site,
+                    f"non-reentrant lock {e.a} is reacquired while "
+                    f"already held (outer acquisition at "
+                    f"{_fmt_site(e.a_site)}, via {e.chain}) — this "
+                    "deadlocks the acquiring thread against itself",
+                )
+                continue
+            paths = "; ".join(
+                f"{e.a} held at {_fmt_site(e.a_site)} then {e.b} "
+                f"acquired at {_fmt_site(e.b_site)} (via {e.chain})"
+                for e in cycle)
+            locks = " <-> ".join(sorted({e.a for e in cycle}
+                                        | {e.b for e in cycle}))
+            anchor = min((e.b_site for e in cycle), key=lambda s: s)
+            yield self._finding_at(
+                modules, anchor,
+                f"lock-order cycle between {locks}: {paths} — two "
+                "threads taking these paths concurrently deadlock; "
+                "pick one global acquisition order (or drop the nested "
+                "acquisition)",
+            )
+
+    def _finding_at(self, modules, site, message):
+        class _Anchor:
+            lineno = site[1]
+            col_offset = 0
+
+        class _Mod:
+            path = site[0]
+
+        return self.finding(_Mod, _Anchor, message)

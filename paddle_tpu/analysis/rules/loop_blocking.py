@@ -1,0 +1,155 @@
+"""JL011 event-loop-blocking: JL007 extended from direct calls to
+call-graph reachability.
+
+JL007 flags a blocking call written INSIDE an ``async def``; a blocking
+call two frames below one — ``async handler -> sync helper ->
+queue.get()`` — is invisible to it and freezes the event loop exactly
+the same way (every SSE stream, every health check, at once). This rule
+walks the module-local call graph from every ``async def``: bare-name
+calls resolve to module functions, ``self.m`` to methods of the same
+class, and blocking calls found in reachable SYNC functions are reported
+with the call chain that reaches them. Work handed off the loop through
+``asyncio.to_thread`` / ``run_in_executor`` passes the callable by
+reference, never calls it on the loop, and is therefore naturally not
+traversed.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, ancestors, qn_matches, register
+from .concurrency import (
+    _BLOCKING_QN,
+    _TYPED_BLOCKING,
+    _class_attr_types,
+    _own_statements,
+    _self_attr,
+)
+
+_MAX_DEPTH = 8
+
+
+def _enclosing_class(node):
+    for a in ancestors(node):
+        if isinstance(a, ast.ClassDef):
+            return a
+    return None
+
+
+def _is_method(node):
+    return isinstance(getattr(node, "_jaxlint_parent", None), ast.ClassDef)
+
+
+@register
+class EventLoopBlocking(Rule):
+    """Blocking calls REACHABLE from an ``async def`` through module-
+    local sync helpers (JL007 already covers the direct case, so this
+    rule only reports sites outside the async function itself)."""
+
+    id = "JL011"
+    name = "event-loop-blocking"
+    incident = ("JL007 caught AsyncLLMEngine.shutdown joining the engine "
+                "thread on the loop only because the join was written "
+                "inline; the same join one helper deeper was invisible — "
+                "this rule closes that hole (PR 15)")
+
+    def check(self, module):
+        if not any(isinstance(n, ast.AsyncFunctionDef)
+                   for n in module.nodes):
+            return
+        # module-level defs by name + methods per class (attr types are
+        # resolved lazily — only classes that actually own a reachable
+        # sync helper pay for the scan, and the scan is memoized)
+        mod_defs = {}
+        class_methods = {}
+        for node in module.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _is_method(node):
+                    cls = _enclosing_class(node)
+                    class_methods.setdefault(cls, {})[node.name] = node
+                else:
+                    mod_defs.setdefault(node.name, node)
+        reported = set()
+        for fn in module.nodes:
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            owner = _enclosing_class(fn)
+            for callee, chain in self._reachable_sync(
+                    module, fn, owner, mod_defs, class_methods):
+                owner_cls = _enclosing_class(callee)
+                types = ({} if owner_cls is None
+                         else _class_attr_types(module, owner_cls))
+                for call, msg in self._blocking_calls(module, callee,
+                                                      types):
+                    if id(call) in reported:
+                        continue
+                    reported.add(id(call))
+                    yield self.finding(
+                        module, call,
+                        f"{msg} is reachable from the event loop "
+                        f"('async def {fn.name}' -> {chain}) — it stalls "
+                        "every coroutine on the loop; use the asyncio "
+                        "equivalent or hand the whole helper to "
+                        "run_in_executor/to_thread",
+                    )
+
+    # -- reachability --------------------------------------------------------
+
+    def _reachable_sync(self, module, fn, owner, mod_defs, class_methods):
+        """(sync_fn, chain_str) pairs reachable from async `fn` through
+        module-local calls (bounded DFS; the async root itself is
+        JL007's jurisdiction)."""
+        out = []
+        seen = set()
+        stack = [(fn, owner, "", 0)]
+        while stack:
+            cur, cur_cls, chain, depth = stack.pop()
+            if depth >= _MAX_DEPTH:
+                continue
+            for call in self._calls(cur):
+                target, target_cls = None, None
+                if isinstance(call.func, ast.Name):
+                    target = mod_defs.get(call.func.id)
+                    target_cls = None
+                else:
+                    attr = _self_attr(call.func)
+                    if attr is not None and cur_cls is not None:
+                        target = class_methods.get(cur_cls, {}).get(attr)
+                        target_cls = cur_cls
+                if target is None or isinstance(target,
+                                                ast.AsyncFunctionDef):
+                    continue   # async callees are their own JL007/JL011
+                if id(target) in seen:
+                    continue
+                seen.add(id(target))
+                sub_chain = (f"{chain} -> {target.name}" if chain
+                             else target.name)
+                out.append((target, sub_chain))
+                stack.append((target, target_cls, sub_chain, depth + 1))
+        return out
+
+    @staticmethod
+    def _calls(fn):
+        for n in _own_statements(fn):
+            if isinstance(n, ast.Call):
+                yield n
+
+    # -- blocking-call detection (the JL007 vocabulary) ----------------------
+
+    def _blocking_calls(self, module, fn, types):
+        for n in _own_statements(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            qn = module.qualname(n.func)
+            if qn_matches(qn, *_BLOCKING_QN):
+                yield n, f"blocking call {qn} in '{fn.name}'"
+                continue
+            if isinstance(n.func, ast.Attribute):
+                attr = _self_attr(n.func.value)
+                tname, bounded = types.get(attr, (None, False))
+                if tname and n.func.attr in _TYPED_BLOCKING[tname]:
+                    if (n.func.attr == "put" and tname.startswith("queue.")
+                            and not bounded):
+                        continue
+                    yield n, (f"'{fn.name}' calls .{n.func.attr}() on "
+                              f"self.{attr} (a {tname})")

@@ -1,0 +1,255 @@
+"""JL010 cross-thread-shared-state: the interprocedural generalization
+of JL005 (which reasons about one lock inside one class).
+
+Thread-entry roots are inferred program-wide (Thread targets,
+to_thread/run_in_executor callables, call_soon_threadsafe callbacks,
+stored-callback resolution — threadgraph.py); every self-attr access
+reachable from a root carries the lock set held at the access. State
+reachable from >= 2 distinct roots with at least one write and NO lock
+common to all its accesses is flagged: that is exactly the shape of the
+PR 13 ``functional_call`` tracer-swap race (two engine threads mutating
+one shared layer's arrays) and the PR 12 watchdog-vs-engine phase-clock
+near-miss — neither visible to JL005 because no single ``with`` block
+names the contested field.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import ProgramRule, register
+from ..threadgraph import (
+    THREAD_SAFE_CTORS,
+    _MUTATORS,
+    ClassInfo,
+    _self_attr,
+    program_for,
+)
+
+
+class _Access:
+    __slots__ = ("root", "write", "guards", "path", "line", "method")
+
+    def __init__(self, root, write, guards, path, line, method):
+        self.root = root
+        self.write = write
+        self.guards = guards
+        self.path = path
+        self.line = line
+        self.method = method
+
+
+class _ClassWalker:
+    """Context-sensitive walk of one class from its thread roots: the
+    held-lock set flows through ``with`` blocks and intra-class
+    self-calls; accesses (own attrs AND typed cross-object attrs) are
+    recorded into the per-class ledgers."""
+
+    def __init__(self, prog, ci, ledgers):
+        self.prog = prog
+        self.ci = ci
+        self.ledgers = ledgers
+        self._visited = None
+
+    def walk_root(self, root_id, method_names):
+        for name in sorted(method_names):
+            fi = self.ci.find_method(name)
+            if fi is None:
+                continue
+            self._visited = set()
+            self._visit_method(fi, frozenset(), root_id)
+
+    def _visit_method(self, fi, held, root):
+        key = (fi.qual, held)
+        if key in self._visited or len(self._visited) > 256:
+            return
+        self._visited.add(key)
+        aliases = {}
+        for n in ast.walk(fi.node):
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                attr = _self_attr(n.value)
+                if attr is not None:
+                    aliases[n.targets[0].id] = attr
+        self._walk_body(fi, fi.node.body, held, root, aliases)
+
+    def _walk_body(self, fi, body, held, root, aliases):
+        for node in body:
+            self._walk_node(fi, node, held, root, aliases)
+
+    def _walk_node(self, fi, node, held, root, aliases):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = set(held)
+            for item in node.items:
+                hit = self.prog.resolve_lock_expr(fi, item.context_expr)
+                if hit is not None:
+                    new.add(hit[0])
+                self._walk_node(fi, item.context_expr, held, root, aliases)
+            self._walk_body(fi, node.body, frozenset(new), root, aliases)
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(fi, node, held, root, aliases)
+        if isinstance(node, ast.Attribute):
+            self._handle_attribute(fi, node, held, root, aliases)
+            # fall through: the receiver chain may hold more accesses
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(fi, child, held, root, aliases)
+
+    def _handle_call(self, fi, call, held, root, aliases):
+        func = call.func
+        attr = _self_attr(func)
+        if attr is not None:
+            m = self.ci.find_method(attr)
+            if m is not None:
+                self._visit_method(m, held, root)
+                return
+        if isinstance(func, ast.Attribute):
+            # mutator call on own or cross-object state is a write
+            recv = func.value
+            if func.attr in _MUTATORS:
+                own = _self_attr(recv)
+                if own is not None:
+                    self._record(self.ci, own, True, held, root, fi,
+                                 call.lineno)
+                    return
+                if isinstance(recv, ast.Attribute):
+                    target = self._cross_target_attr(fi, recv, aliases)
+                    if target is not None:
+                        cls, a = target
+                        self._record(cls, a, True, held, root, fi,
+                                     call.lineno)
+
+    def _handle_attribute(self, fi, node, held, root, aliases):
+        write = isinstance(node.ctx, (ast.Store, ast.Del))
+        attr = _self_attr(node)
+        if attr is not None:
+            if self._is_method_ref(self.ci, attr, node):
+                return
+            self._record(self.ci, attr, write, held, root, fi, node.lineno)
+            return
+        target = self._cross_target_attr(fi, node, aliases)
+        if target is not None:
+            cls, a = target
+            if not self._is_method_ref(cls, a, node):
+                self._record(cls, a, write, held, root, fi, node.lineno)
+
+    def _is_method_ref(self, ci, attr, node):
+        """``self.m(...)``/``obj.m(...)`` call receivers and bound-method
+        references are code, not data."""
+        if ci.find_method(attr) is None:
+            return False
+        parent = getattr(node, "_jaxlint_parent", None)
+        return not isinstance(parent, (ast.Assign, ast.AugAssign))
+
+    def _cross_target_attr(self, fi, node, aliases):
+        """(ClassInfo, attr) when `node` is ``self.x.a`` / ``alias.a``
+        with ``self.x`` typed to a program class."""
+        return self._cross_target(fi, node.value, aliases, node.attr)
+
+    def _cross_target(self, fi, recv, aliases, attr=None):
+        own = _self_attr(recv)
+        if own is None and isinstance(recv, ast.Name):
+            own = aliases.get(recv.id)
+        if own is None:
+            return None
+        t = self.ci.attr_types.get(own)
+        if not isinstance(t, ClassInfo) or attr is None:
+            return None
+        return t, attr
+
+    def _record(self, cls, attr, write, held, root, fi, line):
+        ledger = self.ledgers.setdefault(id(cls), (cls, {}))[1]
+        ledger.setdefault(attr, []).append(_Access(
+            f"{self.ci.name}:{root}", write, held, fi.module.path, line,
+            fi.qual))
+
+
+@register
+class CrossThreadSharedState(ProgramRule):
+    """Self-attr state reachable from >= 2 inferred thread-entry roots,
+    written at least once, with no lock common to every access. Fix by
+    guarding all accesses with one lock, confining the state to one
+    thread, or (for deliberately benign GIL-atomic flags) waiving with
+    the reason."""
+
+    id = "JL010"
+    name = "cross-thread-shared-state"
+    incident = ("PR 13: functional_call swapped the SHARED model's "
+                "tensor arrays during tracing; two engine threads (the "
+                "first concurrent multi-engine user) interleaved "
+                "swap/restore and leaked each other's tracers into "
+                "later traces — invisible to JL005 because no lock "
+                "guarded the field anywhere")
+
+    def check_program(self, modules):
+        prog = program_for(modules)
+        prog.resolve_thread_roots()
+        ledgers = {}
+        for ci in prog.classes:
+            roots = self._roots(ci)
+            if len(roots) < 2 or not any(
+                    r.startswith("thread:") for r in roots):
+                continue
+            walker = _ClassWalker(prog, ci, ledgers)
+            for root_id, methods in sorted(roots.items()):
+                walker.walk_root(root_id, methods)
+        for _cid, (cls, ledger) in sorted(
+                ledgers.items(), key=lambda kv: kv[1][0].name):
+            yield from self._judge_class(cls, ledger)
+
+    @staticmethod
+    def _roots(ci):
+        roots = {}
+        callers = {name for name in ci.methods
+                   if not name.startswith("_")}
+        callers |= ci.loop_callbacks
+        if callers:
+            roots["caller"] = callers
+        for label, methods in ci.thread_roots.items():
+            roots[label] = set(methods)
+        return roots
+
+    def _judge_class(self, cls, ledger):
+        for attr in sorted(ledger):
+            accesses = ledger[attr]
+            if cls.find_lock_attr(attr) is not None:
+                continue
+            t = cls.attr_types.get(attr)
+            if isinstance(t, str) and any(
+                    t == c or t.endswith("." + c.rsplit(".", 1)[-1])
+                    for c in THREAD_SAFE_CTORS):
+                continue
+            roots = {a.root for a in accesses}
+            writes = [a for a in accesses if a.write]
+            if len(roots) < 2 or not writes:
+                continue
+            common = None
+            for a in accesses:
+                common = (set(a.guards) if common is None
+                          else common & set(a.guards))
+            if common:
+                continue
+            anchor = next((a for a in writes if not a.guards),
+                          next((a for a in accesses if not a.guards),
+                               writes[0]))
+            root_list = ", ".join(sorted(roots))
+            yield self._finding_at(
+                anchor,
+                f"{cls.name}.{attr} is shared across thread roots "
+                f"({root_list}) with at least one write "
+                f"({writes[0].method}) and no lock common to every "
+                "access — concurrent access races; guard every access "
+                "with one lock or confine the field to one thread",
+            )
+
+    def _finding_at(self, access, message):
+        class _Anchor:
+            lineno = access.line
+            col_offset = 0
+
+        class _Mod:
+            path = access.path
+
+        return self.finding(_Mod, _Anchor, message)

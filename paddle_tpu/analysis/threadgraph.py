@@ -1,0 +1,759 @@
+"""Whole-program thread/lock model: the shared substrate under the
+interprocedural concurrency rules (JL009 lock-order-cycle, JL010
+cross-thread-shared-state) and the runtime lock-order witness cross-check
+(analysis/witness.py + tests/test_lock_witness.py).
+
+Everything here is still pure-stdlib ``ast`` over the Modules the core
+runner already parsed — no imports of the analyzed code. The model is an
+UNDER-approximation built from the idioms this codebase actually uses;
+the runtime witness exists precisely to catch acquisition orders the
+parser failed to model (observed-but-unmodeled edges fail the tier-1
+cross-check as a parser-gap canary).
+
+What is modeled
+---------------
+- **Lock nodes**: ``threading.Lock/RLock/Condition`` and ``asyncio.Lock``
+  instances stored on self-attributes (node ``Class.attr``, named by the
+  DEFINING class so subclasses share their base's node) or module globals
+  (node ``modstem.NAME``). Construction sites are recorded so the runtime
+  witness can map a live lock back to its static node.
+- **Call resolution**: bare names resolve to module-local defs;
+  ``self.m(...)`` resolves through the class and its program-local bases;
+  ``obj.m(...)`` resolves only when exactly ONE program class defines
+  ``m`` and the name is not a too-common method name (a deliberate
+  precision/recall trade: ``self.metrics.observe_hist`` resolves,
+  ``x.get`` never does).
+- **Lock-order edges**: "acquires B while holding A", from literal
+  ``with`` nesting and from calls made inside a ``with`` block whose
+  (transitively resolved) callees acquire locks.
+- **Thread-entry roots** per class: ``Thread(target=self.m)``,
+  ``asyncio.to_thread(self.m)``, ``run_in_executor(_, self.m)`` start
+  ``m`` on its own thread; ``call_soon_threadsafe(self.m)`` marks ``m``
+  as an event-loop entry (grouped with the public "caller" surface); and
+  one round of stored-callback resolution: a method reference assigned
+  into another class's callback slot (or passed to its constructor's
+  callback parameter) that the slot-owner invokes from ITS thread root
+  runs on that foreign thread too.
+- **Self-attr types**: ``self.x = ClassName(...)`` (and one round of
+  constructor-parameter inference) types attributes, so cross-object
+  accesses like ``self.supervisor.step_started_at`` land in the ledger
+  of the class that owns the field.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import qn_matches
+
+THREAD_LOCK_CTORS = ("threading.Lock", "threading.RLock",
+                     "threading.Condition")
+ASYNC_LOCK_CTORS = ("asyncio.Lock",)
+LOCK_CTORS = THREAD_LOCK_CTORS + ASYNC_LOCK_CTORS
+# reacquiring one of these while holding it is legal (no self-deadlock)
+REENTRANT_CTORS = ("threading.RLock", "threading.Condition")
+
+# self-attrs of these types are thread-safe by construction and never
+# shared-state findings themselves
+THREAD_SAFE_CTORS = (
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "threading.local", "threading.Thread",
+    "asyncio.Queue", "asyncio.Event", "asyncio.Lock", "asyncio.Condition",
+)
+
+# obj.m(...) resolution by unique method name skips names this common —
+# they would otherwise bind dict/list/queue/socket calls to whichever
+# program class happens to define the name once
+_COMMON_METHOD_NAMES = frozenset((
+    "get", "put", "set", "add", "pop", "append", "appendleft", "extend",
+    "items", "keys", "values", "join", "start", "run", "stop", "close",
+    "open", "read", "write", "send", "recv", "wait", "clear", "acquire",
+    "release", "update", "copy", "count", "index", "submit", "cancel",
+    "result", "done", "flush", "next", "step", "reset", "format", "load",
+    "save", "name", "eval", "train", "sort", "remove", "discard", "check",
+))
+
+_MUTATORS = ("append", "appendleft", "add", "insert", "extend", "remove",
+             "discard", "pop", "popleft", "popitem", "clear", "update",
+             "setdefault", "move_to_end", "rotate")
+
+_THREAD_SINKS = ("threading.Thread", "Thread")
+_TO_THREAD = ("asyncio.to_thread", "to_thread")
+
+
+def _self_attr(node):
+    """'attr' when node is ``self.attr``, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _own_statements(body):
+    """Nodes under `body` excluding nested function/lambda bodies."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                stack.append(child)
+
+
+def _mod_stem(path):
+    base = os.path.basename(path)
+    return base[:-3] if base.endswith(".py") else base
+
+
+class FuncInfo:
+    """One function/method with its direct lock acquisitions."""
+
+    def __init__(self, module, node, cls=None):
+        self.module = module
+        self.node = node
+        self.cls = cls              # owning ClassInfo or None
+        self.name = node.name
+        # direct with-acquisitions: (lock_node_name, with_stmt, ctor_qn)
+        self.acquires = []
+        self.calls = []             # Call nodes in own statements (cached)
+        self.withs = []             # With/AsyncWith in own statements
+        # computed by Program: lock -> (site_path, site_line, chain_str)
+        self._all_locks = None
+
+    @property
+    def qual(self):
+        if self.cls is not None:
+            return f"{self.cls.name}.{self.name}"
+        return f"{_mod_stem(self.module.path)}.{self.name}"
+
+
+class ClassInfo:
+    def __init__(self, module, node):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.methods = {}           # name -> FuncInfo (own defs only)
+        self.base_names = [b.id for b in node.bases
+                           if isinstance(b, ast.Name)]
+        self.bases = []             # resolved ClassInfo list (program pass)
+        # attr -> {"kind": ctor_qn, "sites": [(path, line)]} for lock attrs
+        self.lock_attrs = {}
+        # attr -> type tag: a ClassInfo (program class) or a ctor qualname
+        # string for known builtin types
+        self.attr_types = {}
+        # attr -> [qualname-ish ctor string] pending program resolution
+        self._pending_types = {}
+        # __init__ params whose value is stored into a self-attr:
+        # param name -> attr name
+        self.param_attrs = {}
+        self.thread_roots = {}      # root label -> set of method names
+        self.loop_callbacks = set()  # call_soon_threadsafe targets
+
+    def find_method(self, name, _seen=None):
+        """Own method or inherited through program-local bases."""
+        if name in self.methods:
+            return self.methods[name]
+        _seen = _seen or set()
+        _seen.add(id(self))
+        for b in self.bases:
+            if id(b) in _seen:
+                continue
+            m = b.find_method(name, _seen)
+            if m is not None:
+                return m
+        return None
+
+    def find_lock_attr(self, attr, _seen=None):
+        """(node_name, ctor_qn) for a lock attr defined here or in a
+        program-local base — the node is named by the DEFINING class."""
+        if attr in self.lock_attrs:
+            info = self.lock_attrs[attr]
+            return f"{self.name}.{attr}", info["kind"]
+        _seen = _seen or set()
+        _seen.add(id(self))
+        for b in self.bases:
+            if id(b) in _seen:
+                continue
+            hit = b.find_lock_attr(attr, _seen)
+            if hit is not None:
+                return hit
+        return None
+
+
+class LockEdge:
+    """First-observed 'acquires `b` while holding `a`' with both sites."""
+
+    def __init__(self, a, b, a_site, b_site, chain):
+        self.a = a
+        self.b = b
+        self.a_site = a_site        # (path, line) of the outer with
+        self.b_site = b_site        # (path, line) of the inner acquisition
+        self.chain = chain          # "f -> g" call path, "" for direct
+
+
+class Program:
+    """The whole-program model over one parsed Module set."""
+
+    def __init__(self, modules):
+        self.modules = list(modules)
+        self.classes = []
+        self.module_funcs = {}      # (stem, name) -> FuncInfo
+        self.funcs = []             # every FuncInfo
+        self.global_locks = {}      # node name -> {"kind", "sites"}
+        self._methods_by_name = {}  # name -> [FuncInfo]
+        self._classes_by_name = {}  # name -> [ClassInfo]
+        for mod in self.modules:
+            self._scan_module(mod)
+        self._index_functions()
+        self._resolve_bases_and_types()
+        self._collect_acquisitions()
+        self._edges = None
+        self._roots_resolved = False
+
+    def _index_functions(self):
+        """One pass per function caching its own-statement Call and
+        With nodes (every later pass reuses these instead of re-walking
+        the tree) and the program-wide constructor-call index."""
+        self._ctor_calls = {}       # id(ClassInfo) -> [(FuncInfo, Call)]
+        for fi in self.funcs:
+            for n in _own_statements(fi.node.body):
+                if isinstance(n, ast.Call):
+                    fi.calls.append(n)
+                    target = self._ctor_target(fi.module, n)
+                    if target is not None:
+                        self._ctor_calls.setdefault(
+                            id(target), []).append((fi, n))
+                elif isinstance(n, (ast.With, ast.AsyncWith)):
+                    fi.withs.append(n)
+
+    # -- module scan --------------------------------------------------------
+
+    def _scan_module(self, mod):
+        stem = _mod_stem(mod.path)
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                qn = mod.qualname(node.value.func)
+                if qn_matches(qn, *LOCK_CTORS):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            name = f"{stem}.{t.id}"
+                            entry = self.global_locks.setdefault(
+                                name, {"kind": qn, "sites": []})
+                            entry["sites"].append(
+                                (mod.path, node.value.lineno))
+        for node in mod.nodes:
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(mod, node)
+            elif (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and not isinstance(getattr(node, "_jaxlint_parent", None),
+                                     ast.ClassDef)):
+                fi = FuncInfo(mod, node)
+                self.funcs.append(fi)
+                self.module_funcs.setdefault((stem, node.name), fi)
+
+    def _scan_class(self, mod, node):
+        ci = ClassInfo(mod, node)
+        self.classes.append(ci)
+        self._classes_by_name.setdefault(ci.name, []).append(ci)
+        for m in node.body:
+            if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fi = FuncInfo(mod, m, cls=ci)
+            ci.methods[m.name] = fi
+            self.funcs.append(fi)
+            self._methods_by_name.setdefault(m.name, []).append(fi)
+        # lock attrs + attr types + __init__ param->attr map
+        for m in ci.methods.values():
+            for n in ast.walk(m.node):
+                if not isinstance(n, ast.Assign):
+                    continue
+                value = n.value
+                for t in n.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    for v in self._value_candidates(value):
+                        if isinstance(v, ast.Call):
+                            qn = mod.qualname(v.func)
+                            if qn_matches(qn, *LOCK_CTORS):
+                                entry = ci.lock_attrs.setdefault(
+                                    attr, {"kind": qn, "sites": []})
+                                entry["sites"].append((mod.path, v.lineno))
+                            elif qn is not None:
+                                ci._pending_types.setdefault(
+                                    attr, []).append(qn)
+                        elif (isinstance(v, ast.Name)
+                              and m.name == "__init__"):
+                            ci.param_attrs.setdefault(v.id, attr)
+
+    @staticmethod
+    def _value_candidates(value):
+        """The value expression plus both arms of a conditional —
+        ``self.h = Default() if h is None else h`` types/locks from
+        either branch."""
+        out, stack = [], [value]
+        while stack:
+            v = stack.pop()
+            if isinstance(v, ast.IfExp):
+                stack.extend((v.body, v.orelse))
+            else:
+                out.append(v)
+        return out
+
+    # -- program-level resolution -------------------------------------------
+
+    def _resolve_bases_and_types(self):
+        for ci in self.classes:
+            for bname in ci.base_names:
+                hits = self._classes_by_name.get(bname, [])
+                if len(hits) == 1:
+                    ci.bases.append(hits[0])
+        for ci in self.classes:
+            for attr, qns in ci._pending_types.items():
+                for qn in qns:
+                    tagged = self._type_for_qn(qn)
+                    if tagged is not None:
+                        ci.attr_types[attr] = tagged
+                        break
+        # one round of constructor-parameter type inference: C(x) where
+        # C.__init__ stores param p into self.a and the call site passes
+        # a value whose type we know -> C.attr_types[a]
+        for target_id, sites in self._ctor_calls.items():
+            for fi, call in sites:
+                target = self._ctor_target(fi.module, call)
+                if target is None:
+                    continue
+                for pname, value in self._bind_args(target, call):
+                    attr = target.param_attrs.get(pname)
+                    if attr is None or attr in target.attr_types:
+                        continue
+                    vt = self._value_type(fi, value)
+                    if vt is not None:
+                        target.attr_types[attr] = vt
+
+    def _type_for_qn(self, qn):
+        if qn is None:
+            return None
+        if qn_matches(qn, *THREAD_SAFE_CTORS):
+            return qn
+        tail = qn.rsplit(".", 1)[-1]
+        hits = self._classes_by_name.get(tail, [])
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    def _ctor_target(self, mod, call):
+        func = call.func
+        if isinstance(func, ast.Name):
+            tail = func.id
+        elif isinstance(func, ast.Attribute):
+            tail = func.attr
+        else:
+            return None
+        if not tail[:1].isupper():   # class-naming convention gate keeps
+            return None              # this O(1) per call
+        hits = self._classes_by_name.get(tail, [])
+        return hits[0] if len(hits) == 1 else None
+
+    @staticmethod
+    def _bind_args(ci, call):
+        """Bind a constructor call's args to __init__ param names."""
+        init = ci.methods.get("__init__")
+        if init is None:
+            return
+        params = [a.arg for a in init.node.args.args[1:]]  # drop self
+        for i, a in enumerate(call.args):
+            if i < len(params):
+                yield params[i], a
+        for kw in call.keywords:
+            if kw.arg is not None:
+                yield kw.arg, kw.value
+
+    def _value_type(self, fi, value):
+        """Type of an argument expression at a call site: a direct
+        constructor call, or a self-attr of the calling class whose type
+        is already known."""
+        for v in self._value_candidates(value):
+            if isinstance(v, ast.Call):
+                t = self._type_for_qn(fi.module.qualname(v.func))
+                if t is not None:
+                    return t
+            attr = _self_attr(v)
+            if attr is not None and fi.cls is not None:
+                t = fi.cls.attr_types.get(attr)
+                if t is not None:
+                    return t
+        return None
+
+    # -- lock node + call resolution ----------------------------------------
+
+    def resolve_lock_expr(self, fi, expr):
+        """(node_name, ctor_qn) for the lock a with-item acquires, or
+        None when the expression is not a modeled lock."""
+        attr = _self_attr(expr)
+        if attr is not None and fi.cls is not None:
+            return fi.cls.find_lock_attr(attr)
+        qn = fi.module.qualname(expr)
+        if qn is None:
+            return None
+        tail = qn.rsplit(".", 1)[-1]
+        stem_local = f"{_mod_stem(fi.module.path)}.{tail}"
+        if stem_local in self.global_locks:
+            return stem_local, self.global_locks[stem_local]["kind"]
+        # imported global lock: unique-tail resolution only (two modules
+        # each defining a _LOCK global stay unresolved rather than
+        # cross-wired)
+        hits = [name for name in self.global_locks
+                if name.rsplit(".", 1)[-1] == tail]
+        if len(hits) == 1:
+            return hits[0], self.global_locks[hits[0]]["kind"]
+        return None
+
+    def resolve_call(self, fi, call):
+        """[FuncInfo] targets of one call node (may be empty)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            hit = self.module_funcs.get(
+                (_mod_stem(fi.module.path), func.id))
+            return [hit] if hit is not None else []
+        if isinstance(func, ast.Attribute):
+            attr = _self_attr(func)
+            if attr is not None and fi.cls is not None:
+                m = fi.cls.find_method(attr)
+                if m is not None:
+                    return [m]
+                return []
+            # typed receiver: self.x.m() with self.x of a known class
+            recv_attr = _self_attr(func.value)
+            if recv_attr is not None and fi.cls is not None:
+                t = fi.cls.attr_types.get(recv_attr)
+                if isinstance(t, ClassInfo):
+                    m = t.find_method(func.attr)
+                    return [m] if m is not None else []
+            # module function called through its module: rng.seed(...)
+            qn = fi.module.qualname(func)
+            if qn is not None and "." in qn:
+                parts = qn.rsplit(".", 2)
+                hit = self.module_funcs.get((parts[-2], parts[-1]))
+                if hit is not None:
+                    return [hit]
+            # unique-method-name fallback for every other receiver
+            if func.attr in _COMMON_METHOD_NAMES:
+                return []
+            hits = self._methods_by_name.get(func.attr, [])
+            if len(hits) == 1:
+                return hits
+        return []
+
+    # -- lock acquisitions + transitive closure -----------------------------
+
+    def _collect_acquisitions(self):
+        for fi in self.funcs:
+            for n in fi.withs:
+                for item in n.items:
+                    hit = self.resolve_lock_expr(fi, item.context_expr)
+                    if hit is not None:
+                        fi.acquires.append((hit[0], n, hit[1]))
+
+    def all_locks(self, fi, _stack=None):
+        """{lock: (path, line, chain)} of every lock `fi` can acquire,
+        transitively through resolved calls.
+
+        Memoized ONLY for top-level queries: a result computed mid-
+        traversal under the cycle cut below can be missing an in-stack
+        ancestor's locks, and caching it would permanently truncate the
+        closure of mutually recursive helpers (JL009 would then miss
+        real edges and the runtime witness would report them as bogus
+        parser gaps). A top-level DFS result is always complete — every
+        reachable function's direct acquires union upward; the cut only
+        skips re-expansion."""
+        if fi._all_locks is not None:
+            return fi._all_locks
+        top = _stack is None
+        if top:
+            _stack = set()
+        if id(fi) in _stack:
+            return {}
+        _stack.add(id(fi))
+        out = {}
+        for lock, stmt, _kind in fi.acquires:
+            out.setdefault(lock, (fi.module.path, stmt.lineno, fi.qual))
+        for call in fi.calls:
+            for callee in self.resolve_call(fi, call):
+                for lock, (path, line, chain) in self.all_locks(
+                        callee, _stack).items():
+                    out.setdefault(
+                        lock, (path, line, f"{fi.qual} -> {chain}"))
+        _stack.discard(id(fi))
+        if top:
+            fi._all_locks = out
+        return out
+
+    # -- lock-order edges + cycles ------------------------------------------
+
+    def lock_edges(self):
+        """{(a, b): LockEdge} over the whole program."""
+        if self._edges is not None:
+            return self._edges
+        edges = {}
+
+        def add(a, b, a_site, b_site, chain):
+            edges.setdefault((a, b), LockEdge(a, b, a_site, b_site, chain))
+
+        for fi in self.funcs:
+            for lock, stmt, _kind in fi.acquires:
+                a_site = (fi.module.path, stmt.lineno)
+                for n in _own_statements(stmt.body):
+                    if isinstance(n, (ast.With, ast.AsyncWith)):
+                        for item in n.items:
+                            hit = self.resolve_lock_expr(fi,
+                                                         item.context_expr)
+                            if hit is not None and hit[0] != lock:
+                                add(lock, hit[0], a_site,
+                                    (fi.module.path, n.lineno), fi.qual)
+                    elif isinstance(n, ast.Call):
+                        for callee in self.resolve_call(fi, n):
+                            for inner, (path, line, chain) in \
+                                    self.all_locks(callee).items():
+                                if inner != lock:
+                                    add(lock, inner, a_site, (path, line),
+                                        f"{fi.qual} -> {chain}")
+                                else:
+                                    # reacquire-through-call: self-edge
+                                    add(lock, lock, a_site, (path, line),
+                                        f"{fi.qual} -> {chain}")
+        self._edges = edges
+        return edges
+
+    def lock_nodes(self):
+        """node name -> {"kind", "sites"} across classes and globals."""
+        nodes = {}
+        for ci in self.classes:
+            for attr, info in ci.lock_attrs.items():
+                nodes[f"{ci.name}.{attr}"] = info
+        nodes.update(self.global_locks)
+        return nodes
+
+    def lock_cycles(self):
+        """[[LockEdge, ...]] — one representative edge list per strongly
+        connected component of size >= 2, plus non-reentrant self-edges
+        as single-edge 'cycles'."""
+        edges = self.lock_edges()
+        adj = {}
+        for (a, b), e in edges.items():
+            if a != b:
+                adj.setdefault(a, []).append(b)
+        sccs = _tarjan(adj)
+        nodes = self.lock_nodes()
+        cycles = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            group = sorted(scc)
+            members = set(group)
+            cycle_edges = [e for (a, b), e in sorted(edges.items())
+                           if a in members and b in members and a != b]
+            cycles.append(cycle_edges)
+        for (a, b), e in sorted(edges.items()):
+            if a == b:
+                kind = nodes.get(a, {}).get("kind", "")
+                if not qn_matches(kind, *REENTRANT_CTORS):
+                    cycles.append([e])
+        return cycles
+
+    # -- thread-entry roots --------------------------------------------------
+
+    def resolve_thread_roots(self):
+        """Fill every class's `thread_roots`: direct sinks plus one round
+        of stored-callback resolution."""
+        if self._roots_resolved:
+            return
+        self._roots_resolved = True
+        for ci in self.classes:
+            self._direct_roots(ci)
+        # reachable-from-thread-root methods, then callback slots
+        for ci in self.classes:
+            foreign = self._foreign_methods(ci)
+            if not foreign:
+                continue
+            slots = self._callback_slots(ci, foreign)
+            if not slots:
+                continue
+            self._resolve_slots(ci, slots)
+
+    def _direct_roots(self, ci):
+        for fi in ci.methods.values():
+            for call in fi.calls:
+                qn = fi.module.qualname(call.func)
+                target = None
+                if qn_matches(qn, *_THREAD_SINKS):
+                    for kw in call.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                elif qn_matches(qn, *_TO_THREAD):
+                    target = call.args[0] if call.args else None
+                elif (isinstance(call.func, ast.Attribute)
+                      and call.func.attr == "run_in_executor"
+                      and len(call.args) >= 2):
+                    target = call.args[1]
+                elif (isinstance(call.func, ast.Attribute)
+                      and call.func.attr == "call_soon_threadsafe"
+                      and call.args):
+                    attr = _self_attr(call.args[0])
+                    if attr is not None and attr in ci.methods:
+                        ci.loop_callbacks.add(attr)
+                    continue
+                if target is None:
+                    continue
+                attr = _self_attr(target)
+                if attr is not None and ci.find_method(attr) is not None:
+                    ci.thread_roots.setdefault(
+                        f"thread:{attr}", set()).add(attr)
+
+    def _foreign_methods(self, ci):
+        """Method names reachable from this class's thread roots via
+        self-calls."""
+        seen = set()
+        queue = [m for ms in ci.thread_roots.values() for m in ms]
+        while queue:
+            name = queue.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            fi = ci.find_method(name)
+            if fi is None:
+                continue
+            for call in fi.calls:
+                attr = _self_attr(call.func)
+                if attr is not None and attr not in seen:
+                    queue.append(attr)
+        return seen
+
+    def _callback_slots(self, ci, foreign):
+        """self-attrs CALLED from a foreign-thread method that are not
+        methods of the class — stored callbacks that run on that
+        thread."""
+        slots = set()
+        for name in foreign:
+            fi = ci.find_method(name)
+            if fi is None:
+                continue
+            for call in fi.calls:
+                attr = _self_attr(call.func)
+                if attr is not None and ci.find_method(attr) is None:
+                    slots.add(attr)
+        return slots
+
+    def _resolve_slots(self, ci, slots):
+        """Mark the methods flowing into `slots` as foreign-thread roots
+        of their owning class: (a) in-class assignments of method refs,
+        (b) constructor call sites passing self.m into a slot param."""
+        slot_params = set()
+        init = ci.methods.get("__init__")
+        if init is not None:
+            for pname, attr in ci.param_attrs.items():
+                if attr in slots:
+                    slot_params.add(pname)
+        for fi in ci.methods.values():
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Assign):
+                    continue
+                for t in n.targets:
+                    if _self_attr(t) not in slots:
+                        continue
+                    for v in self._value_candidates(n.value):
+                        self._mark_ref_as_root(ci, fi, v)
+        if not slot_params:
+            return
+        for fi in self.funcs:
+            for call in fi.calls:
+                if self._ctor_target(fi.module, call) is not ci:
+                    continue
+                for pname, value in self._bind_args(ci, call):
+                    if pname in slot_params:
+                        self._mark_ref_as_root(ci, fi, value)
+
+    def _mark_ref_as_root(self, slot_cls, fi, value):
+        """`value` is an expression assigned into a callback slot: when
+        it is a method reference we can place, the referenced method
+        becomes a thread root of its owning class."""
+        if not isinstance(value, ast.Attribute):
+            return
+        attr = _self_attr(value)
+        if attr is not None and fi.cls is not None:
+            if fi.cls.find_method(attr) is not None:
+                fi.cls.thread_roots.setdefault(
+                    f"thread:via {slot_cls.name}", set()).add(attr)
+            return
+        hits = self._methods_by_name.get(value.attr, [])
+        if len(hits) == 1 and value.attr not in _COMMON_METHOD_NAMES:
+            owner = hits[0].cls
+            if owner is not None:
+                owner.thread_roots.setdefault(
+                    f"thread:via {slot_cls.name}", set()).add(value.attr)
+
+
+def _tarjan(adj):
+    """Strongly connected components of {node: [succ]} (iterative)."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = [0]
+    for start in sorted(adj):
+        if start in index:
+            continue
+        work = [(start, iter(adj.get(start, ())))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = counter[0]
+                    counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append((succ, iter(adj.get(succ, ()))))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    n = stack.pop()
+                    on_stack.discard(n)
+                    scc.append(n)
+                    if n == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def program_for(modules):
+    """The (cached) Program for one parsed module list — program rules
+    running over the same sweep share one model build."""
+    if not modules:
+        return Program([])
+    anchor = modules[0]
+    prog = getattr(anchor, "_jaxlint_program", None)
+    if prog is None or len(prog.modules) != len(modules):
+        prog = Program(modules)
+        anchor._jaxlint_program = prog
+    return prog

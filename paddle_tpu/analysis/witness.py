@@ -1,0 +1,412 @@
+"""Runtime lock-order witness: the FACT layer under JL009's claim layer.
+
+jaxlint's JL009 builds the whole-program lock graph statically; this
+module observes the REAL one. While installed, every
+``threading.Lock``/``threading.RLock`` constructed from paddle_tpu code
+is wrapped so acquire/release maintain a per-thread held-set with
+acquisition sites; each "acquired B while holding A" pair becomes an
+edge in the observed acquisition-order graph, recorded once with both
+acquisition stacks. At teardown:
+
+- `Witness.check_acyclic()` asserts the union graph has no cycle,
+  naming both acquisition paths of every offending edge — a runtime
+  deadlock witness over whatever interleavings the chaos suites drove;
+- `cross_check(witness)` maps every observed edge back to the static
+  JL009 graph by lock CONSTRUCTION SITE and fails on
+  observed-but-unmodeled edges — the hlolint-canary discipline: when
+  the parser's model of the code goes stale, tier-1 goes red instead of
+  the model silently rotting.
+
+Gating: nothing in the serving stack imports this module. The chaos
+suites install it when ``PADDLE_TPU_LOCK_WITNESS`` is truthy (plus one
+dedicated tier-1 test that installs it explicitly), so the witness-off
+serve is byte-identical by construction. asyncio.Lock is deliberately
+not witnessed — it is event-loop-confined and cannot participate in a
+cross-THREAD cycle; the static graph still models it.
+
+Limitations (documented, and why they are acceptable): only locks
+CONSTRUCTED while installed are wrapped (install before building
+engines); ``Condition``'s internal ``_release_save`` fast path is not
+intercepted (this codebase constructs no Conditions); a lock acquired
+through ``acquire(timeout=...)`` that times out records no edge.
+"""
+from __future__ import annotations
+
+import contextlib
+import linecache
+import os
+import sys
+import threading
+import traceback
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+_ACTIVE = None          # the installed Witness (at most one)
+
+
+def enabled_from_env(env="PADDLE_TPU_LOCK_WITNESS"):
+    """Truthy unless unset/0/false/off/no — the chaos suites' gate."""
+    return os.environ.get(env, "").strip().lower() not in (
+        "", "0", "false", "off", "no")
+
+
+class LockOrderViolation(AssertionError):
+    """The observed acquisition-order graph has a cycle (or a
+    non-reentrant lock was reacquired by its holder)."""
+
+
+class _Edge:
+    """First observation of 'acquired `b` while holding `a`'."""
+
+    __slots__ = ("a", "b", "a_site", "b_site", "b_stack", "count")
+
+    def __init__(self, a, b, a_site, b_site, b_stack):
+        self.a = a              # held lock's ctor site (file, line)
+        self.b = b              # acquired lock's ctor site
+        self.a_site = a_site    # held lock's acquisition site (file, line)
+        self.b_site = b_site    # this acquisition's site
+        self.b_stack = b_stack  # formatted stack of this acquisition
+        self.count = 1
+
+
+class _WitnessedLock:
+    """Wrapper over a real lock delegating everything, with held-set
+    bookkeeping around acquire/release. `reentrant` suppresses
+    self-edges for RLocks (reacquiring one is legal)."""
+
+    def __init__(self, witness, inner, site, reentrant):
+        self._w = witness
+        self._inner = inner
+        self.ctor_site = site
+        self.reentrant = reentrant
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._w._did_acquire(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._w._did_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class Witness:
+    """The observed acquisition-order graph plus per-thread held sets."""
+
+    def __init__(self, package_root=_PKG_ROOT):
+        self.package_root = package_root
+        self._tls = threading.local()
+        self._meta = _ORIG_LOCK()      # guards edges/nodes (a REAL lock:
+        self.edges = {}                # the witness must not witness
+        self.nodes = {}                # itself)
+
+    # -- factory side --------------------------------------------------------
+
+    def _caller_site(self):
+        """(file, line) of the first frame outside this module."""
+        f = sys._getframe(2)
+        while f is not None and f.f_code.co_filename == __file__:
+            f = f.f_back
+        if f is None:
+            return ("<unknown>", 0)
+        return (f.f_code.co_filename, f.f_lineno)
+
+    def _wants(self, site):
+        """Witness only locks constructed from paddle_tpu code — stdlib
+        internals (logging, asyncio plumbing) keep raw locks. The source
+        line must itself name the construction: a C-extension caller
+        (numpy's BitGenerator building its own lock) has no Python frame,
+        so the nearest visible frame is OUR code and would otherwise
+        claim a foreign lock the static model rightly ignores."""
+        if not site[0].startswith(self.package_root):
+            return False
+        return "Lock(" in linecache.getline(site[0], site[1])
+
+    def make_lock(self):
+        site = self._caller_site()
+        if not self._wants(site):
+            return _ORIG_LOCK()
+        self._note_node(site, "Lock")
+        return _WitnessedLock(self, _ORIG_LOCK(), site, reentrant=False)
+
+    def make_rlock(self):
+        site = self._caller_site()
+        if not self._wants(site):
+            return _ORIG_RLOCK()
+        self._note_node(site, "RLock")
+        return _WitnessedLock(self, _ORIG_RLOCK(), site, reentrant=True)
+
+    def _note_node(self, site, kind):
+        with self._meta:
+            self.nodes.setdefault(site, kind)
+
+    # -- acquire/release bookkeeping ----------------------------------------
+
+    def _held(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []   # [(lock, acq_site)] in order
+        return held
+
+    def _did_acquire(self, lock):
+        held = self._held()
+        site = self._caller_site()
+        for h, _ in held:
+            if h is lock:
+                # reentrant reacquire: the pair set is unchanged, so no
+                # new edges (a BLOCKING self-reacquire of a plain Lock
+                # deadlocks inside the inner acquire and never reaches
+                # here — that failure mode belongs to JL009's static
+                # self-edge check)
+                held.append((lock, site))
+                return
+        new_edges = []
+        for h, h_site in held:
+            key = (h.ctor_site, lock.ctor_site)
+            new_edges.append((key, h_site, site))
+        held.append((lock, site))
+        if not new_edges:
+            return
+        with self._meta:
+            for key, a_site, b_site in new_edges:
+                e = self.edges.get(key)
+                if e is None:
+                    self.edges[key] = _Edge(
+                        key[0], key[1], a_site, b_site,
+                        "".join(traceback.format_stack(limit=10)))
+                else:
+                    e.count += 1
+
+    def _did_release(self, lock):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lock:
+                del held[i]
+                return
+
+    # -- teardown checks -----------------------------------------------------
+
+    def held_now(self):
+        """This thread's held list (tests of the bookkeeping)."""
+        return [(lk.ctor_site, site) for lk, site in self._held()]
+
+    def observed_graph(self):
+        """JSON-able observed graph: nodes by construction site, edges
+        with both acquisition sites and counts."""
+        with self._meta:
+            nodes = [{"ctor": f"{f}:{ln}", "kind": kind}
+                     for (f, ln), kind in sorted(self.nodes.items())]
+            edges = [{
+                "held_ctor": f"{e.a[0]}:{e.a[1]}",
+                "acquired_ctor": f"{e.b[0]}:{e.b[1]}",
+                "held_at": f"{e.a_site[0]}:{e.a_site[1]}",
+                "acquired_at": f"{e.b_site[0]}:{e.b_site[1]}",
+                "count": e.count,
+            } for _, e in sorted(self.edges.items())]
+        return {"nodes": nodes, "edges": edges}
+
+    def check_acyclic(self):
+        """Assert the union acquisition-order graph is acyclic; raises
+        LockOrderViolation naming both acquisition paths otherwise."""
+        with self._meta:
+            edges = dict(self.edges)
+        adj = {}
+        for (a, b) in edges:
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+        cycle = _find_cycle(adj)
+        if cycle is None:
+            return
+        lines = ["lock acquisition-order cycle observed at runtime:"]
+        for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+            e = edges.get((a, b))
+            if e is None:
+                continue
+            lines.append(
+                f"  held {a[0]}:{a[1]} (acquired at "
+                f"{e.a_site[0]}:{e.a_site[1]}) then acquired "
+                f"{b[0]}:{b[1]} at {e.b_site[0]}:{e.b_site[1]} "
+                f"({e.count}x); acquisition stack:\n{e.b_stack}")
+        raise LockOrderViolation("\n".join(lines))
+
+
+def _find_cycle(adj):
+    """One cycle (node list) in {node: {succ}} or None."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    parent = {}
+    for start in sorted(adj):
+        if color.get(start, WHITE) != WHITE:
+            continue
+        stack = [(start, iter(sorted(adj.get(start, ()))))]
+        color[start] = GRAY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for succ in it:
+                c = color.get(succ, WHITE)
+                if c == GRAY:
+                    cycle = [succ]
+                    cur = node
+                    while cur != succ:
+                        cycle.append(cur)
+                        cur = parent[cur]
+                    cycle.reverse()
+                    return cycle
+                if c == WHITE:
+                    color[succ] = GRAY
+                    parent[succ] = node
+                    stack.append((succ, iter(sorted(adj.get(succ, ())))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+    return None
+
+
+# -- install / uninstall -----------------------------------------------------
+
+
+_REFS = 0
+
+
+def install(package_root=None):
+    """Patch the threading lock factories; returns the active Witness.
+    Re-entrant: a nested install returns the existing witness and bumps
+    a refcount, so an inner install/uninstall pair (or `witnessed()`
+    used inside an already-witnessed chaos module) cannot silently tear
+    the outer witness down mid-run. A nested install asking for a
+    DIFFERENT `package_root` raises — silently keeping the old filter
+    would mis-attribute every lock the caller expected to witness.
+    `package_root` widens/narrows the construction-site filter (unit
+    tests witness locks built in the test file itself)."""
+    global _ACTIVE, _REFS
+    if _ACTIVE is not None:
+        # package_root=None adopts the active witness (witnessed() used
+        # inside an already-witnessed module); only an EXPLICIT
+        # conflicting root is an error
+        if (package_root is not None
+                and package_root != _ACTIVE.package_root):
+            raise RuntimeError(
+                f"lock witness already installed with package_root="
+                f"{_ACTIVE.package_root!r}; cannot re-install with "
+                f"{package_root!r} — uninstall first")
+        _REFS += 1
+        return _ACTIVE
+    w = Witness(package_root=package_root or _PKG_ROOT)
+    threading.Lock = w.make_lock
+    threading.RLock = w.make_rlock
+    _ACTIVE = w
+    _REFS = 1
+    return w
+
+
+def uninstall():
+    """Drop one install; the original factories are restored when the
+    LAST install is released (already-wrapped locks keep working — they
+    own their real inner lock). A no-op when nothing is installed."""
+    global _ACTIVE, _REFS
+    if _ACTIVE is None:
+        return
+    _REFS -= 1
+    if _REFS > 0:
+        return
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _ACTIVE = None
+    _REFS = 0
+
+
+def active():
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def witnessed():
+    """``with witnessed() as w:`` — install around a block, uninstall
+    after (the caller still runs `w.check_acyclic()` explicitly so a
+    test failure points at the assertion, not the fixture)."""
+    w = install()
+    try:
+        yield w
+    finally:
+        uninstall()
+
+
+# -- static cross-check ------------------------------------------------------
+
+
+def cross_check(witness, package_dir=None):
+    """Map every observed edge onto the static JL009 lock graph; returns
+    a list of human-readable gaps (empty = the static model covers
+    everything the runtime saw). A gap is either a lock the parser never
+    modeled or an observed edge absent from the static graph — both mean
+    the JL009 model went stale (the parser-gap canary)."""
+    from .core import Module, iter_python_files
+    from .threadgraph import Program
+
+    package_dir = package_dir or _PKG_ROOT
+    rel_root = os.path.dirname(package_dir)
+    modules = []
+    for path in iter_python_files([package_dir]):
+        display = os.path.relpath(path, rel_root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                modules.append(Module(path, f.read(), display_path=display))
+        except (OSError, SyntaxError, ValueError):
+            continue
+    prog = Program(modules)
+    static_nodes = prog.lock_nodes()
+    site_to_node = {}
+    for name, info in static_nodes.items():
+        for path, line in info["sites"]:
+            site_to_node[(os.path.abspath(
+                os.path.join(rel_root, path)), line)] = name
+    static_edges = {(a, b) for (a, b) in prog.lock_edges()}
+
+    def _map(site):
+        return site_to_node.get((os.path.abspath(site[0]), site[1]))
+
+    gaps = []
+    with witness._meta:
+        nodes = dict(witness.nodes)
+        edges = dict(witness.edges)
+    for site in nodes:
+        if _map(site) is None:
+            gaps.append(
+                f"unmodeled lock: constructed at {site[0]}:{site[1]} "
+                "but absent from the static JL009 graph (parser gap: "
+                "teach threadgraph.py this construction idiom)")
+    for (a, b), e in sorted(edges.items()):
+        na, nb = _map(a), _map(b)
+        if na is None or nb is None:
+            continue   # already reported as unmodeled locks
+        if na == nb:
+            continue   # same static node (e.g. two instances): no order
+        if (na, nb) not in static_edges:
+            gaps.append(
+                f"observed-but-unmodeled edge: {na} -> {nb} "
+                f"(held at {e.a_site[0]}:{e.a_site[1]}, acquired at "
+                f"{e.b_site[0]}:{e.b_site[1]}, {e.count}x) — the static "
+                "JL009 graph has no such edge; teach threadgraph.py the "
+                "call path or the model has gone stale")
+    return gaps

@@ -163,11 +163,14 @@ class Tracer:
     def chrome_trace(self):
         """The trace as a Chrome/Perfetto trace-event JSON object. Track
         metadata is kept outside the ring, so lane names survive even
-        after the ring has overwritten the events that created them."""
+        after the ring has overwritten the events that created them.
+        The meta snapshot shares the ring's lock: producers append lane
+        metadata mid-run (EngineTracer._lane) while any thread exports."""
         with self._lock:
             ring = list(self.events)
+            meta = list(self._meta)
         return {
-            "traceEvents": list(self._meta) + ring,
+            "traceEvents": meta + ring,
             "displayTimeUnit": "ms",
             "otherData": {
                 "producer": self.producer,

@@ -217,6 +217,7 @@ class AsyncLLMEngine:
         """Bind to the running event loop and start the engine thread."""
         if self._thread is not None:
             return self
+        # jaxlint: disable=JL010 -- written once here, BEFORE the engine/watchdog threads exist (Thread.start is the happens-before edge); read-only afterwards
         self._loop = asyncio.get_running_loop()
         self._stopped = asyncio.Event()
         self.metrics.set_gauge("engine_unhealthy", 0.0)
@@ -289,6 +290,7 @@ class AsyncLLMEngine:
                 f"engine unhealthy: {self.health.reason}; cannot resume "
                 "admission", reason="unhealthy", retry_after_s=None,
             )
+        # jaxlint: disable=JL010 -- GIL-atomic bool flag, benign race by design: a submit racing a drain flip is re-checked on the engine thread (draining adds reject)
         self._closed = False
 
     async def shutdown(self, drain=True, timeout_s=30.0):

@@ -155,6 +155,7 @@ class EngineSupervisor:
         After a recovery, ``last_touched`` names every request of the
         failed step's plan (else it is empty)."""
         eng = self.engine
+        # jaxlint: disable=JL010 -- single-threaded in reality: step() has exactly one caller, the engine thread's _engine_loop, which also does the reading
         self.last_touched = []
         try:
             outs = self._timed_step()
@@ -164,11 +165,13 @@ class EngineSupervisor:
         return outs, list(eng.step_faults)
 
     def _timed_step(self, only=None):
+        # jaxlint: disable=JL010 -- deliberate lock-free design (see class doc): a single GIL-atomic attribute store; the watchdog thread tolerates a stale read by construction (one extra poll interval of latency)
         self.step_started_at = time.monotonic()
         try:
             return self.engine.step(only=only)
         finally:
             self.step_started_at = None
+            # jaxlint: disable=JL010 -- GIL-atomic monotonic float; the loop-thread reader (shutdown's wedge detector) only needs progress-vs-staleness, never an exact value
             self.last_step_finished = time.monotonic()
 
     # -- poison isolation ----------------------------------------------------
@@ -470,6 +473,7 @@ class StepWatchdog:
                 continue
             stuck = time.monotonic() - started
             if stuck >= self.timeout_s:
+                # jaxlint: disable=JL010 -- GIL-atomic bool, set once and never cleared; the engine thread reading it late only delays the orphan-abort sweep by one loop turn
                 self.tripped = True
                 self.on_trip(stuck)
                 return   # sticky: one trip per watchdog lifetime

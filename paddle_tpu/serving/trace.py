@@ -123,9 +123,13 @@ class EngineTracer(Tracer):
             self._lane_of[req.request_id] = tid
             if tid not in self._named_lanes:
                 self._named_lanes.add(tid)
-                self._meta.append(self._meta_ev(
-                    "thread_name", PID_REQUESTS, tid,
-                    {"name": f"req-lane-{tid - _LANE_BASE:03d}"}))
+                # under the ring lock: chrome_trace() snapshots _meta
+                # from the HTTP thread while this (engine) thread names
+                # new lanes mid-serve
+                with self._lock:
+                    self._meta.append(self._meta_ev(
+                        "thread_name", PID_REQUESTS, tid,
+                        {"name": f"req-lane-{tid - _LANE_BASE:03d}"}))
         return tid
 
     def begin_request(self, req):

@@ -23,10 +23,10 @@ def rule_ids(rep):
     return [f.rule for f in rep.unsuppressed]
 
 
-def test_registry_has_all_eight_rules():
+def test_registry_has_all_eleven_rules():
     assert [r.id for r in all_rules()] == [
         "JL001", "JL002", "JL003", "JL004", "JL005", "JL006", "JL007",
-        "JL008"]
+        "JL008", "JL009", "JL010", "JL011"]
     for r in all_rules():
         assert r.incident, f"{r.id} must name its historical incident"
 
@@ -420,6 +420,289 @@ def test_jl007_literal_zero_maxsize_is_unbounded():
                 self.q.put(x)
     """)
     assert rule_ids(rep) == ["JL007"]
+
+
+# ---------------------------------------------------------------------------
+# JL009 lock-order-cycle
+
+
+def test_jl009_flags_ab_ba_inversion_in_one_class():
+    rep = run("""
+        import threading
+        class Pools:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert rule_ids(rep) == ["JL009"]
+    (f,) = rep.unsuppressed
+    # both acquisition paths are named in the one cycle finding
+    assert "Pools.one" in f.message and "Pools.two" in f.message
+
+
+def test_jl009_flags_cycle_through_call_graph_and_global_locks():
+    # the A->B edge only exists through a call: `one` holds _A and calls
+    # a helper that takes _B
+    rep = run("""
+        import threading
+        _A = threading.Lock()
+        _B = threading.Lock()
+        def locked_b():
+            with _B:
+                pass
+        def one():
+            with _A:
+                locked_b()
+        def two():
+            with _B:
+                with _A:
+                    pass
+    """)
+    assert rule_ids(rep) == ["JL009"]
+    assert "one -> " in rep.unsuppressed[0].message
+
+
+def test_jl009_flags_nonreentrant_self_deadlock_via_helper():
+    rep = run("""
+        import threading
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def outer(self):
+                with self._lock:
+                    self._inner()
+            def _inner(self):
+                with self._lock:
+                    pass
+    """)
+    assert rule_ids(rep) == ["JL009"]
+    assert "reacquired" in rep.unsuppressed[0].message
+
+
+def test_jl009_clean_consistent_order_and_rlock_reentry():
+    # one global order (A then B) from two paths is fine; RLock
+    # reacquisition through a helper is legal
+    rep = run("""
+        import threading
+        class Pools:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._r = threading.RLock()
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def outer(self):
+                with self._r:
+                    self._inner()
+            def _inner(self):
+                with self._r:
+                    pass
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_threadgraph_mutual_recursion_closure_not_truncated():
+    """Regression: all_locks() must not memoize a partial closure
+    computed under the recursion cut — querying f first used to cache
+    g's mid-traversal result {_B}, permanently losing _A and with it
+    the caller-holds-_C -> _A edge."""
+    from paddle_tpu.analysis.core import Module
+    from paddle_tpu.analysis.threadgraph import Program
+
+    src = textwrap.dedent("""
+        import threading
+        _A = threading.Lock()
+        _B = threading.Lock()
+        _C = threading.Lock()
+        def f():
+            with _A:
+                g()
+        def g():
+            with _B:
+                f()
+        def caller():
+            with _C:
+                g()
+    """)
+    prog = Program([Module("m.py", src)])
+    f = next(fi for fi in prog.funcs if fi.name == "f")
+    g = next(fi for fi in prog.funcs if fi.name == "g")
+    # query order is the regression: f's traversal computes g partially
+    assert set(prog.all_locks(f)) == {"m._A", "m._B"}
+    assert set(prog.all_locks(g)) == {"m._A", "m._B"}
+    assert ("m._C", "m._A") in prog.lock_edges()
+
+
+# ---------------------------------------------------------------------------
+# JL010 cross-thread-shared-state
+
+
+def test_jl010_flags_thread_target_vs_caller_write():
+    rep = run("""
+        import threading
+        class Layer:
+            def __init__(self):
+                self._array = None
+                self._thread = threading.Thread(target=self._trace_loop)
+            def _trace_loop(self):
+                saved = self._array
+                self._array = saved
+            def swap(self, arr):
+                prev = self._array
+                self._array = arr
+                return prev
+    """)
+    assert rule_ids(rep) == ["JL010"]
+    assert "Layer._array" in rep.unsuppressed[0].message
+
+
+def test_jl010_flags_executor_root_and_mutator_write():
+    # run_in_executor roots a method; a .append() outside any common
+    # guard races the locked reader
+    rep = run("""
+        import threading
+        class Feed:
+            def __init__(self, loop):
+                self.rows = []
+                self._lock = threading.Lock()
+                loop.run_in_executor(None, self._produce)
+            def _produce(self):
+                self.rows.append(1)
+            def snapshot_rows(self):
+                with self._lock:
+                    return list(self.rows)
+    """)
+    assert rule_ids(rep) == ["JL010"]
+
+
+def test_jl010_clean_common_lock_everywhere():
+    rep = run("""
+        import threading
+        class Feed:
+            def __init__(self, loop):
+                self.rows = []
+                self._lock = threading.Lock()
+                loop.run_in_executor(None, self._produce)
+            def _produce(self):
+                with self._lock:
+                    self.rows.append(1)
+            def snapshot_rows(self):
+                with self._lock:
+                    return list(self.rows)
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_jl010_clean_threadsafe_types_and_init_only_writes():
+    # queue.Queue attrs are thread-safe by construction; a field written
+    # only in __init__ and read everywhere is configuration, not a race
+    rep = run("""
+        import queue
+        import threading
+        class Pump:
+            def __init__(self):
+                self.cmds = queue.Queue()
+                self.limit = 8
+                self._thread = threading.Thread(target=self._loop)
+            def _loop(self):
+                while True:
+                    item = self.cmds.get()
+                    if item > self.limit:
+                        return
+            def push(self, item):
+                self.cmds.put(item)
+    """)
+    assert rule_ids(rep) == []
+
+
+def test_jl010_stored_callback_roots_cross_class():
+    """The supervisor/watchdog shape: a method reference passed into
+    another class's callback slot runs on THAT class's thread — writes
+    it makes race the owning class's caller-thread readers."""
+    rep = run("""
+        import threading
+        class Watchdog:
+            def __init__(self, on_trip):
+                self.on_trip = on_trip
+                self._thread = threading.Thread(target=self._run)
+            def _run(self):
+                self.on_trip(1.0)
+        class Engine:
+            def __init__(self):
+                self.tripped_at = None
+                self._dog = Watchdog(on_trip=self._on_trip)
+            def _on_trip(self, t):
+                self.tripped_at = t
+            def status(self):
+                return self.tripped_at
+    """)
+    assert rule_ids(rep) == ["JL010"]
+    assert "Engine.tripped_at" in rep.unsuppressed[0].message
+
+
+# ---------------------------------------------------------------------------
+# JL011 event-loop-blocking (reachability; direct calls are JL007)
+
+
+def test_jl011_flags_blocking_call_one_frame_below_async():
+    rep = run("""
+        import time
+        def helper(x):
+            time.sleep(0.1)
+            return x
+        async def handler(req):
+            return helper(req)
+    """)
+    assert rule_ids(rep) == ["JL011"]
+    assert "handler' -> helper" in rep.unsuppressed[0].message
+
+
+def test_jl011_flags_typed_blocking_attr_in_sync_method_chain():
+    rep = run("""
+        import queue
+        class Frontend:
+            def __init__(self):
+                self._cmds = queue.Queue(8)
+            def _drain(self):
+                return self._cmds.get(timeout=1.0)
+            def _tick(self):
+                return self._drain()
+            async def poll(self):
+                return self._tick()
+    """)
+    assert rule_ids(rep) == ["JL011"]
+
+
+def test_jl011_clean_offloaded_and_sync_only_helpers():
+    # handing the helper to to_thread/run_in_executor moves it OFF the
+    # loop; a blocking helper never called from async code is fine; an
+    # async callee is its own rule's problem (no double report)
+    rep = run("""
+        import asyncio
+        import time
+        def helper():
+            time.sleep(0.1)
+        async def offloaded(loop):
+            await asyncio.to_thread(helper)
+            await loop.run_in_executor(None, helper)
+        def sync_caller():
+            return helper()
+    """)
+    assert rule_ids(rep) == []
 
 
 # ---------------------------------------------------------------------------

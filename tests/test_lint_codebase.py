@@ -84,6 +84,54 @@ class Tracer:
     def chrome_trace(self):
         return list(self.events)
 """,
+        # the deadlock class JL009 exists for: an AB/BA lock-order
+        # inversion between two subsystems (hand-built seed — the tree
+        # itself must stay cycle-free)
+        "JL009": """
+import threading
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families = threading.Lock()
+    def finalize(self):
+        with self._lock:
+            with self._families:
+                pass
+    def scrape(self):
+        with self._families:
+            with self._lock:
+                pass
+""",
+        # PR 13 functional_call race shape: one thread swaps the shared
+        # layer's arrays while another reads them, no common guard
+        "JL010": """
+import threading
+class SwappedLayer:
+    def __init__(self):
+        self._array = None
+        self._thread = threading.Thread(target=self._trace_loop)
+    def _trace_loop(self):
+        saved = self._array
+        self._array = saved
+    def swap_state(self, arr):
+        prev = self._array
+        self._array = arr
+        return prev
+""",
+        # the JL007 blind spot JL011 closes: the blocking join is one
+        # helper below the async def
+        "JL011": """
+import threading
+class Frontend:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop)
+    def _loop(self):
+        pass
+    def _join_engine(self):
+        self._thread.join(timeout=5.0)
+    async def shutdown(self):
+        self._join_engine()
+""",
     }
     for rule_id, src in seeded.items():
         rep = lint_source(src, path=f"seeded_{rule_id}.py")

@@ -1,0 +1,344 @@
+"""Runtime lock-order witness (analysis/witness.py): unit mechanics plus
+THE tier-1 end-to-end check — a witnessed chaos serve must be
+acquisition-order-acyclic, the observed graph must be covered by the
+static JL009 model (observed-but-unmodeled edges are a parser-gap
+canary, the hlolint discipline), and a witnessed serve must be
+token-identical to an unwitnessed one.
+
+The full chaos/router-chaos suites run witnessed when
+``PADDLE_TPU_LOCK_WITNESS=1`` (module fixtures there); this file keeps a
+compact always-on variant inside the tier-1 budget.
+"""
+import asyncio
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import witness
+from paddle_tpu.analysis.witness import LockOrderViolation
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _always_uninstall():
+    yield
+    witness.uninstall()
+
+
+def _install_here():
+    """Witness locks constructed from THIS file (the default filter only
+    wraps paddle_tpu construction sites)."""
+    return witness.install(package_root=TESTS_DIR)
+
+
+# -- unit: bookkeeping --------------------------------------------------------
+
+
+def test_held_set_bookkeeping_and_consistent_order_is_clean():
+    w = _install_here()
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        assert len(w.held_now()) == 1
+        with b:
+            assert len(w.held_now()) == 2
+    assert w.held_now() == []
+    with a:
+        with b:
+            pass
+    w.check_acyclic()   # A->B twice: one edge, no cycle
+    g = w.observed_graph()
+    assert len(g["nodes"]) == 2
+    assert len(g["edges"]) == 1
+    assert g["edges"][0]["count"] == 2
+
+
+def test_rlock_reentrancy_records_no_self_edge():
+    w = _install_here()
+    r = threading.RLock()
+    with r:
+        with r:
+            assert len(w.held_now()) == 2
+        assert len(w.held_now()) == 1
+    assert w.held_now() == []
+    assert w.observed_graph()["edges"] == []
+    w.check_acyclic()
+
+
+def test_ab_ba_cycle_detected_naming_both_sites():
+    w = _install_here()
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    with pytest.raises(LockOrderViolation) as ei:
+        w.check_acyclic()
+    msg = str(ei.value)
+    # both acquisition paths named, with this file's sites and stacks
+    assert msg.count("test_lock_witness.py") >= 4
+    assert "acquisition stack" in msg
+
+
+def test_three_lock_cycle_detected():
+    w = _install_here()
+    # three distinct construction SITES: the node identity is the ctor
+    # site, so a comprehension would fold all three into one node
+    locks = [threading.Lock(),
+             threading.Lock(),
+             threading.Lock()]
+    for i in range(3):
+        with locks[i]:
+            with locks[(i + 1) % 3]:
+                pass
+    with pytest.raises(LockOrderViolation):
+        w.check_acyclic()
+
+
+def test_cross_thread_union_graph_catches_split_cycle():
+    """Each thread's own order is locally consistent; the cycle only
+    exists in the UNION graph — exactly the deadlock shape."""
+    w = _install_here()
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    with pytest.raises(LockOrderViolation):
+        w.check_acyclic()
+
+
+# -- unit: gating + identity --------------------------------------------------
+
+
+def test_disabled_is_byte_identical_factories():
+    """Without install, the factories are the stdlib originals; install
+    patches, uninstall restores — and locks built while uninstalled are
+    raw (no wrapper in the acquire path at all)."""
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    assert witness.active() is None
+    w = _install_here()
+    assert getattr(threading.Lock, "__self__", None) is w
+    witness.uninstall()
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+    assert type(threading.Lock()) is type(orig_lock())
+
+
+def test_site_filter_leaves_foreign_locks_raw():
+    """Locks constructed outside the package root (stdlib: queue.Queue's
+    mutex) stay raw — the witness never taxes or renames them."""
+    import queue
+
+    w = witness.install()   # real package root: tests/ is outside it
+    q = queue.Queue()
+    mine = threading.Lock()
+    assert not isinstance(q.mutex, witness._WitnessedLock)
+    assert not isinstance(mine, witness._WitnessedLock)
+    assert w.observed_graph()["nodes"] == []
+
+
+def test_env_gate():
+    try:
+        for v, want in (("", False), ("0", False), ("off", False),
+                        ("1", True), ("true", True)):
+            os.environ["PADDLE_TPU_LOCK_WITNESS"] = v
+            assert witness.enabled_from_env() is want
+    finally:
+        # a mid-loop assertion must not leak the gate into the rest of
+        # the session (it would silently witness every later chaos run)
+        os.environ.pop("PADDLE_TPU_LOCK_WITNESS", None)
+
+
+def test_nested_install_keeps_outer_witness_alive():
+    """An inner install/uninstall pair (witnessed() inside an already-
+    witnessed module) must not tear down the outer witness, and a
+    nested install with a conflicting filter must raise instead of
+    silently mis-attributing."""
+    outer = _install_here()
+    with witness.witnessed() as inner:
+        assert inner is outer
+    assert witness.active() is outer          # outer survives the pair
+    lock = threading.Lock()
+    assert isinstance(lock, witness._WitnessedLock)
+    with pytest.raises(RuntimeError):
+        witness.install(package_root="/somewhere/else")
+    witness.uninstall()
+    assert witness.active() is None
+
+
+def test_overhead_bound():
+    """The wrapper must stay cheap enough for witnessed chaos runs to
+    fit the tier-1 margin: 20k uncontended acquire/release pairs well
+    under a (very generous) wall bound."""
+    _install_here()
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+    for _ in range(20000):
+        with lock:
+            pass
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"witnessed acquire overhead too high: {elapsed:.3f}s"
+
+
+# -- observed-vs-static cross-check ------------------------------------------
+
+
+def test_cross_check_flags_unmodeled_edge_and_lock():
+    """The parser-gap canary mechanics: an observed edge the static
+    JL009 graph does not model (here: a REVERSED ledger->metrics edge,
+    and a lock constructed at an unmodeled site) must come back as
+    gaps."""
+    from paddle_tpu.analysis.core import Module, iter_python_files
+    from paddle_tpu.analysis.threadgraph import Program
+
+    pkg = os.path.join(os.path.dirname(TESTS_DIR), "paddle_tpu")
+    mods = []
+    for p in iter_python_files([pkg]):
+        try:
+            with open(p, encoding="utf-8") as f:
+                mods.append(Module(p, f.read(),
+                                   display_path=os.path.relpath(
+                                       p, os.path.dirname(pkg))))
+        except (OSError, SyntaxError, ValueError):
+            continue
+    prog = Program(mods)
+    nodes = prog.lock_nodes()
+    slo_site = nodes["SLOLedger._lock"]["sites"][0]
+    met_site = nodes["ServingMetrics._families_lock"]["sites"][0]
+    to_abs = lambda s: (os.path.join(os.path.dirname(pkg), s[0]), s[1])  # noqa: E731
+
+    w = witness.Witness()
+    # one cross_check call (it reparses the tree, ~3s) covering all
+    # three behaviors: the modeled direction produces NO gap, the
+    # reversed edge is an unmodeled-edge gap, and a construction site
+    # the parser never saw is an unmodeled-lock gap
+    w.nodes[to_abs(slo_site)] = "Lock"
+    w.nodes[to_abs(met_site)] = "Lock"
+    fake = (os.path.join(pkg, "serving", "engine.py"), 99999)
+    w.nodes[fake] = "Lock"
+    w.edges[(to_abs(slo_site), to_abs(met_site))] = witness._Edge(
+        to_abs(slo_site), to_abs(met_site), ("x", 1), ("y", 2), "")
+    w.edges[(to_abs(met_site), to_abs(slo_site))] = witness._Edge(
+        to_abs(met_site), to_abs(slo_site), ("x", 1), ("y", 2), "")
+    gaps = witness.cross_check(w)
+    assert len(gaps) == 2, gaps
+    assert any("unmodeled lock" in g and "engine.py:99999" in g
+               for g in gaps)
+    assert any("observed-but-unmodeled edge" in g
+               and "ServingMetrics._families_lock -> SLOLedger._lock"
+               in g for g in gaps)
+
+
+# -- end-to-end: witnessed chaos serve ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=64, attn_impl="xla",
+                    dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(lengths, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, (n,)).tolist() for n in lengths]
+
+
+def test_witnessed_chaos_serve_acyclic_covered_and_token_identical(model):
+    """THE acceptance path in one compact serve: poison isolation +
+    watchdog-armed engine with SLO ledger, tracer, and a mid-serve
+    scrape from the loop thread, all under the witness. The observed
+    graph must be acyclic, non-trivial (the ledger->metrics edge fires),
+    fully covered by the static JL009 model, and the tokens must match
+    an unwitnessed reference serve."""
+    from paddle_tpu.serving import AsyncLLMEngine, LLMEngine, faults
+    from paddle_tpu.serving.faults import FaultPlan
+
+    prompts = _prompts((5, 9, 13), seed=7)
+
+    def build():
+        return LLMEngine(model, block_size=8, max_batch=4, max_seq_len=64,
+                         trace=True, slo=True)
+
+    # unwitnessed reference first (fixture uninstalls between tests)
+    ref = build().generate(prompts, max_new_tokens=6, temperature=0.0)
+
+    w = witness.install()
+    try:
+        faults.install(FaultPlan([
+            {"point": "step_raise", "request_id": "poison",
+             "exc": "DeviceBoom"},
+        ]))
+        engine = build()
+
+        async def main():
+            fe = await AsyncLLMEngine(
+                engine, max_waiting=8,
+                watchdog_step_timeout_s=30.0).start()
+            streams = []
+            for i, p in enumerate(prompts):
+                rid = "poison" if i == 1 else f"r{i}"
+                streams.append(fe.submit(
+                    p, max_new_tokens=6, temperature=0.0, request_id=rid,
+                    tenant="t0"))
+            # mid-serve scrape from the LOOP thread: trace export + SLO
+            # rollup both take their locks concurrently with the engine
+            await asyncio.sleep(0.02)
+            engine.tracer.chrome_trace()
+            engine.slo.rollup()
+            results = await asyncio.wait_for(
+                asyncio.gather(*(s.collect() for s in streams)), 30.0)
+            await fe.shutdown(drain=True, timeout_s=10.0)
+            return results
+
+        results = asyncio.run(main())
+    finally:
+        plan = faults.active()
+        if plan is not None:
+            plan.release_hangs()
+        faults.clear()
+        witness.uninstall()
+
+    toks, reasons = zip(*results)
+    assert reasons[1] == "error"                  # poison isolated
+    assert list(toks[0]) == ref[0]                # innocents identical to
+    assert list(toks[2]) == ref[2]                # the unwitnessed serve
+    w.check_acyclic()
+    g = w.observed_graph()
+    assert g["nodes"], "no paddle_tpu lock was witnessed"
+    assert any(e["held_ctor"].endswith("slo.py:122") or
+               "slo.py" in e["held_ctor"] and "metrics.py" in
+               e["acquired_ctor"] for e in g["edges"]), (
+        "expected the SLOLedger->ServingMetrics edge in the observed "
+        "graph", g["edges"])
+    gaps = witness.cross_check(w)
+    assert gaps == [], "\n".join(gaps)

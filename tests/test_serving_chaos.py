@@ -19,6 +19,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu.analysis import witness as lock_witness
 from paddle_tpu.models.gpt import GPT, GPTConfig
 from paddle_tpu.serving import (
     AsyncLLMEngine,
@@ -30,6 +31,26 @@ from paddle_tpu.serving import (
     faults,
 )
 from paddle_tpu.serving.faults import FaultPlan
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_witness():
+    """PADDLE_TPU_LOCK_WITNESS=1: run this whole chaos module with the
+    lock-order witness installed and assert the union acquisition-order
+    graph is acyclic at teardown (tests/test_lock_witness.py carries the
+    always-on tier-1 variant, so the default run stays unwitnessed and
+    byte-identical)."""
+    if not lock_witness.enabled_from_env():
+        yield None
+        return
+    w = lock_witness.install()
+    try:
+        yield w
+    finally:
+        lock_witness.uninstall()
+    w.check_acyclic()
+    gaps = lock_witness.cross_check(w)
+    assert gaps == [], "\n".join(gaps)
 
 
 @pytest.fixture(scope="module")

@@ -18,6 +18,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu.analysis import witness as lock_witness
 from paddle_tpu.models.gpt import GPT, GPTConfig
 from paddle_tpu.serving import (
     AsyncLLMEngine,
@@ -26,6 +27,24 @@ from paddle_tpu.serving import (
     faults,
 )
 from paddle_tpu.serving.faults import FaultPlan
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_witness():
+    """PADDLE_TPU_LOCK_WITNESS=1: witness every lock the fleet builds in
+    this module and assert acquisition-order acyclicity + static-model
+    coverage at teardown (see tests/test_serving_chaos.py twin)."""
+    if not lock_witness.enabled_from_env():
+        yield None
+        return
+    w = lock_witness.install()
+    try:
+        yield w
+    finally:
+        lock_witness.uninstall()
+    w.check_acyclic()
+    gaps = lock_witness.cross_check(w)
+    assert gaps == [], "\n".join(gaps)
 
 
 @pytest.fixture(scope="module")
@@ -72,13 +91,28 @@ def _homed_prompt(router, home, seed0, n=12):
 
 
 def test_replica_thread_die_mid_wave(model, ref_engine):
-    """Kill one of 3 replicas mid-wave (thread_die, times=1): the dead
-    replica's running requests fail with exactly one structured error
-    each, its queued zero-token requests replay elsewhere and complete
-    token-identical, everyone else is untouched, and the replica is
-    ejected."""
+    """Kill one of 3 replicas mid-wave: the dead replica's running
+    requests fail with exactly one structured error each, its queued
+    zero-token requests replay elsewhere and complete token-identical,
+    everyone else is untouched, and the replica is ejected.
+
+    The kill is PINNED to one replica (its supervisor's next step
+    raises, escaping the engine loop — the exact thread_die/crash-
+    epilogue path) and gated on THAT replica's engine-side state: the
+    old global thread_die(times=1) raced cross-replica skew — the gate
+    waited for the slowest replica while the eventual victim ran 24+
+    steps ahead, finished its first pair, and deleted the zero-token
+    replay (or the mid-stream victims) the test exists to exercise.
+    Death lands before the victim's next step, so its running pair can
+    never retire and its queued pair can never start — both outcome
+    classes are guaranteed whatever the host scheduler does."""
     async def main():
-        router = ReplicaRouter([_replica(model) for _ in range(3)],
+        replicas = [_replica(model) for _ in range(3)]
+        # warm every replica BEFORE the wave (the watchdog-test idiom):
+        # first-step XLA compile is a slow step that widens skew
+        for r in replicas:
+            r.engine.generate([[0]], max_new_tokens=2, temperature=0.0)
+        router = ReplicaRouter(replicas,
                                sweep_interval_s=0.02,
                                probe_interval_s=60.0)
         await router.start()
@@ -99,19 +133,31 @@ def test_replica_thread_die_mid_wave(model, ref_engine):
                                    temperature=0.0)
         streams = [await router.submit(p, max_new_tokens=24,
                                        temperature=0.0) for p in prompts]
+        victim = next(r for r in router.replicas if r.name == "r1")
+        victim_streams = [s for s in streams if s.replica == "r1"]
 
-        def per_replica_started():
-            counts = {}
-            for s in streams:
-                if s.n_tokens >= 1:
-                    counts[s.replica] = counts.get(s.replica, 0) + 1
-            return all(counts.get(f"r{i}", 0) >= 2 for i in range(3))
+        def victim_arranged():
+            # ENGINE-side truth only (output_ids grows on the engine
+            # thread): two rows emitting, two still at zero — the
+            # loop-side token counts lag dispatch and raced under load
+            started = sum(1 for s in victim_streams
+                          if len(s.req.output_ids) >= 1)
+            zero = sum(1 for s in victim_streams
+                       if len(s.req.output_ids) == 0)
+            return started >= 2 and zero >= 2
 
         t0 = time.monotonic()
-        while not per_replica_started():
-            assert time.monotonic() - t0 < 30, "wave never started"
+        while not victim_arranged():
+            assert time.monotonic() - t0 < 30, "victim never arranged"
             await asyncio.sleep(0.005)
-        faults.install(FaultPlan([{"point": "thread_die", "times": 1}]))
+        # pinned kill: the victim's next supervised step raises OUTSIDE
+        # the supervisor's own isolation (frontend calls sup.step()
+        # un-tried), escaping _run_engine_loop into the crash epilogue —
+        # the same path the global thread_die fault takes
+        def die():
+            raise faults.FaultInjected("thread_die (pinned to r1)")
+
+        victim.engine._sup.step = die
         results = await asyncio.wait_for(
             asyncio.gather(*[s.collect() for s in streams]), 60.0)
         dead = [r for r in router.replicas
