@@ -644,6 +644,359 @@ def bench_gpt_serve_router(on_tpu, errors, deadline_s):
         _log(f"router serve: affinity {aff['tok_s']} tok/s "
              f"(hit {aff['hit_rate_by_class']}) vs no-affinity "
              f"{noaff['tok_s']} tok/s (hit {noaff['hit_rate_by_class']})")
+    # host-tier measurements ride the same JSON line: the over-capacity
+    # distinct-prefix wave (host hit rate must beat device-only at
+    # neutral step latency) and the zero-rewarm rolling drain (post-drain
+    # hit rate with vs without migration, zero failed requests)
+    oc = _kvtier_overcap_wave(model, cfg, rs, errors, deadline_s)
+    if oc:
+        out["kvtier_overcap"] = oc
+    dr = _kvtier_drain_wave(model, cfg, rs, errors, deadline_s)
+    if dr:
+        out["kvtier_drain"] = dr
+    return out
+
+
+def _hit_rates(engines):
+    """(hit_tokens, lookup_tokens, swap_in_hit_tokens) summed across
+    engines — prefix_cache_hit_tokens already includes host-tier
+    swap-backs (scheduler._swap_in charges them like device hits)."""
+    hit = lookup = swap = 0
+    for eng in engines:
+        c = eng.metrics.counters
+        hit += c.get("prefix_cache_hit_tokens", 0)
+        lookup += c.get("prefix_cache_lookup_tokens", 0)
+        swap += c.get("swap_in_hit_tokens", 0)
+    return hit, lookup, swap
+
+
+def _kvtier_overcap_wave(model, cfg, rs, errors, deadline_s):
+    """Many-distinct-prefixes wave OVER device-cache capacity, served
+    with the host tier on vs off through otherwise-identical engines.
+    Round 1 publishes every prefix (early ones are LRU-evicted — demoted
+    to host when the tier is on); round 2 re-serves them in the same
+    order, so the device-only engine recomputes what the tiered engine
+    swaps back in. Reports both hit rates (the tiered one must be
+    strictly higher) and the p95 step latency ratio (the tier must be
+    off the critical path: within +10%)."""
+    from paddle_tpu.serving import LLMEngine
+
+    if time.monotonic() > deadline_s:
+        errors.append("gpt_serve_router: deadline before kvtier "
+                      "over-capacity wave")
+        return None
+    bs, num_blocks, gen = 16, 40, 8
+    n_prefix, plen = 10, 64
+    prefixes = [rs.randint(0, cfg.vocab_size, (plen,)).tolist()
+                for _ in range(n_prefix)]
+    tails = [rs.randint(0, cfg.vocab_size, (8,)).tolist()
+             for _ in range(n_prefix)]
+
+    def wave(host_blocks):
+        eng = LLMEngine(model, block_size=bs, max_batch=4,
+                        num_blocks=num_blocks, host_kv_blocks=host_blocks)
+        eng.generate([rs.randint(0, cfg.vocab_size, (8,)).tolist()],
+                     max_new_tokens=2, temperature=0.0)       # prime
+        for p in prefixes:                                    # round 1
+            eng.generate([p], max_new_tokens=2, temperature=0.0)
+        base = _hit_rates([eng])
+        for p, t in zip(prefixes, tails):                     # round 2
+            eng.add_request(p + t, max_new_tokens=gen, temperature=0.0)
+        steps, t0 = [], time.perf_counter()
+        while eng.has_unfinished():
+            if time.monotonic() > deadline_s:
+                errors.append("gpt_serve_router: deadline mid kvtier "
+                              "over-capacity wave; comparison dropped")
+                for rid in list(eng._requests):
+                    eng.abort(rid)
+                return None
+            s0 = time.perf_counter()
+            eng.step()
+            steps.append(time.perf_counter() - s0)
+        dt = time.perf_counter() - t0
+        hit, lookup, swap = (a - b for a, b in
+                             zip(_hit_rates([eng]), base))
+        eng.close()
+        return {
+            "hit_rate": round(hit / lookup, 4) if lookup else 0.0,
+            "swap_in_hit_tokens": swap,
+            "p95_step_ms": round(
+                sorted(steps)[int(0.95 * (len(steps) - 1))] * 1e3, 2),
+            "tok_s": round(n_prefix * gen / dt, 1) if dt else 0.0,
+        }
+
+    tiered = wave(host_blocks=128)
+    if tiered is None or time.monotonic() > deadline_s:
+        return None
+    device_only = wave(host_blocks=None)
+    if device_only is None:
+        return None
+    out = {
+        "distinct_prefixes": n_prefix,
+        "device_blocks": num_blocks - 1,
+        "tiered": tiered,
+        "device_only": device_only,
+        "hit_rate_gain": round(
+            tiered["hit_rate"] - device_only["hit_rate"], 4),
+        "p95_step_ratio": round(
+            tiered["p95_step_ms"] / device_only["p95_step_ms"], 3)
+        if device_only["p95_step_ms"] else 0.0,
+    }
+    if tiered["hit_rate"] <= device_only["hit_rate"]:
+        errors.append(
+            f"gpt_serve_router: kvtier over-capacity hit rate "
+            f"{tiered['hit_rate']} not above device-only "
+            f"{device_only['hit_rate']}")
+    if out["p95_step_ratio"] > 1.10:
+        errors.append(
+            f"gpt_serve_router: kvtier p95 step latency ratio "
+            f"{out['p95_step_ratio']} exceeds 1.10 — the host tier is "
+            "on the decode critical path")
+    _log(f"kvtier overcap: hit {tiered['hit_rate']} (tiered) vs "
+         f"{device_only['hit_rate']} (device-only), p95 ratio "
+         f"{out['p95_step_ratio']}")
+    return out
+
+
+def _kvtier_drain_wave(model, cfg, rs, errors, deadline_s):
+    """Zero-rewarm rolling drain: a 2-replica fleet with a restart
+    factory serves a warm shared-prefix wave, rolls every replica, and
+    re-serves — once WITH cross-replica migration and once WITHOUT. With
+    migration the post-drain hit rate must hold at (or above) the
+    pre-drain warm rate and no request may fail; without it the fresh
+    engines start cache-cold."""
+    import asyncio
+
+    from paddle_tpu.serving import AsyncLLMEngine, LLMEngine, ReplicaRouter
+
+    if time.monotonic() > deadline_s:
+        errors.append("gpt_serve_router: deadline before kvtier "
+                      "drain wave")
+        return None
+    gen = 4
+    shared = [rs.randint(0, cfg.vocab_size, (64,)).tolist()
+              for _ in range(3)]
+    prompts = [s + rs.randint(0, cfg.vocab_size, (8,)).tolist()
+               for s in shared for _ in range(2)]
+
+    def mk(_i=0):
+        return AsyncLLMEngine(LLMEngine(model, block_size=16, max_batch=4,
+                                        host_kv_blocks=128))
+
+    async def run(migrate):
+        router = ReplicaRouter([mk() for _ in range(2)], factory=mk,
+                               migrate_on_drain=migrate,
+                               sweep_interval_s=0.05)
+        await router.start()
+        engines = lambda: [r.engine.engine for r in router.replicas]  # noqa: E731
+
+        async def serve():
+            base = _hit_rates(engines())
+            streams = [await router.submit(p, max_new_tokens=gen,
+                                           temperature=0.0)
+                       for p in prompts]
+            outs = [await s.collect() for s in streams]
+            hit, lookup, _ = (a - b for a, b in
+                              zip(_hit_rates(engines()), base))
+            failed = sum(1 for _, r in outs if r not in ("length", "stop"))
+            return (round(hit / lookup, 4) if lookup else 0.0), failed
+
+        await serve()                                  # publish + compile
+        warm_rate, _ = await serve()                   # pre-drain warm
+        await router.rolling_drain()
+        post_rate, failed = await serve()              # post-drain
+        migrated = router.metrics.counters.get("router_migrated_blocks", 0)
+        await router.shutdown()
+        return {"warm_hit_rate": warm_rate, "post_drain_hit_rate": post_rate,
+                "failed": failed, "migrated_blocks": migrated}
+
+    try:
+        with_mig = asyncio.run(run(True))
+        if time.monotonic() > deadline_s:
+            errors.append("gpt_serve_router: deadline before no-migration "
+                          "drain wave; comparison dropped")
+            return {"with_migration": with_mig}
+        without = asyncio.run(run(False))
+    except Exception as e:  # noqa: BLE001 — the router waves already landed
+        errors.append(f"gpt_serve_router kvtier drain: "
+                      f"{type(e).__name__}: {str(e)[:200]}")
+        return None
+    out = {"with_migration": with_mig, "without_migration": without,
+           "zero_rewarm": with_mig["post_drain_hit_rate"]
+           >= with_mig["warm_hit_rate"]}
+    if with_mig["failed"] or without["failed"]:
+        errors.append(f"gpt_serve_router: kvtier drain failed requests "
+                      f"(with={with_mig['failed']}, "
+                      f"without={without['failed']})")
+    if with_mig["post_drain_hit_rate"] < with_mig["warm_hit_rate"]:
+        errors.append(
+            f"gpt_serve_router: post-drain hit rate "
+            f"{with_mig['post_drain_hit_rate']} below pre-drain warm "
+            f"rate {with_mig['warm_hit_rate']} despite migration")
+    if with_mig["post_drain_hit_rate"] <= without["post_drain_hit_rate"]:
+        errors.append(
+            f"gpt_serve_router: migration post-drain hit rate "
+            f"{with_mig['post_drain_hit_rate']} not above no-migration "
+            f"{without['post_drain_hit_rate']}")
+    _log(f"kvtier drain: post-drain hit {with_mig['post_drain_hit_rate']} "
+         f"(migration, {with_mig['migrated_blocks']} blocks) vs "
+         f"{without['post_drain_hit_rate']} (cold restart)")
+    return out
+
+
+def _bench_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=256, attn_impl="xla")
+    model = GPT(cfg)
+    model.eval()
+    return model, cfg
+
+
+def bench_gpt_serve_longdoc_qa(on_tpu, errors, deadline_s):
+    """Long-document QA over a shared corpus (the host-tier headline
+    workload): a corpus of document prefixes larger than the device
+    cache, each asked several questions with OTHER documents' questions
+    interleaved between them — so by the time a document's next question
+    arrives, its blocks have been LRU-evicted from the device arena.
+    Served tiered vs device-only: the tier turns every re-visit into a
+    swap-back instead of a full-document re-prefill."""
+    from paddle_tpu.serving import LLMEngine
+
+    del on_tpu
+    model, cfg = _bench_model()
+    rs = np.random.RandomState(0)
+    bs, num_blocks, gen = 16, 28, 8
+    n_docs, doc_len, n_q = (6, 96, 2) if _fast() else (8, 96, 3)
+    docs = [rs.randint(0, cfg.vocab_size, (doc_len,)).tolist()
+            for _ in range(n_docs)]
+    # round-robin across documents: consecutive questions about one doc
+    # never run back-to-back (the interleaving that defeats device LRU)
+    qa = [(d, docs[d] + rs.randint(0, cfg.vocab_size, (8,)).tolist())
+          for q in range(n_q) for d in range(n_docs)]
+
+    def wave(host_blocks):
+        eng = LLMEngine(model, block_size=bs, max_batch=2,
+                        num_blocks=num_blocks, host_kv_blocks=host_blocks)
+        eng.generate([docs[0]], max_new_tokens=2, temperature=0.0)  # prime
+        base = _hit_rates([eng])
+        t0 = time.perf_counter()
+        for i in range(0, len(qa), 2):
+            if time.monotonic() > deadline_s:
+                errors.append("gpt_serve_longdoc_qa: deadline mid wave")
+                return None
+            eng.generate([p for _, p in qa[i:i + 2]],
+                         max_new_tokens=gen, temperature=0.0)
+        dt = time.perf_counter() - t0
+        hit, lookup, swap = (a - b for a, b in
+                             zip(_hit_rates([eng]), base))
+        eng.close()
+        return {
+            "tok_s": round(len(qa) * gen / dt, 1) if dt else 0.0,
+            "hit_rate": round(hit / lookup, 4) if lookup else 0.0,
+            "swap_in_hit_tokens": swap,
+        }
+
+    tiered = wave(host_blocks=192)
+    if tiered is None or time.monotonic() > deadline_s:
+        return None
+    device_only = wave(host_blocks=None)
+    if device_only is None:
+        return None
+    out = {
+        "value": tiered["tok_s"],
+        "documents": n_docs, "doc_tokens": doc_len,
+        "questions_per_doc": n_q,
+        "device_blocks": num_blocks - 1,
+        "tiered": tiered, "device_only": device_only,
+        "hit_rate_gain": round(
+            tiered["hit_rate"] - device_only["hit_rate"], 4),
+        "speedup": round(tiered["tok_s"] / device_only["tok_s"], 3)
+        if device_only["tok_s"] else 0.0,
+    }
+    if tiered["hit_rate"] <= device_only["hit_rate"]:
+        errors.append(
+            f"gpt_serve_longdoc_qa: tiered hit rate {tiered['hit_rate']} "
+            f"not above device-only {device_only['hit_rate']}")
+    _log(f"longdoc qa: {tiered['tok_s']} tok/s hit {tiered['hit_rate']} "
+         f"(tiered) vs {device_only['tok_s']} tok/s hit "
+         f"{device_only['hit_rate']} (device-only)")
+    return out
+
+
+def bench_gpt_serve_nbest(on_tpu, errors, deadline_s):
+    """N-best parallel sampling over a prompt corpus: each round samples
+    n completions of ONE prompt (the samples share every prompt block;
+    their divergent tails copy-on-write off the shared last block), and
+    rounds cycle through more prompts than the device cache holds — the
+    host tier keeps every prompt's prefix warm between its rounds.
+    Tiered vs device-only tok/s + hit rate, plus the COW copy count
+    (the sharing proof)."""
+    from paddle_tpu.serving import LLMEngine
+
+    del on_tpu
+    model, cfg = _bench_model()
+    rs = np.random.RandomState(1)
+    bs, num_blocks, gen, n_best = 16, 40, 8, 4
+    n_prompts, plen, rounds = (6, 64, 2) if _fast() else (8, 64, 2)
+    corpus = [rs.randint(0, cfg.vocab_size, (plen,)).tolist()
+              for _ in range(n_prompts)]
+
+    def wave(host_blocks):
+        eng = LLMEngine(model, block_size=bs, max_batch=n_best,
+                        num_blocks=num_blocks, host_kv_blocks=host_blocks)
+        eng.generate([corpus[0]], max_new_tokens=2, temperature=0.0)
+        base = _hit_rates([eng])
+        cow0 = eng.metrics.counters.get("prefix_cache_cow_copies", 0)
+        t0, generated = time.perf_counter(), 0
+        for rnd in range(rounds):
+            for p in corpus:
+                if time.monotonic() > deadline_s:
+                    errors.append("gpt_serve_nbest: deadline mid wave")
+                    return None
+                # n-best: n sampled completions of the same prompt in
+                # one batch (seeded engine sampler -> distinct tails)
+                outs = eng.generate([p] * n_best, max_new_tokens=gen,
+                                    temperature=0.8, top_p=0.95)
+                generated += sum(len(o) for o in outs)
+        dt = time.perf_counter() - t0
+        hit, lookup, swap = (a - b for a, b in
+                             zip(_hit_rates([eng]), base))
+        cow = eng.metrics.counters.get("prefix_cache_cow_copies", 0) - cow0
+        eng.close()
+        return {
+            "tok_s": round(generated / dt, 1) if dt else 0.0,
+            "hit_rate": round(hit / lookup, 4) if lookup else 0.0,
+            "swap_in_hit_tokens": swap,
+            "cow_copies": cow,
+        }
+
+    tiered = wave(host_blocks=192)
+    if tiered is None or time.monotonic() > deadline_s:
+        return None
+    device_only = wave(host_blocks=None)
+    if device_only is None:
+        return None
+    out = {
+        "value": tiered["tok_s"],
+        "prompts": n_prompts, "n_best": n_best, "rounds": rounds,
+        "device_blocks": num_blocks - 1,
+        "tiered": tiered, "device_only": device_only,
+        "hit_rate_gain": round(
+            tiered["hit_rate"] - device_only["hit_rate"], 4),
+        "speedup": round(tiered["tok_s"] / device_only["tok_s"], 3)
+        if device_only["tok_s"] else 0.0,
+    }
+    if tiered["hit_rate"] <= device_only["hit_rate"]:
+        errors.append(
+            f"gpt_serve_nbest: tiered hit rate {tiered['hit_rate']} "
+            f"not above device-only {device_only['hit_rate']}")
+    _log(f"nbest: {tiered['tok_s']} tok/s hit {tiered['hit_rate']} "
+         f"(tiered, {tiered['cow_copies']} cow) vs {device_only['tok_s']} "
+         f"tok/s hit {device_only['hit_rate']} (device-only)")
     return out
 
 
@@ -1028,6 +1381,8 @@ _BENCHES = {
     "gpt_serve": bench_gpt_serve,
     "gpt_serve_multichip": bench_gpt_serve_multichip,
     "gpt_serve_router": bench_gpt_serve_router,
+    "gpt_serve_longdoc_qa": bench_gpt_serve_longdoc_qa,
+    "gpt_serve_nbest": bench_gpt_serve_nbest,
     "resnet50": bench_resnet50,
     "lenet": bench_lenet,
     "ppyoloe": bench_ppyoloe,
@@ -1203,13 +1558,25 @@ def main():
 
     # fleet-router wave: mixed tenants over 2 replicas, affinity vs
     # no-affinity, per-class p95 TTFT / attainment / cache hit rate
-    r = _run_isolated("gpt_serve_router", min(240.0, _remaining()))
+    r = _run_isolated("gpt_serve_router", min(300.0, _remaining()))
     errors.extend(r.get("errors") or [])
     rt = _emit_model("gpt_serve_router", r, "tokens/sec",
                      metric="gpt_serve_router_tokens_per_sec")
     if rt:
         completed += 1
         extras["gpt_serve_router"] = rt
+
+    # host-tier workload scenarios: long-document QA over a shared
+    # corpus, and n-best parallel sampling — both over device capacity,
+    # tiered vs device-only
+    for name in ("gpt_serve_longdoc_qa", "gpt_serve_nbest"):
+        r = _run_isolated(name, min(180.0, _remaining()))
+        errors.extend(r.get("errors") or [])
+        result = _emit_model(name, r, "tokens/sec",
+                             metric=f"{name}_tokens_per_sec")
+        if result:
+            completed += 1
+            extras[name] = result
 
     units = {"resnet50": "samples/sec", "ppyoloe": "ms", "lenet": "ms"}
     for name in ("resnet50", "ppyoloe", "lenet"):

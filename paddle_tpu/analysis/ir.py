@@ -15,8 +15,10 @@ declarative contracts in `contracts.py`.
 The program set (`default_artifacts`): the serving engine's unified
 ragged step program at every width bucket (``w1`` / ``w4`` / ``w8`` on
 the harness config — decode, spec, and chunk widths of ONE kind-free
-program) at tp=1 and tp=2 on the 8-fake-device host mesh, plus the spmd
-train step on a dp2 x mp2 mesh — all on the smallest GPT config that
+program) at tp=1 and tp=2 on the 8-fake-device host mesh, the host-tier
+swap gather/scatter pair at each tp degree (serving/kv_tier.py — the
+swap-in donation and the swap-out no-alias are IR002 facts), plus the
+spmd train step on a dp2 x mp2 mesh — all on the smallest GPT config that
 still exercises tp sharding, so the whole pass lowers + compiles in
 seconds and can gate tier-1 (tests/test_ir_contracts.py).
 
@@ -356,7 +358,8 @@ def build_serving_engine(model, tp_degree):
     from ..serving.engine import LLMEngine
 
     return LLMEngine(model, block_size=8, max_batch=2, prefill_chunk=8,
-                     mesh=tp_degree, spec_decoding=True, num_spec_tokens=3)
+                     mesh=tp_degree, spec_decoding=True, num_spec_tokens=3,
+                     host_kv_blocks=8)
 
 
 def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
@@ -390,6 +393,29 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
                 # boundaries — serving steps only; the train artifact
                 # has no sampler region
                 "sampler_region": True,
+            }
+            arts.append(artifact_from_compiled(
+                f"serve/tp{tp}/{name}", name, tp,
+                jax.default_backend(), lowered.compile(), expected))
+        if kinds is not None:
+            continue   # restricted step subset: skip the swap programs
+        # the host-tier swap copies (serving/kv_tier.py): the swap-in
+        # scatter must donate the arenas under the same gate as the step
+        # program, and the swap-out gather must alias NOTHING (the arena
+        # stays live under it). Chip-local copies — no collective budget.
+        sspec = eng.swap_program_spec()
+        for name, lowered in eng.lowered_swap_programs().items():
+            expected = {
+                "collective_budget": None,
+                "donation": {
+                    "expected": (sspec["donation_expected"]
+                                 and name not in sspec["no_alias"]),
+                    "param_indices": sspec["arena_param_indices"],
+                    "output_indices":
+                        sspec["arena_output_indices"].get(name),
+                    "what": "KV arena (k, v)",
+                },
+                "custom_call_whitelist": DEFAULT_CUSTOM_CALL_WHITELIST,
             }
             arts.append(artifact_from_compiled(
                 f"serve/tp{tp}/{name}", name, tp,

@@ -12,6 +12,12 @@ Automatic prefix caching (ref-counted content-hashed blocks with a
 cached-free LRU tier and copy-on-write) is on by default — shared system
 prompts/few-shot templates skip their prefill on every hit; disable with
 ``PADDLE_TPU_PREFIX_CACHE=0`` or ``LLMEngine(prefix_cache=False)``.
+A host-memory KV tier (serving/kv_tier.py, ``LLMEngine(host_kv_blocks=N)``
+or ``PADDLE_TPU_HOST_KV_BLOCKS=N``) catches cached blocks the device LRU
+evicts, swaps them back on a prefix hit via a donated scatter dispatched
+at plan time, and doubles as the fleet's block-transport substrate for
+zero-rewarm drains and cross-replica migration. See README "Tiered KV
+cache".
 Speculative decoding (serving/spec.py: prompt-lookup n-gram drafting +
 batched parallel verification, no draft model) is OFF by default — enable
 with ``LLMEngine(spec_decoding=True)`` or ``PADDLE_TPU_SPEC_DECODE=1`` to
@@ -88,6 +94,7 @@ from .frontend import (  # noqa: F401
     EngineOverloadedError,
     RequestStream,
 )
+from .kv_tier import KVTier  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .postmortem import FlightRecorder  # noqa: F401
 from .router import (  # noqa: F401

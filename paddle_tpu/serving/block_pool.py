@@ -244,6 +244,14 @@ class BlockPool:
         self.metrics = metrics
         self.tracer = tracer          # serving/trace.py EngineTracer or None
         self._copy_fn = None          # jitted donated block-copy (lazy)
+        self.tier = None              # host-memory tier (serving/kv_tier.py)
+
+    def attach_tier(self, tier):
+        """Install the host-memory tier (serving/kv_tier.py): evicted
+        cached-free blocks demote to host instead of dying, and the
+        scheduler can swap them back on a prefix match. One pointer —
+        None keeps every hook below a single test."""
+        self.tier = tier
 
     @property
     def num_free(self):
@@ -260,6 +268,11 @@ class BlockPool:
     def num_cached_blocks(self):
         """Blocks currently parked in the cached-free tier."""
         return len(self._cached)
+
+    def cached_blocks(self):
+        """``(block, hash)`` pairs parked in the cached-free tier, LRU
+        order — the migration demote walk (engine.export_kv_tier)."""
+        return list(self._cached.items())
 
     def blocks_for(self, num_tokens):
         """How many blocks a sequence of `num_tokens` tokens needs."""
@@ -300,6 +313,12 @@ class BlockPool:
                 b, _ = self._cached.popitem(last=False)  # LRU victim
                 h = self._block_hash.pop(b)
                 del self._hash_index[h]
+                if self.tier is not None:
+                    # demote instead of dying: the tier buffers the (hash,
+                    # block) pair and gathers the bytes at the next
+                    # flush — which every arena-write site runs first, so
+                    # the contents are still valid when the gather reads
+                    self.tier.save(h, b)
                 self.evictions += 1
                 n_evicted += 1
                 if self.metrics is not None:
@@ -381,6 +400,24 @@ class BlockPool:
             out.append(b)
         return out
 
+    def adopt(self, blocks, hashes):
+        """Publish freshly ALLOCATED (held, refcount >= 1) blocks into the
+        content index — the tier's swap-in path: a restored block holds
+        valid full-block KV for ``hashes[i]`` and must be matchable by
+        concurrent admissions exactly like a device-warm block. A hash
+        already served by another block is skipped (the block stays held
+        and correct, just unpublished) so the index/inverse invariant
+        can never break."""
+        for b, h in zip(blocks, hashes):
+            b = int(b)
+            if self._hash_index.get(h) is not None:
+                continue
+            old = self._block_hash.get(b)
+            if old is not None:
+                del self._hash_index[old]
+            self._hash_index[h] = b
+            self._block_hash[b] = h
+
     def copy_blocks(self, src, dst):
         """Device-side block copy (the copy-on-write path: a sequence about
         to append into a block shared with other holders first duplicates
@@ -392,6 +429,10 @@ class BlockPool:
         import jax
         import jax.numpy as jnp
 
+        if self.tier is not None:
+            # arena-write ordering: buffered demotions must gather their
+            # (still-valid) bytes before this scatter lands on them
+            self.tier.flush_saves()
         if self._copy_fn is None:
             def _copy(k, v, s, d):
                 return (k.at[:, :, d].set(k[:, :, s]),

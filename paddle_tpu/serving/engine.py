@@ -145,7 +145,8 @@ class LLMEngine:
                  spec_max_ngram=3, spec_min_ngram=1, trace=None,
                  trace_buffer=None, request_log=None, mesh=None,
                  kv_hbm_bytes=None, slo=None, postmortem_dir=None,
-                 postmortem_keep=None, width_buckets=None):
+                 postmortem_keep=None, width_buckets=None,
+                 host_kv_blocks=None, host_swap_chunk=4):
         import jax
 
         from .sharded import as_serving_mesh, kv_capacity_blocks
@@ -359,6 +360,22 @@ class LLMEngine:
             sharding=(None if self._smesh is None
                       else self._smesh.arena_sharding()),
         )
+        # host-memory KV tier (serving/kv_tier.py): `host_kv_blocks` host
+        # block slots make evicted cached prefixes swap-back-able instead
+        # of dying (and carry them across replicas on drain/eject).
+        # None/0 = off, one pointer, every hook a single test — the
+        # tierless engine is byte-identical to the pre-tier engine.
+        if host_kv_blocks is None:
+            host_kv_blocks = int(
+                os.environ.get("PADDLE_TPU_HOST_KV_BLOCKS", "0") or 0)
+        self.tier = None
+        if host_kv_blocks:
+            from .kv_tier import KVTier
+
+            self.tier = KVTier(self.pool, host_kv_blocks,
+                               mesh=self._smesh, metrics=self.metrics,
+                               swap_chunk=host_swap_chunk)
+            self.pool.attach_tier(self.tier)
         # mesh topology gauges: a replica's shape is visible on /metrics
         # and /healthz without log-diving (single-chip engines report
         # tp_degree 1 so dashboards need no sharded-or-not special case)
@@ -796,6 +813,62 @@ class LLMEngine:
             "donation_expected": donation_on,
         }
 
+    def swap_program_shapes(self):
+        """{name: chunk_width} for the host-tier swap copy programs
+        (kv_tier.py) this engine would compile — empty when the tier is
+        off. The IR contract checker lowers exactly these alongside the
+        step programs."""
+        if self.tier is None:
+            return {}
+        return {"swap_out": self.tier.swap_chunk,
+                "swap_in": self.tier.swap_chunk}
+
+    def lowered_swap_programs(self):
+        """AOT-lower the tier's swap gather/scatter WITHOUT executing
+        them: {name: jax.stages.Lowered}. The tier's own lazily-built jit
+        callables are lowered (not re-built copies), so shardings and
+        donation lower exactly as a served swap would — a silent
+        full-arena-copy regression in either program (the PR 4 eager-COW
+        bug class) shows up in the artifact's cost/alias analysis."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.tier is None:
+            return {}
+        t = self.tier
+        c = t.swap_chunk
+        L, H, Bs, D = t._shape
+        dt = self.pool.k.dtype
+        idx = jax.ShapeDtypeStruct((c,), jnp.int32)
+        chunk = jax.ShapeDtypeStruct((L, H, c, Bs, D), dt)
+        return {
+            "swap_out": t._gather_jit().lower(self.pool.k, self.pool.v,
+                                              idx),
+            "swap_in": t._scatter_jit().lower(self.pool.k, self.pool.v,
+                                              chunk, chunk, idx),
+        }
+
+    def swap_program_spec(self):
+        """IR002 facts for the swap programs: the swap-in scatter donates
+        both arenas (params 0, 1 -> outputs 0, 1) under the same policy
+        as the step program — unconditionally single-chip, gated off on
+        the cpu host platform when sharded; the swap-out gather must
+        donate NOTHING (the arena stays live under it — an alias there
+        would corrupt the pool). Stated independently of the gate, like
+        `step_program_spec` (a bypassed gate must move only one side)."""
+        import jax
+
+        if self._smesh is None:
+            donation_on = True
+        else:
+            donation_on = jax.default_backend() != "cpu"
+        return {
+            "arena_param_indices": (0, 1),
+            "arena_output_indices": {"swap_in": (0, 1)},
+            "donation_expected": donation_on,
+            "no_alias": ("swap_out",),
+        }
+
     def _annotation(self, step_id):
         """While tracing, the device dispatch runs under a jax.profiler
         TraceAnnotation named after the step id — the join key that lets
@@ -923,6 +996,11 @@ class LLMEngine:
         # catch-up-flipping bystanders
         self.last_planned = []
         rows = self.scheduler.schedule(only=only)
+        if self.tier is not None:
+            # arena-write ordering (kv_tier.py rule 1): demotions buffered
+            # by this plan's evictions must gather their bytes before the
+            # step program's donated scatters land on those blocks
+            self.tier.flush_saves()
         if not rows:
             return []
         self.step_count += 1
@@ -1224,7 +1302,7 @@ class LLMEngine:
         operators: block-pool occupancy split by tier plus scheduler queue
         depths — enough to see saturation without scraping /metrics."""
         usable = self.pool.num_blocks - 1
-        return {
+        stats = {
             "blocks_total": usable,
             "blocks_truly_free": self.pool.num_truly_free,
             "blocks_cached_free": self.pool.num_cached_blocks,
@@ -1232,6 +1310,50 @@ class LLMEngine:
             "requests_running": len(self.scheduler.running),
             "requests_waiting": len(self.scheduler.waiting),
         }
+        if self.tier is not None:
+            # host-tier occupancy + swap/migration counters ride the same
+            # dict, so /healthz "pool" and the /metrics pool_* gauges can
+            # never disagree (they both render exactly this)
+            stats.update(self.tier.stats())
+        return stats
+
+    # -- host-tier migration (serving/router.py drain/eject hooks) ---------
+
+    def export_kv_tier(self, demote=True):
+        """Serialize this engine's reusable prefix blocks for an
+        in-process handoff to another replica (the router's rolling-drain
+        / ejection migration). With ``demote=True`` every DEVICE
+        cached-free block is first saved into the host tier (the blocks
+        stay device-resident and matchable — demotion copies, it does not
+        evict), so a drained replica hands over its full warm set, not
+        just what eviction pressure already spilled. Returns the payload
+        for `import_kv_tier`, or None when the tier is off.
+
+        ``demote=True`` requires a QUIESCENT (drained/idle) engine — it
+        gathers from the device arena. ``demote=False`` is safe on a
+        LIVE engine (the ejection path): it only reads settled host
+        slabs under the tier lock, skipping in-flight saves."""
+        if self.tier is None:
+            return None
+        if demote:
+            for b, h in self.pool.cached_blocks():
+                self.tier.save(h, b)
+            self.tier.settle()
+        return self.tier.export()
+
+    def import_kv_tier(self, payload):
+        """Adopt another replica's exported host tier into ours (geometry
+        must match — see `KVTier.import_payload`). Returns blocks
+        imported (0 when the tier is off or payload is None)."""
+        if self.tier is None or payload is None:
+            return 0
+        return self.tier.import_payload(payload)
+
+    def close(self):
+        """Release engine-owned background resources (the tier's drain
+        thread). Idempotent; safe on a tierless engine."""
+        if self.tier is not None:
+            self.tier.close()
 
     # -- conveniences ------------------------------------------------------
 

@@ -338,6 +338,11 @@ class AsyncLLMEngine:
         # executor worker, never the event loop (JL007)
         await asyncio.get_running_loop().run_in_executor(
             None, self._thread.join, 5.0)
+        close = getattr(self.engine, "close", None)
+        if close is not None:
+            # release engine-owned background resources (the host-tier
+            # drain thread) now that the engine thread is gone
+            await asyncio.get_running_loop().run_in_executor(None, close)
 
     async def _await_stopped(self, timeout_s):
         """True once the engine thread signalled `_stopped` (bounded by
@@ -380,10 +385,12 @@ class AsyncLLMEngine:
             )
         if self._thread is None:
             raise RuntimeError("AsyncLLMEngine.start() has not been awaited")
-        if not self._thread.is_alive():
+        if not self._thread.is_alive() or self._stopped.is_set():
             # a dead engine thread that slipped past the crash handler
             # (e.g. interpreter teardown): fail fast, never enqueue into
-            # a command queue nobody drains
+            # a command queue nobody drains. `_stopped` covers the unwind
+            # window where the epilogue has posted but the OS thread is
+            # still exiting (is_alive() briefly True)
             raise EngineClosedError(
                 "engine thread is dead; not admitting",
                 reason="engine_dead", retry_after_s=None,

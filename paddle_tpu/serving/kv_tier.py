@@ -1,0 +1,487 @@
+"""Host-memory KV block tier: swap-out on eviction, swap-back on match,
+and the fleet's block-transport substrate.
+
+The device arena (block_pool.py) bounds the prefix cache at device size;
+host RAM is 10-100x larger. This module adds a THIRD tier under the
+pool's two (truly-free, cached-free): when LRU eviction claims a
+cached-free block, its contents are copied to a host slab and its content
+hash stays matchable in a ``host_cached`` index. A later request whose
+prompt walks past the device index into host-resident hashes gets those
+blocks swapped BACK into freshly allocated arena blocks, charged exactly
+like device cache hits — so cache capacity for prefix reuse becomes host
+RAM, not HBM.
+
+Dataflow discipline (the whole correctness story in four rules):
+
+1. **Save buffers, flush gathers.** `save(h, b)` (called by the pool
+   inside the eviction branch) only BUFFERS the pair — the block's bytes
+   are still valid on device because nothing has written the arena yet.
+   `flush_saves()` dispatches one jitted gather per chunk
+   (``jnp.take(arena, src, axis=2)``, NO donation) and hands the gathered
+   device arrays to the drain thread. Every arena WRITE site flushes
+   first: the engine flushes between `schedule()` and step dispatch,
+   `BlockPool.copy_blocks` flushes before the COW scatter, and `restore`
+   flushes before its own swap-in scatter. Enqueue order on the device
+   stream then guarantees the gather reads pre-write bytes.
+2. **Restore dispatches at plan time.** A host hit allocates device
+   blocks (pool eviction rules apply — evictions it causes are flushed
+   first, rule 1), device_puts the host bytes, and dispatches a jitted
+   DONATED scatter into the arena immediately. Async dispatch is the
+   double-buffering: the scatter is enqueued ahead of the step program
+   that consumes the arena, so decode never stalls on a host copy.
+3. **Per-shard slabs.** Under tensor-parallel serving the arena's head
+   axis is sharded; the save gather preserves that sharding and the
+   drain thread reads each chip's ``addressable_shards`` — no cross-chip
+   gather ever happens on the save path. Slabs are keyed by head range;
+   restore concatenates ranges on host and device_puts with the arena
+   sharding (each chip receives only its own heads).
+4. **One lock.** All index/slab/pending state is guarded by
+   ``KVTier._lock``; it never nests with any other lock, device syncs
+   (``np.asarray`` on device arrays) happen OUTSIDE it, and the drain
+   thread talks to the engine thread only through a ``queue.Queue`` plus
+   that lock. Late slab writes racing a host-LRU eviction are dropped by
+   a per-slot generation counter.
+
+Migration (`export` / `import_payload`) reuses the same slabs as the
+fleet's block-transport substrate: on a rolling drain or ejection the
+router demotes the old home's device-cached blocks into its host tier,
+exports ``hash -> full-logical [L, H, bs, D]`` numpy entries, and imports
+them into the new home — a drain is zero-rewarm instead of cache-cold.
+The in-process payload is the stepping stone to disaggregated
+prefill/decode: the interface is already (hashes, bytes), not engines.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+class KVTier:
+    """Host-memory block tier under one `BlockPool`.
+
+    Thread model: the engine thread calls `save`/`flush_saves`/`match`/
+    `restore`; `export`/`import_payload` run on a quiescent (drained)
+    engine from any thread; the ``kvtier-drain`` thread owns nothing but
+    `_write_chunk`. Every shared access takes ``self._lock``.
+    """
+
+    def __init__(self, pool, host_blocks, mesh=None, metrics=None,
+                 swap_chunk=4):
+        import jax.numpy as jnp
+
+        if host_blocks < 1:
+            raise ValueError("host_kv_blocks must be >= 1")
+        self.pool = pool
+        self.mesh = mesh          # ServingMesh or None (single-chip)
+        self.metrics = metrics
+        self.host_blocks = int(host_blocks)
+        self.swap_chunk = max(1, int(swap_chunk))
+        L, H, _, Bs, D = pool.k.shape
+        self._shape = (L, H, Bs, D)   # per-block logical shape
+        self._dtype = np.dtype(jnp.dtype(pool.k.dtype).name)
+        # per-shard host slabs [(h0, h1, k_slab, v_slab)]: one entry per
+        # tp head range (single-chip: one full-width entry). Plain numpy
+        # is the "pinned host slab" on the host platform; on real
+        # accelerators device_put from numpy already uses the pinned
+        # staging path.
+        if mesh is None or mesh.tp_degree == 1:
+            ranges = [(0, H)]
+        else:
+            ranges = mesh.tp_head_ranges(H)
+        self._slabs = [
+            (h0, h1,
+             np.zeros((L, h1 - h0, self.host_blocks, Bs, D), self._dtype),
+             np.zeros((L, h1 - h0, self.host_blocks, Bs, D), self._dtype))
+            for h0, h1 in ranges
+        ]
+        self._lock = threading.Lock()
+        self._index = OrderedDict()   # hash -> slot (LRU order, MRU last)
+        self._slot_gen = [0] * self.host_blocks  # bumps on slot reuse
+        self._free_slots = list(range(self.host_blocks - 1, -1, -1))
+        self._save_buf = []           # buffered (hash, device block) saves
+        self._pending = {}            # hash -> (slot, gen, j, k_g, v_g)
+        self.swap_ins = 0
+        self.swap_outs = 0
+        self.swap_in_hit_tokens = 0
+        self.migrated_blocks_out = 0
+        self.migrated_blocks_in = 0
+        self._gather_fn = None
+        self._scatter_fn = None
+        self._queue = queue.Queue()
+        self._drain = threading.Thread(target=self._drain_loop,
+                                       name="kvtier-drain", daemon=True)
+        self._drain.start()
+
+    # -- save path (engine thread) -----------------------------------------
+
+    def save(self, h, block):
+        """Buffer one evicted cached-free block for demotion to host.
+        Called by the pool INSIDE its eviction branch — the block's arena
+        bytes stay valid until the next arena write, and every arena-write
+        site flushes this buffer first (module docstring, rule 1)."""
+        with self._lock:
+            if h in self._index and h not in self._pending:
+                self._index.move_to_end(h)   # already resident: refresh
+                return
+            self._save_buf.append((h, int(block)))
+
+    def flush_saves(self):
+        """Dispatch every buffered save as chunked jitted gathers and hand
+        the gathered device arrays to the drain thread. MUST run before
+        any arena-write dispatch; cheap no-op when the buffer is empty."""
+        with self._lock:
+            if not self._save_buf:
+                return
+            buf, self._save_buf = self._save_buf, []
+            plan = []                 # (hash, slot, gen) per buffered block
+            for h, b in buf:
+                if h in self._index:
+                    self._index.move_to_end(h)
+                    continue
+                slot = self._take_slot_locked()
+                if slot is None:
+                    continue          # host tier full of newer entries
+                self._index[h] = slot
+                plan.append((h, b, slot, self._slot_gen[slot]))
+        if not plan:
+            return
+        for i in range(0, len(plan), self.swap_chunk):
+            chunk = plan[i:i + self.swap_chunk]
+            src = [b for _, b, _, _ in chunk]
+            # pad to the compiled chunk width by repeating the last index
+            # (idempotent — the duplicate columns are never read back)
+            src = src + [src[-1]] * (self.swap_chunk - len(src))
+            k_g, v_g = self._gather(np.asarray(src, np.int32))
+            entries = [(h, slot, gen, j)
+                       for j, (h, _, slot, gen) in enumerate(chunk)]
+            with self._lock:
+                for h, slot, gen, j in entries:
+                    self._pending[h] = (slot, gen, j, k_g, v_g)
+            self._queue.put((entries, k_g, v_g))
+
+    def _take_slot_locked(self):
+        """One host slot, evicting the host-LRU entry when full. Returns
+        None only when every slot is held by a pending save newer than
+        everything evictable. Caller holds the lock."""
+        if self._free_slots:
+            return self._free_slots.pop()
+        for h in self._index:          # oldest first
+            if h not in self._pending:
+                slot = self._index.pop(h)
+                self._slot_gen[slot] += 1
+                return slot
+        # everything resident is a pending save: evict the oldest pending
+        # entry anyway (its late slab write is dropped by the gen bump)
+        h, slot = next(iter(self._index.items()))
+        del self._index[h]
+        del self._pending[h]
+        self._slot_gen[slot] += 1
+        return slot
+
+    def _gather_jit(self):
+        """The jitted block gather (built lazily, NO donation — the arena
+        stays live). Sharded arenas keep their head sharding on the
+        output, so each chip's shard of the result is exactly its own
+        slab slice (rule 3). Also the hlolint lowering surface
+        (`LLMEngine.lowered_swap_programs`)."""
+        import jax
+
+        if self._gather_fn is None:
+            self._gather_fn = jax.jit(
+                _swap_out, **({} if self.mesh is None else
+                              {"out_shardings": (self.mesh.arena_sharding(),
+                                                 self.mesh.arena_sharding())})
+            )
+        return self._gather_fn
+
+    def _gather(self, src):
+        return self._gather_jit()(self.pool.k, self.pool.v, src)
+
+    # -- drain thread ------------------------------------------------------
+
+    def _drain_loop(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                self._write_chunk(*item)
+            finally:
+                self._queue.task_done()
+
+    def _write_chunk(self, entries, k_g, v_g):
+        """Device->host transfer of one gathered chunk, then slab writes
+        under the lock. The `np.asarray` sync happens OUTSIDE the lock;
+        a generation mismatch (host-LRU evicted the slot while the copy
+        was in flight) drops the write."""
+        host = [(h0, h1, self._shard_to_host(k_g, h0, h1),
+                 self._shard_to_host(v_g, h0, h1))
+                for h0, h1, _, _ in self._slabs]
+        written = 0
+        with self._lock:
+            for h, slot, gen, j in entries:
+                pend = self._pending.get(h)
+                if pend is None or pend[1] != gen:
+                    continue
+                del self._pending[h]
+                if self._slot_gen[slot] != gen:
+                    continue
+                for (_, _, k_slab, v_slab), (_, _, hk, hv) in zip(
+                        self._slabs, host):
+                    k_slab[:, :, slot] = hk[:, :, j]
+                    v_slab[:, :, slot] = hv[:, :, j]
+                written += 1
+                self.swap_outs += 1
+        if self.metrics is not None and written:
+            self.metrics.inc("swap_outs", written)
+
+    def _shard_to_host(self, arr, h0, h1):
+        """Host numpy copy of head range [h0, h1) of a gathered chunk —
+        per-shard (`addressable_shards`, no collective) when sharded."""
+        if self.mesh is None or self.mesh.tp_degree == 1:
+            return np.asarray(arr)[:, h0:h1]
+        for shard in arr.addressable_shards:
+            sl = shard.index[1]
+            s0 = 0 if sl.start is None else sl.start
+            if s0 == h0:
+                return np.asarray(shard.data)
+        raise AssertionError(
+            f"no addressable shard covers head range [{h0}, {h1})")
+
+    # -- restore path (engine thread) --------------------------------------
+
+    def match(self, hashes):
+        """Longest consecutive host-resident run of `hashes` (resident =
+        slab-written OR still pending its slab write). Refreshes LRU."""
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if h not in self._index:
+                    break
+                self._index.move_to_end(h)
+                n += 1
+        return n
+
+    def restore(self, hashes, blocks):
+        """Swap `hashes` (host-resident per a prior `match`) back into the
+        freshly allocated arena `blocks` via the donated scatter. Host
+        copies are RETAINED (still LRU-matchable; a re-eviction of the
+        restored device block is a free re-save). Returns the number of
+        LEADING blocks actually restored — an entry evicted between match
+        and restore trims the run, and the caller must only charge (and
+        only register hashes for) that many."""
+        self.flush_saves()   # rule 1: evictions for `blocks` gather first
+        pend_sync = {}
+        n = 0
+        with self._lock:
+            for h in hashes:
+                ent = self._index.get(h)
+                if ent is None:
+                    break
+                if h in self._pending:
+                    pend_sync[h] = self._pending[h]
+                n += 1
+        if n == 0:
+            return 0
+        # pending entries' bytes are still device-side: sync them outside
+        # the lock (np.asarray on the gathered chunk), then read slabs
+        pend_host = {
+            h: (j, [(self._shard_to_host(k_g, h0, h1),
+                     self._shard_to_host(v_g, h0, h1))
+                    for h0, h1, _, _ in self._slabs])
+            for h, (_, _, j, k_g, v_g) in pend_sync.items()
+        }
+        L, H, Bs, D = self._shape
+        hk = np.empty((L, H, n, Bs, D), self._dtype)
+        hv = np.empty((L, H, n, Bs, D), self._dtype)
+        with self._lock:
+            for i, h in enumerate(hashes[:n]):
+                slot = self._index.get(h)
+                if slot is None:
+                    n = i          # evicted between match and here: trim
+                    break
+                if h in pend_host:
+                    j, shards = pend_host[h]
+                    for (h0, h1, _, _), (pk, pv) in zip(self._slabs, shards):
+                        hk[:, h0:h1, i] = pk[:, :, j]
+                        hv[:, h0:h1, i] = pv[:, :, j]
+                else:
+                    for h0, h1, k_slab, v_slab in self._slabs:
+                        hk[:, h0:h1, i] = k_slab[:, :, slot]
+                        hv[:, h0:h1, i] = v_slab[:, :, slot]
+            self.swap_ins += n
+            self.swap_in_hit_tokens += n * self.pool.block_size
+        if n == 0:
+            return 0
+        self._scatter(hk[:, :, :n], hv[:, :, :n],
+                      np.asarray(blocks[:n], np.int32))
+        if self.metrics is not None:
+            self.metrics.inc("swap_ins", n)
+            self.metrics.inc("swap_in_hit_tokens",
+                             n * self.pool.block_size)
+        return n
+
+    def _scatter(self, hk, hv, dst):
+        """Donated jitted scatter of host chunks into the arena, padded to
+        the compiled chunk width by repeating the last (dst, data) column
+        (idempotent; never pads with block 0)."""
+        c = self.swap_chunk
+        fn = self._scatter_jit()
+        for i in range(0, hk.shape[2], c):
+            ck, cv = hk[:, :, i:i + c], hv[:, :, i:i + c]
+            cd = dst[i:i + c]
+            if ck.shape[2] < c:
+                pad = c - ck.shape[2]
+                ck = np.concatenate([ck] + [ck[:, :, -1:]] * pad, axis=2)
+                cv = np.concatenate([cv] + [cv[:, :, -1:]] * pad, axis=2)
+                cd = np.concatenate([cd, np.repeat(cd[-1:], pad)])
+            dk, dv = self._device_put(ck), self._device_put(cv)
+            self.pool.k, self.pool.v = fn(
+                self.pool.k, self.pool.v, dk, dv,
+                np.asarray(cd, np.int32))
+
+    def _scatter_jit(self):
+        """The jitted donated swap-in scatter (built lazily) — the other
+        half of the hlolint lowering surface."""
+        import jax
+
+        if self._scatter_fn is None:
+            if self.mesh is None:
+                self._scatter_fn = jax.jit(
+                    _swap_in,
+                    # jaxlint: disable=JL004 -- swap-in scatter donates the single-device KV arenas in place (an undonated scatter would copy the whole arena per restore on the decode critical path); the aliasing is machine-checked by IR contract IR002 on the engine's lowered swap programs (analysis/contracts.py)
+                    donate_argnums=(0, 1))
+            else:
+                from ..parallel.spmd import mesh_donate_argnums
+
+                arena = self.mesh.arena_sharding()
+                self._scatter_fn = jax.jit(
+                    _swap_in,
+                    in_shardings=(arena, arena, arena, arena,
+                                  self.mesh.replicated()),
+                    out_shardings=(arena, arena),
+                    donate_argnums=mesh_donate_argnums((0, 1)))
+        return self._scatter_fn
+
+    def _device_put(self, host_chunk):
+        """Host chunk -> device, arena-sharded when tp (each chip receives
+        only its own head slice — no cross-chip traffic)."""
+        import jax
+
+        if self.mesh is None:
+            return jax.device_put(host_chunk)
+        return jax.device_put(host_chunk, self.mesh.arena_sharding())
+
+    # -- migration (quiescent engine, any thread) --------------------------
+
+    def settle(self):
+        """Block until every dispatched save has landed in its slab."""
+        self.flush_saves()
+        self._queue.join()
+
+    def export(self):
+        """Serialize every host-resident block as ``(hash, k, v)`` with
+        full-logical ``[L, H, bs, D]`` numpy arrays, oldest first (so an
+        importer's LRU order mirrors ours). Call `settle` (or
+        `LLMEngine.export_kv_tier`) first so pending saves are included."""
+        L, H, Bs, D = self._shape
+        with self._lock:
+            entries = []
+            for h, slot in self._index.items():
+                if h in self._pending:
+                    continue           # unsettled: caller skipped settle()
+                k = np.empty((L, H, Bs, D), self._dtype)
+                v = np.empty((L, H, Bs, D), self._dtype)
+                for h0, h1, k_slab, v_slab in self._slabs:
+                    k[:, h0:h1] = k_slab[:, :, slot]
+                    v[:, h0:h1] = v_slab[:, :, slot]
+                entries.append((h, k, v))
+            self.migrated_blocks_out += len(entries)
+        if self.metrics is not None and entries:
+            self.metrics.inc("kv_migrated_blocks_out", len(entries))
+        return {"shape": self._shape, "dtype": self._dtype.name,
+                "block_size": self.pool.block_size, "entries": entries}
+
+    def import_payload(self, payload):
+        """Adopt an exported payload into this tier (oldest first, LRU
+        evicting our own cold entries as needed). Shape/dtype/block-size
+        mismatches raise — silently adopting foreign-geometry KV would
+        serve one model's cache to another. Returns blocks imported."""
+        if (tuple(payload["shape"]) != self._shape
+                or payload["dtype"] != self._dtype.name
+                or payload["block_size"] != self.pool.block_size):
+            raise ValueError(
+                f"kv tier geometry mismatch: theirs "
+                f"{payload['shape']}/{payload['dtype']}/bs"
+                f"{payload['block_size']}, ours {self._shape}/"
+                f"{self._dtype.name}/bs{self.pool.block_size}")
+        n = 0
+        with self._lock:
+            for h, k, v in payload["entries"]:
+                if h in self._index:
+                    self._index.move_to_end(h)
+                    continue
+                slot = self._take_slot_locked()
+                if slot is None:
+                    continue
+                for h0, h1, k_slab, v_slab in self._slabs:
+                    k_slab[:, :, slot] = k[:, h0:h1]
+                    v_slab[:, :, slot] = v[:, h0:h1]
+                self._index[h] = slot
+                n += 1
+            self.migrated_blocks_in += n
+        if self.metrics is not None and n:
+            self.metrics.inc("kv_migrated_blocks_in", n)
+        return n
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self):
+        """Gauges + counters for pool_stats()/debug surfaces."""
+        with self._lock:
+            return {
+                "host_blocks_total": self.host_blocks,
+                "host_blocks_used": len(self._index),
+                "swap_ins": self.swap_ins,
+                "swap_outs": self.swap_outs,
+                "swap_in_hit_tokens": self.swap_in_hit_tokens,
+                "migrated_blocks_out": self.migrated_blocks_out,
+                "migrated_blocks_in": self.migrated_blocks_in,
+            }
+
+    def debug_snapshot(self):
+        """The /debug/kvtier body: stats plus the resident hash ring
+        (hex-truncated, LRU->MRU) and slab geometry."""
+        s = self.stats()
+        with self._lock:
+            s["pending_saves"] = len(self._pending)
+            s["resident"] = [h.hex()[:16] for h in self._index]
+        s["swap_chunk"] = self.swap_chunk
+        s["block_shape"] = list(self._shape)
+        s["dtype"] = self._dtype.name
+        s["shards"] = [[h0, h1] for h0, h1, _, _ in self._slabs]
+        return s
+
+    def close(self):
+        """Stop the drain thread (idempotent). Pending queue items are
+        drained first so no save is silently dropped."""
+        if self._drain.is_alive():
+            self._queue.put(None)
+            self._drain.join(timeout=10.0)
+
+
+def _swap_out(k, v, src):
+    """Gather `src` blocks out of the arenas (jitted, NOT donated)."""
+    import jax.numpy as jnp
+
+    return jnp.take(k, src, axis=2), jnp.take(v, src, axis=2)
+
+
+def _swap_in(k, v, hk, hv, dst):
+    """Scatter host chunks into arena blocks `dst` (jitted, arenas
+    donated — the same in-place contract as the step program and COW)."""
+    return (k.at[:, :, dst].set(hk.astype(k.dtype)),
+            v.at[:, :, dst].set(hv.astype(v.dtype)))

@@ -133,6 +133,29 @@ _HELP = {
     "pool_blocks_allocated": "KV blocks held by live sequences",
     "pool_requests_running": "Sequences in the running batch (pool view)",
     "pool_requests_waiting": "Requests waiting for a lane (pool view)",
+    "pool_host_blocks_total": "Host-tier slab capacity in KV blocks "
+                              "(0 when the tier is off)",
+    "pool_host_blocks_used": "Host-tier slab slots holding a matchable "
+                             "block (resident + pending saves)",
+    "pool_swap_ins": "KV blocks restored from the host tier into the "
+                     "device arena (gauge mirror of swap_ins)",
+    "pool_swap_outs": "Evicted KV blocks demoted to the host slab "
+                      "(gauge mirror of swap_outs)",
+    "pool_swap_in_hit_tokens": "Prefill tokens served from host-tier "
+                               "blocks instead of recompute",
+    "pool_migrated_blocks_out": "KV blocks exported to a peer replica "
+                                "(drain / ejection salvage)",
+    "pool_migrated_blocks_in": "KV blocks adopted from a peer replica's "
+                               "export",
+    "swap_ins": "KV blocks restored from the host tier into the device "
+                "arena",
+    "swap_outs": "Evicted KV blocks demoted to the host slab",
+    "swap_in_hit_tokens": "Prefill tokens served from host-tier blocks "
+                          "instead of recompute",
+    "kv_migrated_blocks_out": "KV blocks exported to a peer replica "
+                              "(drain / ejection salvage)",
+    "kv_migrated_blocks_in": "KV blocks adopted from a peer replica's "
+                             "export",
     "backpressure_drops": "Streams switched to catch-up mode (consumer "
                           "lagged)",
     "client_disconnects": "Requests aborted because the client went away",
@@ -217,6 +240,10 @@ _HELP = {
     "router_restarts": "Replica engines rebuilt via the replica factory "
                        "(probe recovery or rolling drain)",
     "router_drains": "Replicas drained by a rolling drain pass",
+    "router_migrations": "KV-tier handoffs between replicas (rolling "
+                         "drain demotion or ejection salvage)",
+    "router_migrated_blocks": "KV blocks moved between replicas across "
+                              "all handoffs",
     "router_replica_events": "Per-replica lifecycle events (eject / "
                              "readmit / restart / drain), by replica",
     "router_replica_requests": "Admissions per replica, by routing "

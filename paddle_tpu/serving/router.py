@@ -203,7 +203,7 @@ class ReplicaRouter:
                  probe_interval_s=1.0, probe_max_interval_s=30.0,
                  probe_timeout_s=10.0, sweep_interval_s=0.05,
                  poison_source_threshold=3, service_time_init_s=None,
-                 default_timeout_s=None, seed=0):
+                 default_timeout_s=None, seed=0, migrate_on_drain=True):
         """`replicas` is a list of `AsyncLLMEngine`s (bare `LLMEngine`s
         are wrapped with frontend defaults); all must share `block_size`
         — the affinity key is a block hash, and a fleet that chunks
@@ -225,6 +225,11 @@ class ReplicaRouter:
         self.poison_source_threshold = max(2, int(poison_source_threshold))
         self.service_time_init_s = service_time_init_s
         self.default_timeout_s = default_timeout_s
+        # host-tier KV migration (serving/kv_tier.py): on a restart-drain
+        # or ejection, carry the outgoing engine's warm prefix blocks to
+        # a live replica so affinity remaps stay zero-rewarm. A no-op on
+        # tierless engines (export returns None).
+        self.migrate_on_drain = bool(migrate_on_drain)
         self.metrics = ServingMetrics()
         self._rng = random.Random(seed)   # backoff jitter (reproducible)
         self._replicas = [Replica(f"r{i}", self._wrap(e), i)
@@ -661,7 +666,44 @@ class ReplicaRouter:
         replica.next_probe_at = now + self.probe_interval_s
         self.metrics.inc("router_ejections")
         self._log_event(replica, "eject", reason)
+        if self.migrate_on_drain:
+            # salvage the victim's SETTLED host-tier blocks for the
+            # replicas its affinity keys remap to. demote=False: an
+            # ejected replica is NOT quiescent (its engine thread may be
+            # mid-step or dead), so only lock-protected host slabs are
+            # read — never the device arena. Fire-and-forget task:
+            # ejection must never wait on a sick replica's host copies.
+            try:
+                t = asyncio.ensure_future(self._migrate_from(replica))
+                self._probe_tasks.add(t)
+                t.add_done_callback(self._probe_tasks.discard)
+            except RuntimeError:
+                pass   # no running loop (unit-level sweep): skip salvage
         self._update_gauges()
+
+    async def _migrate_from(self, replica):
+        """Best-effort salvage of an ejected replica's host tier into
+        every live replica (they share the remapped affinity keys)."""
+        try:
+            payload = await asyncio.to_thread(
+                replica.engine.engine.export_kv_tier, demote=False)
+        except Exception:  # noqa: BLE001 — sick replica, nothing to save
+            return
+        if not payload or not payload["entries"]:
+            return
+        n = 0
+        for r in self._replicas:
+            if r is replica or r.state not in (ACTIVE, DRAINING):
+                continue
+            try:
+                n += await asyncio.to_thread(
+                    r.engine.engine.import_kv_tier, payload)
+            except Exception:  # noqa: BLE001 — per-destination best-effort
+                continue
+        if n:
+            self.metrics.inc("router_migrations")
+            self.metrics.inc("router_migrated_blocks", n)
+            self._log_event(replica, "migrate", f"{n} blocks salvaged")
 
     async def _sweep_loop(self):
         while True:
@@ -766,6 +808,25 @@ class ReplicaRouter:
         replica.ewma_service_s = None
         self.metrics.inc("router_restarts")
         self._log_event(replica, "restart")
+        if self.migrate_on_drain:
+            # zero-rewarm handoff (serving/kv_tier.py): the old engine is
+            # drained (inflight 0, step loop idle-polling), so demoting
+            # its device-cached blocks into its host tier and importing
+            # them into the fresh engine is race-free. Off the event loop
+            # (JL007/JL011: export syncs device arrays); best-effort — a
+            # wedged old engine loses its cache, never the restart.
+            try:
+                payload = await asyncio.to_thread(
+                    old.engine.export_kv_tier, demote=True)
+                n = await asyncio.to_thread(
+                    replica.engine.engine.import_kv_tier, payload)
+                if n:
+                    self.metrics.inc("router_migrations")
+                    self.metrics.inc("router_migrated_blocks", n)
+                    self._log_event(replica, "migrate", f"{n} blocks")
+            except Exception as e:  # noqa: BLE001 — cache carryover is
+                self._log_event(       # an optimization, never a gate
+                    replica, "migrate_failed", f"{type(e).__name__}: {e}")
         try:
             await old.shutdown(drain=False, timeout_s=self.probe_timeout_s)
         except Exception:  # noqa: BLE001 — a wedged old engine is

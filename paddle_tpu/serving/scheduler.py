@@ -352,6 +352,7 @@ class Scheduler:
             self.metrics.inc("prefix_cache_lookup_tokens",
                              len(req.block_hashes) * self.pool.block_size)
         hit = self.pool.match_prefix(req.block_hashes)
+        hit = list(hit) + self._swap_in(req, len(hit))
         if not hit:
             return
         req.blocks = list(hit)
@@ -366,6 +367,39 @@ class Scheduler:
             # 1.0 on a fully-warm workload)
             self.metrics.inc("prefix_cache_hit_tokens",
                              len(hit) * self.pool.block_size)
+
+    def _swap_in(self, req, n_dev):
+        """Extend a device-index walk that stopped after `n_dev` blocks
+        with host-tier (serving/kv_tier.py) hits: consecutive
+        host-resident hashes past the device run are swapped back into
+        freshly allocated arena blocks at PLAN time — async dispatch
+        double-buffers the restore against compute, so the admission
+        charges these exactly like device cache hits. The restored
+        blocks' hashes are published (`pool.adopt`) so concurrent
+        admissions share them; the host copies are retained. Returns the
+        restored block ids (possibly empty)."""
+        tier = self.pool.tier
+        want = req.block_hashes[n_dev:]
+        if tier is None or not want:
+            return []
+        n = min(tier.match(want),
+                # at least one query token must run; blocks past the
+                # num_tokens - 1 cap would be pinned but never charged
+                max(0, (req.num_tokens - 1) // self.pool.block_size - n_dev),
+                self.pool.num_free)
+        if n < 1:
+            return []
+        blocks = self.pool.allocate(n)
+        if blocks is None:            # injected alloc pressure (faults)
+            return []
+        got = tier.restore(want[:n], blocks)
+        if got < n:
+            # trimmed between match and restore: return the unused tail
+            self.pool.release(blocks[got:])
+            blocks = blocks[:got]
+        if blocks:
+            self.pool.adopt(blocks, want[:got])
+        return blocks
 
     def _take_block(self, req):
         """One block for `req`, preempting arrival-YOUNGER sequences (FCFS

@@ -526,6 +526,26 @@ class ServingServer(_HTTPServerBase):
                 lambda: json.dumps(tracer.chrome_trace()).encode())
             writer.write(_http_response("200 OK", body))
             return await writer.drain()
+        if path == "/debug/kvtier":
+            tier = getattr(self.engine.engine, "tier", None)
+            if tier is None:
+                writer.write(_http_response(
+                    "404 Not Found",
+                    _error_body(
+                        404,
+                        "the host KV tier is off — start the engine with "
+                        "LLMEngine(host_kv_blocks=N) (or "
+                        "PADDLE_TPU_HOST_KV_BLOCKS=N) to spill evicted "
+                        "cache blocks to a host slab", "not_found"),
+                ))
+                return await writer.drain()
+            # the snapshot takes the tier lock (shared with the engine
+            # thread's flush path and the drain thread's slab writes) —
+            # off the event loop so a scrape can't stall live SSE streams
+            body = await asyncio.to_thread(
+                lambda: json.dumps(tier.debug_snapshot()).encode())
+            writer.write(_http_response("200 OK", body))
+            return await writer.drain()
         if path == "/v1/completions":
             if method != "POST":
                 writer.write(_http_response(
@@ -652,6 +672,27 @@ class RouterServer(_HTTPServerBase):
             # windows — off the event loop (the /debug/slo discipline)
             body = await asyncio.to_thread(
                 lambda: SLOLedger.merged_rollup(ledgers))
+            writer.write(_http_response("200 OK", body))
+            return await writer.drain()
+        if path == "/debug/kvtier":
+            pairs = [(r.name, getattr(r.engine.engine, "tier", None))
+                     for r in self.router.replicas]
+            if not any(t is not None for _, t in pairs):
+                writer.write(_http_response(
+                    "404 Not Found",
+                    _error_body(
+                        404,
+                        "no replica runs the host KV tier — build the "
+                        "replica engines with LLMEngine(host_kv_blocks=N) "
+                        "(or PADDLE_TPU_HOST_KV_BLOCKS=N) for the fleet "
+                        "view", "not_found"),
+                ))
+                return await writer.drain()
+            # each snapshot takes that replica's tier lock — off the
+            # event loop (the /debug/slo discipline)
+            body = await asyncio.to_thread(lambda: json.dumps({
+                name: (None if t is None else t.debug_snapshot())
+                for name, t in pairs}).encode())
             writer.write(_http_response("200 OK", body))
             return await writer.drain()
         if path == "/v1/completions":

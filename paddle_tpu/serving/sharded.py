@@ -118,6 +118,18 @@ class ServingMesh:
         block) tiles never straddle chips."""
         return self.named(None, self.TP_AXIS)
 
+    def tp_head_ranges(self, num_heads):
+        """The arena head axis cut into per-shard ``(h0, h1)`` ranges, in
+        tp shard order — the host-tier slab layout (serving/kv_tier.py):
+        one host slab per range, filled from each chip's own addressable
+        shard so the save path never gathers across chips."""
+        tp = self.tp_degree
+        if num_heads % tp:
+            raise ValueError(
+                f"tp_degree {tp} does not divide num_heads {num_heads}")
+        per = num_heads // tp
+        return [(i * per, (i + 1) * per) for i in range(tp)]
+
     def validate_model(self, cfg):
         """Reject a model the tp degree cannot shard evenly: attention
         heads, FFN columns, and the (vocab-parallel) embedding rows must

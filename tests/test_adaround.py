@@ -44,6 +44,7 @@ def test_adaround_beats_nearest_on_linear():
     assert e_learned < e_nearest, (e_learned, e_nearest)
 
 
+@pytest.mark.slow
 def test_ptq_adaround_end_to_end_lenet():
     from paddle_tpu.vision.models import LeNet
 

@@ -211,6 +211,7 @@ class TestPPYOLOE:
                 assert (valid[:, 2] >= 0).all() and (valid[:, 4] <= 64).all()
                 assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
 
+    @pytest.mark.slow
     def test_simple_loss_trains(self):
         from paddle_tpu.vision.models import ppyoloe_s
 

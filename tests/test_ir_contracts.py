@@ -62,10 +62,14 @@ def test_program_set_covers_the_registry(artifacts):
     names = {a.name for a in artifacts}
     want = {f"serve/tp{tp}/{name}"
             for tp in (1, 2) for name in eng.step_program_shapes()}
+    want |= {f"serve/tp{tp}/{name}"
+             for tp in (1, 2) for name in eng.swap_program_shapes()}
     want.add("train/dp2_mp2")
-    # one artifact per ragged width bucket — the engine helper is the
-    # ONE place the program-count contract lives
-    assert len(want) == 2 * eng.expected_program_count() + 1
+    # one artifact per ragged width bucket plus the host-tier swap pair —
+    # the engine helpers are the ONE place the program-count contract
+    # lives
+    assert len(want) == (2 * eng.expected_program_count()
+                         + 2 * len(eng.swap_program_shapes()) + 1)
     assert names == want, names
 
 
@@ -99,6 +103,11 @@ def test_donation_aliases_match_the_gate(artifacts):
         if not a.name.startswith("serve/tp1/"):
             continue
         don = a.expected["donation"]
+        if a.kind == "swap_out":
+            # the gather's arena inputs stay live: NOTHING may alias
+            assert don["expected"] is False
+            assert a.aliases == [], (a.name, a.aliases)
+            continue
         assert don["expected"] is True
         aliased = {al.param_number for al in a.aliases}
         assert set(don["param_indices"]) <= aliased, (a.name, a.aliases)

@@ -1,5 +1,6 @@
 """jit.to_static, amp, DataLoader, PyLayer, recompute tests."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
@@ -227,6 +228,7 @@ def test_flash_attention_pallas_interpret():
         del os.environ["PADDLE_TPU_PALLAS_INTERPRET"]
 
 
+@pytest.mark.slow
 def test_flash_attention_mask_grad_matches_xla():
     """Pallas path must differentiate an additive mask (e.g. a trainable
     relative-position bias) identically to the XLA fallback."""

@@ -177,6 +177,7 @@ class TestGPTSchedules:
             ls.append(float(loss))
         return ls
 
+    @pytest.mark.slow
     def test_cross_mesh_and_schedule_trajectories_agree(self):
         base = self._train({"pp": 2}, "gpipe")
         np.testing.assert_allclose(self._train({"pp": 2}, "1f1b"), base, rtol=3e-4)
