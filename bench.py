@@ -283,7 +283,14 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
     (prompt-lookup drafting + batched verify, serving/spec.py): the same
     workload spec-on vs spec-off, reporting both tok/s plus
     `spec_acceptance_rate` and tokens/step — the repetitive case must beat
-    the one-token-per-step baseline."""
+    the one-token-per-step baseline.
+
+    A fourth wave measures the INT8 KV ARENA (`kv_dtype="int8"`): the
+    same `kv_hbm_bytes` budget spent on int8 vs weight-dtype blocks,
+    over capacity for the baseline — reporting blocks bought, preemption
+    counts, tok/s, and the greedy parity rate between the two engines.
+    The main line also carries `kv_dtype`/`kv_bytes_per_block` so the
+    trajectory can see which arena priced the serve."""
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPT, GPTConfig
     from paddle_tpu.serving import LLMEngine
@@ -368,6 +375,7 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
                                   deadline_s, on_tpu)
     spec = _serve_spec_wave(model, cfg, max_batch, rs, errors, deadline_s,
                             on_tpu)
+    int8cmp = _serve_int8_overcap(model, cfg, rs, errors, deadline_s)
     view = engine.metrics.schedule_view()
     sched = view.get("serving-engine", {})
     lat = engine.metrics.latency_summary()
@@ -385,6 +393,8 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
         "max_batch": max_batch,
         "max_new_tokens": max_new,
         "prefill_chunk": engine.prefill_chunk,
+        "kv_dtype": engine.pool_stats()["kv_dtype"],
+        "kv_bytes_per_block": engine.pool_stats()["kv_bytes_per_block"],
         "ttft_p50_ms": round(ttft.get("p50_ms", 0.0), 2),
         "ttft_p95_ms": round(ttft.get("p95_ms", 0.0), 2),
         "tpot_p50_ms": round(tpot["p50"] or 0.0, 3),
@@ -407,6 +417,7 @@ def bench_gpt_serve(on_tpu, errors, deadline_s):
         **trace_info,
         **(shared or {}),
         **(spec or {}),
+        **({"int8_overcap": int8cmp} if int8cmp else {}),
     }
 
 
@@ -421,7 +432,11 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
     certifies the sharded engine's correctness and topology plumbing, not
     accelerator speed (`_child` forces the platform via
     `_cpu_mesh.force_host_cpu_devices` before any jax backend init, the
-    same trick as the MULTICHIP dryrun)."""
+    same trick as the MULTICHIP dryrun). A final tp=2 A/B re-serves the
+    wave through the int8 KV arena with the EQuARX quantized all-reduce,
+    reporting decode-step p50/p95 beside the f32 fields plus a greedy
+    parity rate; its collective counts ride the same `collectives` dict
+    so the quantized program's shape is trajectory-locked too."""
     del on_tpu  # forced to the fake CPU mesh by _child
     import jax
 
@@ -439,8 +454,8 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
     prompts = [rs.randint(0, cfg.vocab_size, (n,)).tolist() for n in lens]
     max_new = 8 if _fast() else 16
 
-    def wave(mesh):
-        eng = LLMEngine(model, block_size=16, max_batch=4, mesh=mesh)
+    def wave(mesh, **kw):
+        eng = LLMEngine(model, block_size=16, max_batch=4, mesh=mesh, **kw)
         # warm: compiles the touched width-bucket programs outside the
         # timing, then reset step timings so decode p50/p95 describe the
         # measured wave only
@@ -459,11 +474,14 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
                     ("mixed_steps", "decode_steps", "verify_steps")) - t0_steps
         syncs = eng.metrics.counters.get("host_syncs", 0) - t0_syncs
         dec = eng.metrics.latency_summary().get("decode_step", {})
+        st = eng.pool_stats()
         facts = {
             "decode_step_p50_ms": round(dec.get("p50_ms", 0.0), 3),
             "decode_step_p95_ms": round(dec.get("p95_ms", 0.0), 3),
             "host_syncs_per_step": (round(syncs / steps, 3) if steps
                                     else None),
+            "kv_dtype": st["kv_dtype"],
+            "kv_bytes_per_block": st["kv_bytes_per_block"],
         }
         return outs, (toks / dt if dt > 0 else 0.0), eng, facts
 
@@ -511,6 +529,35 @@ def bench_gpt_serve_multichip(on_tpu, errors, deadline_s):
              f"sharded_parity: {parity}")
     if "tp2_tok_s" not in out:
         return None
+    # sharded-decode step-time A/B: the SAME tp=2 wave through the int8
+    # KV arena + EQuARX quantized RowParallel all-reduce. Decode-step
+    # p50/p95 land next to the f32 fields above (the ratio is THE metric
+    # — a quantized step that got slower means the dequant left VMEM or
+    # the quantized collective regressed), plus tok/s, bytes/block, and
+    # the greedy per-request parity rate vs the single-chip f32 reference
+    # (recorded, not errored: tests/test_int8_kv.py owns the rate gate).
+    if time.monotonic() <= deadline_s:
+        try:
+            outs, tok_s, eng, facts = wave(2, kv_dtype="int8",
+                                           quant_allreduce=True)
+        except Exception as e:  # noqa: BLE001 — f32 waves already landed
+            errors.append(f"gpt_serve_multichip: int8 tp=2 wave: "
+                          f"{type(e).__name__}: {str(e)[:200]}")
+        else:
+            out["tp2_int8_tok_s"] = round(tok_s, 1)
+            out.update({f"tp2_int8_{k}": v for k, v in facts.items()})
+            out["tp2_int8_parity_rate"] = round(
+                sum(a == b for a, b in zip(outs, ref_outs)) / len(ref_outs),
+                3) if ref_outs else 0.0
+            out["tp2_int8_quant_collectives"] = sorted(
+                eng.quant_collectives)
+            p50_f32 = out.get("tp2_decode_step_p50_ms") or 0.0
+            out["tp2_int8_decode_p50_ratio"] = round(
+                facts["decode_step_p50_ms"] / p50_f32, 3) if p50_f32 else None
+            engines["tp2_int8"] = eng
+            _log(f"multichip serve tp=2 int8: {tok_s:.1f} tok/s "
+                 f"decode p50 ratio {out['tp2_int8_decode_p50_ratio']} "
+                 f"parity rate {out['tp2_int8_parity_rate']}")
     # collective counts come LAST: the drift metric is order-independent,
     # and its lowering+compiling must never eat deadline budget the tp
     # waves (the primary tok/s + parity measurement) still need
@@ -1158,6 +1205,94 @@ def _serve_spec_wave(model, cfg, max_batch, rs, errors, deadline_s, on_tpu):
         "spec_proposed_tokens": int(d["spec_proposed_tokens"]),
         "spec_accepted_tokens": int(d["spec_accepted_tokens"]),
     }
+
+
+def _serve_int8_overcap(model, cfg, rs, errors, deadline_s):
+    """Int8-vs-weight-dtype KV arena at the SAME per-chip byte budget
+    (`kv_hbm_bytes`): the quantized arena's smaller blocks buy ~2x (bf16)
+    to ~4x (f32) the capacity, so an over-capacity wave that churns the
+    baseline engine through preemptions mostly fits resident on int8.
+    Reports blocks bought per dtype, bytes/block, preemptions, tok/s, and
+    the greedy token parity rate between the two engines — the tier-1
+    quality gate (tests/test_int8_kv.py) locks the rate; the bench line
+    records the measured value so the trajectory sees quantization drift
+    before the gate trips."""
+    from paddle_tpu.serving import LLMEngine
+
+    if time.monotonic() > deadline_s:
+        errors.append("gpt_serve: deadline before int8 overcap wave")
+        return None
+    bs, max_seq, max_new, n_req = 16, 128, 8, 8
+    head_dim = cfg.hidden_size // cfg.num_heads
+    itemsize = model.wte.weight._array.dtype.itemsize
+    per_block = 2 * cfg.num_layers * cfg.num_heads * bs * head_dim * itemsize
+    # ~12 baseline blocks: enough for one max_seq sequence (+null) but
+    # well under the wave's working set, so the baseline engine churns
+    budget = 12 * per_block
+    prompts = [rs.randint(0, cfg.vocab_size, (96,)).tolist()
+               for _ in range(n_req)]
+
+    def wave(kv_dtype):
+        eng = LLMEngine(model, block_size=bs, max_batch=4,
+                        max_seq_len=max_seq, kv_hbm_bytes=budget,
+                        kv_dtype=kv_dtype)
+        eng.generate([prompts[0][:24]], max_new_tokens=2,
+                     temperature=0.0)                          # prime
+        eng.metrics.reset_schedule()
+        t0_tok = eng.metrics.counters["generated_tokens"]
+        t0_pre = eng.metrics.counters.get("preemptions", 0)
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new_tokens=max_new, temperature=0.0)
+                for p in prompts]
+        while eng.has_unfinished():
+            if time.monotonic() > deadline_s:
+                errors.append("gpt_serve: deadline mid int8 overcap "
+                              "wave; comparison dropped")
+                for rid in list(eng._requests):
+                    eng.abort(rid)
+                return None, None
+            eng.step()
+        dt = time.perf_counter() - t0
+        outs = [tuple(eng._requests[r].output_ids) for r in rids]
+        for r in rids:
+            eng.release(r)
+        toks = eng.metrics.counters["generated_tokens"] - t0_tok
+        st = eng.pool_stats()
+        return outs, {
+            "kv_dtype": st["kv_dtype"],
+            "num_blocks": st["blocks_total"],
+            "kv_bytes_per_block": st["kv_bytes_per_block"],
+            "preemptions": int(eng.metrics.counters.get("preemptions", 0)
+                               - t0_pre),
+            "tok_s": round(toks / dt, 1) if dt else 0.0,
+        }
+
+    try:
+        base_outs, base = wave(None)
+        if base is None or time.monotonic() > deadline_s:
+            return None
+        q_outs, quant = wave("int8")
+    except Exception as e:  # noqa: BLE001 — the main wave already landed
+        errors.append(f"gpt_serve int8 overcap wave: {type(e).__name__}: "
+                      f"{str(e)[:200]}")
+        return None
+    if quant is None:
+        return None
+    matched = sum(a == b for a, b in zip(base_outs, q_outs))
+    out = {
+        "kv_hbm_bytes": budget,
+        "requests": n_req,
+        "base": base,
+        "int8": quant,
+        "capacity_ratio": round(quant["num_blocks"] / base["num_blocks"], 2),
+        "greedy_parity_rate": round(matched / n_req, 3) if n_req else 0.0,
+    }
+    _log(f"int8 overcap: {quant['num_blocks']} blocks "
+         f"({quant['tok_s']} tok/s, {quant['preemptions']} preempt) vs "
+         f"{base['num_blocks']} {base['kv_dtype']} blocks "
+         f"({base['tok_s']} tok/s, {base['preemptions']} preempt), "
+         f"parity {out['greedy_parity_rate']}")
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -350,22 +350,32 @@ def tiny_gpt_config():
                      dropout=0.0)
 
 
-def build_serving_engine(model, tp_degree):
+def build_serving_engine(model, tp_degree, kv_dtype=None,
+                         quant_allreduce=None):
     """The harness engine: spec decoding ON so every default width
     bucket exists (w1 decode, w4 spec, w8 chunk); mesh=1 is the explicit
     single-chip request (beats a stray PADDLE_TPU_TP env,
-    serving/sharded.py)."""
+    serving/sharded.py). ``kv_dtype``/``quant_allreduce`` select the
+    int8 program family (quantized arena + EQuARX collectives)."""
     from ..serving.engine import LLMEngine
 
     return LLMEngine(model, block_size=8, max_batch=2, prefill_chunk=8,
                      mesh=tp_degree, spec_decoding=True, num_spec_tokens=3,
-                     host_kv_blocks=8)
+                     host_kv_blocks=8, kv_dtype=kv_dtype,
+                     quant_allreduce=quant_allreduce)
 
 
-def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
+def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None,
+                      kv_dtype=None, quant_allreduce=None, prefix="serve",
+                      include_swap=None):
     """Lower + compile the engine's width-bucket programs at each tp
     degree; returns [ProgramArtifact]. `kinds` restricts to a name
-    subset (the seeded-regression tests lower just "w1")."""
+    subset (the seeded-regression tests lower just "w1");
+    `include_swap` overrides the default "swap programs only on the
+    full set" rule. `kv_dtype`/`quant_allreduce` build the int8 family
+    under its own `prefix` — the budget derives from the ENGINE's
+    resolved `quant_collectives` (per-op gating), so IR001 locks the
+    quantized collective shape exactly."""
     import jax
 
     from ..models.gpt import GPT
@@ -373,11 +383,17 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
 
     if model is None:
         model = GPT(tiny_gpt_config())
+    if include_swap is None:
+        include_swap = kinds is None
     arts = []
     for tp in tp_degrees:
-        eng = build_serving_engine(model, tp)
+        eng = build_serving_engine(model, tp, kv_dtype=kv_dtype,
+                                   quant_allreduce=quant_allreduce)
         spec = eng.step_program_spec()
-        budget = serving_collective_budget(model.cfg, tp)
+        budget = serving_collective_budget(
+            model.cfg, tp, quant_collectives=eng.quant_collectives)
+        arena_what = ("KV arena (k, v, k_scale, v_scale)"
+                      if eng.pool.quantized else "KV arena (k, v)")
         for name, lowered in eng.lowered_step_programs(kinds=kinds).items():
             expected = {
                 "collective_budget": budget,
@@ -385,7 +401,7 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
                     "expected": spec["donation_expected"],
                     "param_indices": spec["arena_param_indices"],
                     "output_indices": spec["arena_output_indices"][name],
-                    "what": "KV arena (k, v)",
+                    "what": arena_what,
                 },
                 "custom_call_whitelist": DEFAULT_CUSTOM_CALL_WHITELIST,
                 # IR005: the program tail (post-attention sampling, spec
@@ -395,9 +411,9 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
                 "sampler_region": True,
             }
             arts.append(artifact_from_compiled(
-                f"serve/tp{tp}/{name}", name, tp,
+                f"{prefix}/tp{tp}/{name}", name, tp,
                 jax.default_backend(), lowered.compile(), expected))
-        if kinds is not None:
+        if not include_swap:
             continue   # restricted step subset: skip the swap programs
         # the host-tier swap copies (serving/kv_tier.py): the swap-in
         # scatter must donate the arenas under the same gate as the step
@@ -413,12 +429,12 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None):
                     "param_indices": sspec["arena_param_indices"],
                     "output_indices":
                         sspec["arena_output_indices"].get(name),
-                    "what": "KV arena (k, v)",
+                    "what": arena_what,
                 },
                 "custom_call_whitelist": DEFAULT_CUSTOM_CALL_WHITELIST,
             }
             arts.append(artifact_from_compiled(
-                f"serve/tp{tp}/{name}", name, tp,
+                f"{prefix}/tp{tp}/{name}", name, tp,
                 jax.default_backend(), lowered.compile(), expected))
     return arts
 
@@ -472,9 +488,15 @@ def train_artifact(mesh_degrees=None):
 
 def default_artifacts():
     """The registered program set the CLI and the tier-1 gate evaluate:
-    the unified step at every width bucket x {tp=1, tp=2} + the
-    dp2 x mp2 train step."""
+    the unified step at every width bucket x {tp=1, tp=2} + the int8
+    end-to-end family (quantized arena + EQuARX collectives; the w1
+    decode step and the 4-array swap copies — the widths share one
+    quantization story, so w1 pins the shape without tripling compile
+    time) + the dp2 x mp2 train step."""
     arts = serving_artifacts()
+    arts += serving_artifacts(kinds=("w1",), kv_dtype="int8",
+                              quant_allreduce=True, prefix="serve_int8",
+                              include_swap=True)
     arts.append(train_artifact())
     return arts
 

@@ -73,6 +73,28 @@ def _split_fused_qkv(qkv, b, s, num_heads, head_dim):
     return q, k, v
 
 
+def _serving_row_parallel(layer, x, op_name, cache):
+    """RowParallel output projection on the paged serving path: routed
+    through the EQuARX-quantized collective (serving/sharded.py
+    `quantized_row_parallel` — int8 payload + per-shard scale instead of
+    the f32 psum) when the threaded-through `PagedState` gates `op_name`
+    on, the plain layer otherwise. The gate lives on the state, not the
+    module, so ONE model serves quantized and f32 engines at once and
+    the training path never sees it (GSPMD's implicit training-mesh
+    all-reduce has no jnp-level seam to quantize)."""
+    st = getattr(cache, "state", cache)
+    if (getattr(st, "mesh", None) is not None
+            and op_name in getattr(st, "quant_collectives", ())):
+        from ..serving.sharded import quantized_row_parallel
+
+        o = quantized_row_parallel(
+            x._array, layer.weight._array,
+            None if layer.bias is None else layer.bias._array,
+            st.mesh)
+        return Tensor._from_op(o)
+    return layer(x)
+
+
 class CausalSelfAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -105,7 +127,8 @@ class CausalSelfAttention(nn.Layer):
             out = M.reshape(
                 Tensor._from_op(o), [b, s, self.num_heads * self.head_dim]
             )
-            return self.proj(out), cache
+            return _serving_row_parallel(self.proj, out, "attn_proj",
+                                         cache), cache
         if cache is not None:
             # incremental decode: fixed-size KV cache so every step compiles
             # once (reference fused_multi_transformer's cache_kv role).
@@ -167,7 +190,8 @@ class GPTBlock(nn.Layer):
         if cache is not None:
             attn_out, new_cache = self.attn(self.ln1(x), cache=cache)
             x = x + attn_out
-            x = x + self.fc2(self.act(self.fc1(self.ln2(x))))
+            x = x + _serving_row_parallel(
+                self.fc2, self.act(self.fc1(self.ln2(x))), "ffn_fc2", cache)
             return x, new_cache
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = _constraint(x, "dp", "sp", None)

@@ -63,19 +63,27 @@ _NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 def paged_attention_xla(q, k_arena, v_arena, layer, block_tables, qpos,
-                        scale=None):
+                        scale=None, k_scale=None, v_scale=None):
     """Reference paged attention: gather the full padded block table.
 
     q: [B, S, H, D]; arenas: [layers, H, num_blocks, block_size, D];
     block_tables: [B, max_blocks] int32 (0 = null block); qpos: [B, S]
     absolute query positions (padding rows/cols carry 0 and are discarded
-    by the caller). Returns [B, S, H, D].
+    by the caller). `k_scale`/`v_scale` [layers, H, num_blocks] dequantize
+    an int8 arena BEFORE the einsum, so this path stays the correctness
+    reference that brackets the kernel's in-VMEM dequant. Returns
+    [B, S, H, D].
     """
     B, S, H, D = q.shape
     if scale is None:
         scale = 1.0 / np.sqrt(D)
     k_seq = k_arena[layer][:, block_tables]  # [H, B, nb, bs, D]
     v_seq = v_arena[layer][:, block_tables]
+    if k_scale is not None:
+        ksc = k_scale[layer][:, block_tables]  # [H, B, nb]
+        vsc = v_scale[layer][:, block_tables]
+        k_seq = k_seq.astype(jnp.float32) * ksc[..., None, None]
+        v_seq = v_seq.astype(jnp.float32) * vsc[..., None, None]
     nb, bs = k_seq.shape[2], k_seq.shape[3]
     L = nb * bs
     # back to the [B, L, H, D] layout of models/gpt.py's contiguous-cache
@@ -98,7 +106,7 @@ def paged_attention_xla(q, k_arena, v_arena, layer, block_tables, qpos,
 # ---------------------------------------------------------------------------
 
 def _ragged_kernel(bt_ref, qs_ref, kl_ref, qb_ref, q_ref, k_ref, v_ref,
-                   o_ref, m_ref, l_ref, acc_ref, *, bs, qt, scale):
+                   *rest, bs, qt, scale, quant):
     """One (row, head, q-block) tile's online-softmax walk over its live
     KV blocks.
 
@@ -108,8 +116,19 @@ def _ragged_kernel(bt_ref, qs_ref, kl_ref, qb_ref, q_ref, k_ref, v_ref,
     what makes query length ragged PER ROW: a decode row (1 live query
     token) riding a wide mixed/verify program computes only its first
     ``qt``-wide query tile — dead q blocks re-address the last live tile
-    (no DMA) and skip all compute, exactly like the dead KV iterations."""
+    (no DMA) and skip all compute, exactly like the dead KV iterations.
+
+    ``quant`` (int8 arena): two extra per-(layer, head, block) f32 scale
+    refs ride the same kv index map, and each DMA'd int8 tile dequantizes
+    IN VMEM (one multiply per tile) before the MXU dot — the arena walk
+    moves a quarter of the f32 bytes and the compute path is unchanged."""
     from jax.experimental import pallas as pl
+
+    if quant:
+        ksc_ref, vsc_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ksc_ref = vsc_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
 
     i = pl.program_id(0)   # batch row
     qb = pl.program_id(2)  # query block
@@ -126,6 +145,8 @@ def _ragged_kernel(bt_ref, qs_ref, kl_ref, qb_ref, q_ref, k_ref, v_ref,
     def _():
         q = q_ref[0, 0]        # [qt, D]
         kt = k_ref[0, 0, 0]    # [bs, D]
+        if quant:
+            kt = kt.astype(jnp.float32) * ksc_ref[0, 0, 0]
         s = jax.lax.dot_general(
             q, kt, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -144,6 +165,8 @@ def _ragged_kernel(bt_ref, qs_ref, kl_ref, qb_ref, q_ref, k_ref, v_ref,
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         vt = v_ref[0, 0, 0]    # [bs, D]
+        if quant:
+            vt = vt.astype(jnp.float32) * vsc_ref[0, 0, 0]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(vt.dtype), vt, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -168,7 +191,8 @@ def _q_tile(S):
 
 
 @functools.lru_cache(maxsize=None)
-def _build_ragged(B, H, sq, d, bs, nk, layer, dtype_name, interpret):
+def _build_ragged(B, H, sq, d, bs, nk, layer, dtype_name, interpret,
+                  quant=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -191,14 +215,25 @@ def _build_ragged(B, H, sq, d, bs, nk, layer, dtype_name, interpret):
         jc = jnp.where(qb < qlb[i], jnp.minimum(j, kl[i] - 1), kl[i] - 1)
         return (layer, h, bt[i, jc], 0, 0)
 
+    def sc_index(i, h, qb, j, bt, qs, kl, qlb):
+        # the int8 scale sidecars [layers, H, num_blocks] walk the SAME
+        # clamped block index as the payload tiles — one f32 scalar rides
+        # along with each [bs, d] int8 tile's DMA
+        jc = jnp.where(qb < qlb[i], jnp.minimum(j, kl[i] - 1), kl[i] - 1)
+        return (layer, h, bt[i, jc])
+
+    in_specs = [
+        pl.BlockSpec((1, 1, qt, d), q_index),
+        pl.BlockSpec((1, 1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, 1, bs, d), kv_index),
+    ]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, 1), sc_index),
+                     pl.BlockSpec((1, 1, 1), sc_index)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, qt, d), q_index),
-            pl.BlockSpec((1, 1, 1, bs, d), kv_index),
-            pl.BlockSpec((1, 1, 1, bs, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, qt, d), q_index),
         scratch_shapes=[
             pltpu.VMEM((qt, 1), jnp.float32),   # running max m
@@ -207,7 +242,8 @@ def _build_ragged(B, H, sq, d, bs, nk, layer, dtype_name, interpret):
         ],
     )
     return pl.pallas_call(
-        functools.partial(_ragged_kernel, bs=bs, qt=qt, scale=scale),
+        functools.partial(_ragged_kernel, bs=bs, qt=qt, scale=scale,
+                          quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, sq, d), jnp.dtype(dtype_name)),
         interpret=interpret,
@@ -215,7 +251,8 @@ def _build_ragged(B, H, sq, d, bs, nk, layer, dtype_name, interpret):
 
 
 def ragged_paged_attention(q, k_arena, v_arena, layer, block_tables,
-                           q_start, kv_live, q_lens=None, interpret=False):
+                           q_start, kv_live, q_lens=None, interpret=False,
+                           k_scale=None, v_scale=None):
     """Pallas ragged paged attention over live KV blocks — and live
     QUERY tiles — only.
 
@@ -224,14 +261,17 @@ def ragged_paged_attention(q, k_arena, v_arena, layer, block_tables,
     row; kv_live: [B] number of live KV blocks per row (>= 1); q_lens:
     [B] live query tokens per row (ragged widths — a decode row riding a
     wide program declares 1 and pays one query tile; None means every
-    row is full-width). Returns [B, S, H, D]. Rows/columns beyond each
-    row's live tokens hold garbage — the engine discards them.
+    row is full-width). `k_scale`/`v_scale` [layers, H, num_blocks]
+    switch the kernel to int8 arenas with in-VMEM dequant. Returns
+    [B, S, H, D]. Rows/columns beyond each row's live tokens hold
+    garbage — the engine discards them.
     """
     B, S, H, D = q.shape
     bs = k_arena.shape[3]
     nk = block_tables.shape[1]
+    quant = k_scale is not None
     fn = _build_ragged(B, H, S, D, bs, nk, int(layer), str(q.dtype),
-                       bool(interpret))
+                       bool(interpret), quant=quant)
     qt = _q_tile(S)
     if q_lens is None:
         qb_live = jnp.full((B,), S // qt, jnp.int32)
@@ -241,12 +281,15 @@ def ragged_paged_attention(q, k_arena, v_arena, layer, block_tables,
         ql = jnp.maximum(q_lens.astype(jnp.int32), 1)
         qb_live = (ql + qt - 1) // qt
     qh = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, S, D]
+    operands = (qh, k_arena, v_arena)
+    if quant:
+        operands += (k_scale, v_scale)
     o = fn(
         block_tables.astype(jnp.int32),
         q_start.astype(jnp.int32),
         jnp.maximum(kv_live.astype(jnp.int32), 1),
         qb_live,
-        qh, k_arena, v_arena,
+        *operands,
     )
     return jnp.transpose(o, (0, 2, 1, 3))
 
@@ -258,7 +301,8 @@ def ragged_paged_attention(q, k_arena, v_arena, layer, block_tables,
 def ragged_paged_attention_sharded(q, k_arena, v_arena, layer, block_tables,
                                    q_start, kv_live, q_lens=None,
                                    mesh=None, tp_axis="tp",
-                                   interpret=False):
+                                   interpret=False,
+                                   k_scale=None, v_scale=None):
     """Per-shard dispatch of the single-device ragged kernel on a tp mesh.
 
     The kernel walks one (row, head, block) grid and DMAs (head, block)
@@ -278,6 +322,26 @@ def ragged_paged_attention_sharded(q, k_arena, v_arena, layer, block_tables,
     if q_lens is None:
         q_lens = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
 
+    quant = k_scale is not None
+    if quant:
+        # scale sidecars [layers, H, num_blocks] shard over the same head
+        # axis as the arenas — each shard dequantizes with its local heads'
+        # scales and no collective is introduced
+        def local(qh, ka, va, ks, vs, bt, qs, kl, ql):
+            return ragged_paged_attention(qh, ka, va, layer, bt, qs, kl,
+                                          q_lens=ql, interpret=interpret,
+                                          k_scale=ks, v_scale=vs)
+
+        fn = shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, None, tp_axis, None), P(None, tp_axis),
+                      P(None, tp_axis), P(None, tp_axis), P(None, tp_axis),
+                      P(), P(), P(), P()),
+            out_specs=P(None, None, tp_axis, None),
+        )
+        return fn(q, k_arena, v_arena, k_scale, v_scale,
+                  block_tables, q_start, kv_live, q_lens)
+
     def local(qh, ka, va, bt, qs, kl, ql):
         return ragged_paged_attention(qh, ka, va, layer, bt, qs, kl,
                                       q_lens=ql, interpret=interpret)
@@ -296,7 +360,8 @@ def ragged_paged_attention_sharded(q, k_arena, v_arena, layer, block_tables,
 
 def paged_attention_arrays(q, k_arena, v_arena, layer, block_tables, qpos,
                            q_start=None, kv_live=None, q_lens=None,
-                           scale=None, mesh=None, tp_axis="tp"):
+                           scale=None, mesh=None, tp_axis="tp",
+                           k_scale=None, v_scale=None):
     """Attend q through the block table: Pallas ragged kernel when the
     backend gate and the ragged metadata allow it, XLA gather otherwise.
     `q_lens` (per-row live query counts) makes the kernel ragged in the
@@ -317,10 +382,12 @@ def paged_attention_arrays(q, k_arena, v_arena, layer, block_tables, qpos,
                 q, k_arena, v_arena, layer, block_tables, q_start, kv_live,
                 q_lens=q_lens, mesh=mesh, tp_axis=tp_axis,
                 interpret=interpret_mode(),
+                k_scale=k_scale, v_scale=v_scale,
             )
         return ragged_paged_attention(
             q, k_arena, v_arena, layer, block_tables, q_start, kv_live,
             q_lens=q_lens, interpret=interpret_mode(),
+            k_scale=k_scale, v_scale=v_scale,
         )
     return paged_attention_xla(q, k_arena, v_arena, layer, block_tables,
-                               qpos, scale)
+                               qpos, scale, k_scale=k_scale, v_scale=v_scale)

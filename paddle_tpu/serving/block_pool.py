@@ -114,15 +114,30 @@ class PagedState:
                     declares 1 and the kernel computes one query tile;
                     None = every row full-width)
 
+    Int8 KV (``kv_dtype="int8"`` on the pool) adds four more:
+      k_scale, v_scale  [layers, heads, num_blocks] float32 — per-block
+                    per-head dequant scales (the head-major arena's
+                    natural sidecar). None on f32 engines.
+      touched       [B, T] int32 — the block ids this step's scatter can
+                    write per row, slot 0 reserved for the null block
+                    (padded tokens route their scale updates there)
+      touch_idx     [B, S] int32 — each fed token's index into its row's
+                    `touched` list (0 = the null slot)
+
     `mesh` (static, not an array) is the tensor-parallel serving mesh
     (serving/sharded.py) or None: it selects the per-shard Pallas dispatch
     and lets `constrain` pin traced activations to the tp layout.
+    `quant_collectives` (static frozenset) names the RowParallel output
+    projections whose tp all-reduce runs quantized (serving/sharded.py
+    `quantized_row_parallel`); models/gpt.py consults it per op.
     """
 
     is_paged = True
 
     def __init__(self, k, v, block_tables, slots, offs, qpos,
-                 q_start=None, kv_live=None, q_lens=None, mesh=None):
+                 q_start=None, kv_live=None, q_lens=None, mesh=None,
+                 k_scale=None, v_scale=None, touched=None, touch_idx=None,
+                 quant_collectives=frozenset()):
         self.k = k
         self.v = v
         self.block_tables = block_tables
@@ -133,6 +148,11 @@ class PagedState:
         self.kv_live = kv_live
         self.q_lens = q_lens
         self.mesh = mesh
+        self.k_scale = k_scale
+        self.v_scale = v_scale
+        self.touched = touched
+        self.touch_idx = touch_idx
+        self.quant_collectives = quant_collectives
 
     def layer(self, i):
         return PagedLayerView(self, i)
@@ -150,6 +170,61 @@ class PagedState:
         return jax.lax.with_sharding_constraint(
             arr, NamedSharding(self.mesh, PartitionSpec(*spec))
         )
+
+
+def _quantize_scatter(arena, scales, layer, new, slots, offs, touched,
+                      touch_idx):
+    """Int8 arena append with per-(layer, head, block) scale growth.
+
+    `new` [B, S, H, D] f32 tokens land in blocks `slots`/`offs`; every
+    block the step can write is listed in `touched` [B, T] (slot 0 = the
+    null block) and `touch_idx` [B, S] maps each token to its row's
+    touched slot. Scales only GROW while a block is owned — when a new
+    token's per-head absmax exceeds the block's stored scale, the block's
+    EXISTING int8 payload is requantized (gather → rescale → set) to the
+    grown scale before the new tokens scatter, so earlier tokens keep
+    dequantizing correctly. A block's first write under its current owner
+    always carries offset 0 (positions are consecutive; preempt-by-
+    recompute and spec rollback both restart at the block head), so
+    ``offs == 0`` marks the block fresh and its STALE scale from a prior
+    occupant is ignored instead of compounding across reuse. Duplicate
+    `touched` entries only ever name the null block, whose payload/scale
+    are scratch. Returns the updated (arena, scales)."""
+    import jax.numpy as jnp
+
+    B, S, H, Dh = new.shape
+    T = touched.shape[1]
+    flat_t = touched.reshape(-1)                            # [B*T]
+    gidx = (touch_idx.astype(jnp.int32)
+            + jnp.arange(B, dtype=jnp.int32)[:, None] * T).reshape(-1)
+    am = jnp.max(jnp.abs(new.astype(jnp.float32)), axis=3)  # [B, S, H]
+    blk_am = jnp.zeros((B * T, H), jnp.float32).at[gidx].max(
+        am.reshape(B * S, H))
+    fresh = jnp.zeros((B * T,), jnp.float32).at[gidx].max(
+        (offs.reshape(-1) == 0).astype(jnp.float32)) > 0.0
+    old_sc = scales[layer][:, flat_t]                       # [H, B*T]
+    old_eff = jnp.where(fresh[None, :], 0.0, old_sc)
+    new_sc = jnp.maximum(jnp.maximum(old_eff, blk_am.T / 127.0), 1e-8)
+    # requantize the touched blocks' existing payload to the grown scale
+    # (fresh blocks have ratio 0 — their stale bytes zero out, which also
+    # clears a recycled block's prior occupant)
+    ratio = old_eff / new_sc
+    old_q = arena[layer][:, flat_t]                         # [H, B*T, bs, D]
+    req = jnp.clip(jnp.round(old_q.astype(jnp.float32)
+                             * ratio[..., None, None]), -127, 127)
+    # NB: in ``arena.at[layer, :, flat_t]`` the scalar `layer` and the
+    # index array are advanced indices SEPARATED by a slice, so the
+    # broadcast dims land at the FRONT of the updated slice: it has shape
+    # [B*T, H, ...], hence the swap/transpose on the updates
+    arena = arena.at[layer, :, flat_t].set(
+        jnp.swapaxes(req, 0, 1).astype(arena.dtype))
+    scales = scales.at[layer, :, flat_t].set(new_sc.T)
+    # quantize the new tokens at their block's (grown) scale and scatter
+    tok_sc = new_sc.T[gidx].reshape(B, S, H)                # [B, S, H]
+    qn = jnp.clip(jnp.round(new.astype(jnp.float32) / tok_sc[..., None]),
+                  -127, 127)
+    arena = arena.at[layer, :, slots, offs].set(qn.astype(arena.dtype))
+    return arena, scales
 
 
 def paged_attention(q, k_new, v_new, view, scale=None):
@@ -170,16 +245,31 @@ def paged_attention(q, k_new, v_new, view, scale=None):
         q = st.constrain(q, None, None, "tp", None)
         k_new = st.constrain(k_new, None, None, "tp", None)
         v_new = st.constrain(v_new, None, None, "tp", None)
-    # scatter the step's K/V rows into their (block, offset) homes; padded
-    # and inactive rows carry slot 0 (the null block). The advanced indices
-    # (layer, slots, offs) are separated by the head-axis slice, so the
-    # indexed view is [B, S, heads, head_dim] — k_new's own layout.
-    st.k = st.k.at[layer, :, st.slots, st.offs].set(k_new.astype(st.k.dtype))
-    st.v = st.v.at[layer, :, st.slots, st.offs].set(v_new.astype(st.v.dtype))
+    if st.k_scale is not None:
+        # int8 arena: quantize at the scatter, scales growing per touched
+        # block (dequant happens inside the Pallas kernel / before the
+        # XLA fallback's einsum — ops/pallas/paged_attention.py)
+        st.k, st.k_scale = _quantize_scatter(
+            st.k, st.k_scale, layer, k_new, st.slots, st.offs,
+            st.touched, st.touch_idx)
+        st.v, st.v_scale = _quantize_scatter(
+            st.v, st.v_scale, layer, v_new, st.slots, st.offs,
+            st.touched, st.touch_idx)
+    else:
+        # scatter the step's K/V rows into their (block, offset) homes;
+        # padded and inactive rows carry slot 0 (the null block). The
+        # advanced indices (layer, slots, offs) are separated by the
+        # head-axis slice, so the indexed view is [B, S, heads, head_dim]
+        # — k_new's own layout.
+        st.k = st.k.at[layer, :, st.slots, st.offs].set(
+            k_new.astype(st.k.dtype))
+        st.v = st.v.at[layer, :, st.slots, st.offs].set(
+            v_new.astype(st.v.dtype))
     return paged_attention_arrays(
         q, st.k, st.v, layer, st.block_tables, st.qpos,
         q_start=st.q_start, kv_live=st.kv_live, q_lens=st.q_lens,
         scale=scale, mesh=st.mesh,
+        k_scale=st.k_scale, v_scale=st.v_scale,
     )
 
 
@@ -202,7 +292,7 @@ class BlockPool:
 
     def __init__(self, num_blocks, num_layers, block_size, num_heads,
                  head_dim, dtype=None, metrics=None, tracer=None,
-                 sharding=None):
+                 sharding=None, kv_dtype=None):
         import jax.numpy as jnp
 
         if num_blocks < 2:
@@ -211,7 +301,15 @@ class BlockPool:
         self.block_size = int(block_size)
         shape = (num_layers, num_heads, self.num_blocks, self.block_size,
                  head_dim)
-        dt = dtype or jnp.float32
+        # `kv_dtype="int8"`: the arena stores int8 payloads with
+        # per-(layer, head, block) f32 dequant scales in `k_scale`/
+        # `v_scale` sidecars [layers, heads, num_blocks]. Anything else
+        # (None / a float dtype) keeps the plain float arena with no
+        # sidecars — every int8 hook below is one `self.quantized` test.
+        self.kv_dtype = (str(kv_dtype) if kv_dtype is not None
+                         else str(jnp.dtype(dtype or jnp.float32).name))
+        self.quantized = self.kv_dtype == "int8"
+        dt = jnp.int8 if self.quantized else (dtype or jnp.float32)
         # `sharding` (tensor-parallel serving, serving/sharded.py): a
         # NamedSharding placing the head axis over tp — each chip owns its
         # heads' slab of every block. ALL host bookkeeping below (free
@@ -219,9 +317,12 @@ class BlockPool:
         # to the single-chip pool: sharding changes where bytes live,
         # never which block ids exist.
         self._sharding = sharding
+        sc_shape = shape[:3]   # [layers, heads, num_blocks] sidecar
         if sharding is None:
             self.k = jnp.zeros(shape, dt)
             self.v = jnp.zeros(shape, dt)
+            self.k_scale = jnp.zeros(sc_shape) if self.quantized else None
+            self.v_scale = jnp.zeros(sc_shape) if self.quantized else None
         else:
             # the shared cached jit-with-out_shardings builder: allocates
             # the arena SHARDED from the start — eager zeros + device_put
@@ -234,6 +335,13 @@ class BlockPool:
             zeros = _sharded_zeros_fn(shape, str(jnp.dtype(dt)), sharding)
             self.k = zeros()
             self.v = zeros()
+            self.k_scale = self.v_scale = None
+            if self.quantized:
+                # same NamedSharding: its PartitionSpec (None, 'tp')
+                # shards the sidecar's head axis exactly like the arena's
+                sc_zeros = _sharded_zeros_fn(sc_shape, "float32", sharding)
+                self.k_scale = sc_zeros()
+                self.v_scale = sc_zeros()
         # block 0 reserved as the null/scratch block
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._refcount = {}           # block -> holders (held blocks only)
@@ -277,6 +385,17 @@ class BlockPool:
     def blocks_for(self, num_tokens):
         """How many blocks a sequence of `num_tokens` tokens needs."""
         return blocks_for(num_tokens, self.block_size)
+
+    def bytes_per_block(self):
+        """Device bytes one LOGICAL block costs in the active KV dtype —
+        K + V payloads plus (int8 arenas) their per-head scale sidecar
+        entries. The observability twin of `sharded.kv_capacity_blocks`'s
+        per-shard formula: pool_stats/healthz/bench all report THIS."""
+        L, H, _, Bs, D = self.k.shape
+        per = 2 * L * H * Bs * D * self.k.dtype.itemsize
+        if self.quantized:
+            per += 2 * L * H * self.k_scale.dtype.itemsize
+        return per
 
     def refcount(self, block):
         """Holders of `block` (0 = free or cached-free)."""
@@ -438,6 +557,16 @@ class BlockPool:
                 return (k.at[:, :, d].set(k[:, :, s]),
                         v.at[:, :, d].set(v[:, :, s]))
 
+            def _copy_q(k, v, ks, vs, s, d):
+                # int8 arenas: the COW clone must carry its source's
+                # dequant scales or the copy dequantizes garbage
+                return (k.at[:, :, d].set(k[:, :, s]),
+                        v.at[:, :, d].set(v[:, :, s]),
+                        ks.at[:, :, d].set(ks[:, :, s]),
+                        vs.at[:, :, d].set(vs[:, :, s]))
+
+            fn = _copy_q if self.quantized else _copy
+            nargs = (0, 1, 2, 3) if self.quantized else (0, 1)
             if self._sharding is not None:
                 # sharded arenas: donation MUST route through the JL004
                 # gate — the host-platform CPU mesh miscompiles donated
@@ -446,14 +575,17 @@ class BlockPool:
                 from ..parallel.spmd import mesh_donate_argnums
 
                 self._copy_fn = jax.jit(
-                    _copy, donate_argnums=mesh_donate_argnums((0, 1)))
+                    fn, donate_argnums=mesh_donate_argnums(nargs))
             else:
-                # jaxlint: disable=JL004 -- COW scatter donates the single-device KV arenas in place; gating would materialize a full arena copy per COW on CPU (see docstring). Not IR-checkable directly: hlolint lowers the engine's step programs, and this jit shares their arenas — IR002 verifying step-program arena aliasing at tp=1 covers the same donation class
-                self._copy_fn = jax.jit(_copy, donate_argnums=(0, 1))
-        self.k, self.v = self._copy_fn(
-            self.k, self.v, jnp.asarray(src, jnp.int32),
-            jnp.asarray(dst, jnp.int32),
-        )
+                # jaxlint: disable=JL004 -- COW scatter donates the single-device KV arenas (and int8 scale sidecars) in place; gating would materialize a full arena copy per COW on CPU (see docstring). Not IR-checkable directly: hlolint lowers the engine's step programs, and this jit shares their arenas — IR002 verifying step-program arena aliasing at tp=1 covers the same donation class
+                self._copy_fn = jax.jit(fn, donate_argnums=nargs)
+        s32 = jnp.asarray(src, jnp.int32)
+        d32 = jnp.asarray(dst, jnp.int32)
+        if self.quantized:
+            self.k, self.v, self.k_scale, self.v_scale = self._copy_fn(
+                self.k, self.v, self.k_scale, self.v_scale, s32, d32)
+        else:
+            self.k, self.v = self._copy_fn(self.k, self.v, s32, d32)
 
     def table_for(self, blocks, max_blocks):
         """Padded [max_blocks] int32 block table (0-padded) for a sequence."""

@@ -111,6 +111,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 import warnings
 from collections import namedtuple
@@ -137,6 +138,71 @@ def _env_flag(name, default):
     return v.strip().lower() not in ("0", "false", "off", "no", "")
 
 
+def _adaround_model_int8(model, calib_prompts, iters=300):
+    """Int8 weight quantization for a GPT serving model: AdaRound
+    (quantization/adaround.py `learn_rounding`) on every tp-parallel
+    Linear in the blocks — qkv/proj/fc1/fc2 — with per-output-channel
+    absmax scales, written back QDQ (``w = q * s``) so every downstream
+    consumer (eager calibration, functional_call step programs, the
+    tied lm head being wte and thus untouched) sees the quantized
+    values with no layer swaps. Norms, embeddings, and biases stay
+    f32. Calibration inputs are captured per layer with forward
+    pre-hooks over `calib_prompts` (token-id sequences; a small
+    deterministic set when None — fine for smoke quality, real
+    deployments should pass held-out prompts). ``iters=0`` degrades to
+    round-to-nearest QDQ (learn_rounding's loop just doesn't run)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..distributed.mesh import suppress_mesh
+    from ..quantization.adaround import learn_rounding
+
+    if calib_prompts is None:
+        vocab = int(model.cfg.vocab_size)
+        calib_prompts = [
+            [(7 * i + 3 * j + 1) % vocab for j in range(16)]
+            for i in range(4)
+        ]
+    subs = []
+    for blk in model.blocks:
+        subs += [blk.attn.qkv, blk.attn.proj, blk.fc1, blk.fc2]
+    captured = {id(s): [] for s in subs}
+
+    def _capture(store):
+        # pre-hook contract (nn/layer.py): returning None keeps the
+        # inputs; list.append obliges
+        return lambda layer, inputs: store.append(
+            np.asarray(inputs[0]._array, np.float32))
+
+    hooks = [s.register_forward_pre_hook(_capture(captured[id(s)]))
+             for s in subs]
+    try:
+        with suppress_mesh():
+            for prompt in calib_prompts:
+                ids = np.asarray(prompt, np.int32).reshape(1, -1)
+                model(Tensor(jnp.asarray(ids)))
+    finally:
+        for h in hooks:
+            h.remove()
+    for s in subs:
+        xs = captured[id(s)]
+        w = np.asarray(s.weight._array, np.float32)
+        scales = np.maximum(np.abs(w).max(axis=0), 1e-8)[None, :] / 127.0
+        bias = (None if s.bias is None
+                else jnp.asarray(s.bias._array, jnp.float32))
+
+        def apply_fn(wq, x, _b=bias):
+            y = x.astype(jnp.float32) @ wq
+            return y if _b is None else y + _b
+
+        targets = [np.asarray(apply_fn(jnp.asarray(w), jnp.asarray(x)))
+                   for x in xs]
+        q = learn_rounding(w, scales, apply_fn, xs, targets, 127.0,
+                           iters=int(iters))
+        s.weight._array = jnp.asarray(q * scales,
+                                      s.weight._array.dtype)
+
+
 class LLMEngine:
     def __init__(self, model, block_size=16, num_blocks=None, max_batch=4,
                  prefill_chunk=None, token_budget=None, max_seq_len=None,
@@ -146,7 +212,9 @@ class LLMEngine:
                  trace_buffer=None, request_log=None, mesh=None,
                  kv_hbm_bytes=None, slo=None, postmortem_dir=None,
                  postmortem_keep=None, width_buckets=None,
-                 host_kv_blocks=None, host_swap_chunk=4):
+                 host_kv_blocks=None, host_swap_chunk=4,
+                 kv_dtype=None, quantize=None, calib_prompts=None,
+                 quantize_iters=300, quant_allreduce=None):
         import jax
 
         from .sharded import as_serving_mesh, kv_capacity_blocks
@@ -164,6 +232,62 @@ class LLMEngine:
         self._smesh = as_serving_mesh(mesh)
         if self._smesh is not None:
             self._smesh.validate_model(cfg)
+        # int8 KV arena (`kv_dtype="int8"` / PADDLE_TPU_KV_DTYPE): payload
+        # bytes quarter (vs f32) and the SAME kv_hbm_bytes budget admits
+        # ~4x the blocks — behind the parity/perplexity quality gates in
+        # tests/test_int8_kv.py. Anything other than "int8" keeps the
+        # weight-dtype arena.
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("PADDLE_TPU_KV_DTYPE", "") or None
+        if kv_dtype is not None and str(kv_dtype) not in ("int8",):
+            raise ValueError(
+                f"kv_dtype {kv_dtype!r} not supported — pass 'int8' for "
+                "the quantized arena or None for the weight dtype")
+        self.kv_dtype = None if kv_dtype is None else str(kv_dtype)
+        self.kv_quantized = self.kv_dtype == "int8"
+        # int8 weights (AdaRound, quantization/adaround.py): QDQ in place
+        # on the caller's model at construction, calibrated on
+        # `calib_prompts` token sequences. Norms/embeddings stay f32.
+        if quantize is not None and quantize is not False:
+            if quantize != "int8":
+                raise ValueError(
+                    f"quantize={quantize!r} not supported — only 'int8'")
+            if self._smesh is not None:
+                raise ValueError(
+                    "quantize='int8' requires mesh=None: AdaRound "
+                    "calibrates against the eager single-device model "
+                    "before placement — quantize first, then build the "
+                    "sharded engine from the quantized model")
+            _adaround_model_int8(model, calib_prompts,
+                                 iters=int(quantize_iters))
+        self.quantize = quantize or None
+        # EQuARX quantized tp all-reduce (serving/sharded.py
+        # `quantized_row_parallel`), gated PER OP so IR001 can lock the
+        # resulting collective shape: True = both RowParallel projections,
+        # or an iterable drawn from {"attn_proj", "ffn_fc2"}; the
+        # PADDLE_TPU_QUANT_ALLREDUCE env ("1" or a comma list) supplies a
+        # default. Meaningless (and ignored) single-chip — there is no
+        # collective to quantize at tp=1.
+        if quant_allreduce is None:
+            qa = os.environ.get("PADDLE_TPU_QUANT_ALLREDUCE", "").strip()
+            if qa.lower() in ("", "0", "false", "off", "no"):
+                quant_allreduce = None
+            elif qa.lower() in ("1", "true", "on", "yes"):
+                quant_allreduce = True
+            else:
+                quant_allreduce = [s.strip() for s in qa.split(",")
+                                   if s.strip()]
+        if quant_allreduce is True:
+            quant_allreduce = ("attn_proj", "ffn_fc2")
+        self.quant_collectives = frozenset(quant_allreduce or ())
+        if not self.quant_collectives <= {"attn_proj", "ffn_fc2"}:
+            raise ValueError(
+                f"quant_allreduce names unknown ops "
+                f"{sorted(self.quant_collectives - {'attn_proj', 'ffn_fc2'})}"
+                " — the quantizable RowParallel collectives are "
+                "'attn_proj' and 'ffn_fc2'")
+        if self._smesh is None:
+            self.quant_collectives = frozenset()
         self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
         if self.max_seq_len > cfg.max_seq_len:
             raise ValueError(
@@ -184,13 +308,17 @@ class LLMEngine:
             # block and the budget buys tp x the logical-head-count
             # formula's blocks — capacity (and therefore `validate`'s
             # admission bound) is derived from what ONE SHARD holds.
+            # An int8 arena prices blocks at itemsize 1 plus the f32
+            # scale-sidecar overhead — this is where the same budget
+            # starts admitting ~4x (f32) / ~2x (bf16) the sequences.
             dt_probe = model.wte.weight._array.dtype
             num_blocks = kv_capacity_blocks(
                 kv_hbm_bytes, cfg.num_layers, cfg.num_heads,
                 self.block_size, cfg.hidden_size // cfg.num_heads,
-                dt_probe.itemsize,
+                1 if self.kv_quantized else dt_probe.itemsize,
                 tp_degree=(1 if self._smesh is None
                            else self._smesh.tp_degree),
+                scale_itemsize=4 if self.kv_quantized else 0,
             )
             # validate()'s worst case for a max-length request: every
             # token but the final sampled one is cached — the gate must
@@ -359,6 +487,7 @@ class LLMEngine:
             metrics=self.metrics, tracer=self.tracer,
             sharding=(None if self._smesh is None
                       else self._smesh.arena_sharding()),
+            kv_dtype=self.kv_dtype,
         )
         # host-memory KV tier (serving/kv_tier.py): `host_kv_blocks` host
         # block slots make evicted cached prefixes swap-back-able instead
@@ -383,6 +512,13 @@ class LLMEngine:
         self.metrics.set_gauge("mesh_tp_degree", mi["tp_degree"])
         self.metrics.set_gauge("mesh_device_count", mi["device_count"])
         self.metrics.set_info("mesh", {"backend": mi["backend"]})
+        # KV dtype observability: the active arena dtype and what one
+        # logical block costs ride /metrics (and mesh_info/pool_stats),
+        # so the int8 capacity doubling is visible on every surface that
+        # reports blocks
+        self.metrics.set_gauge("kv_bytes_per_block",
+                               self.pool.bytes_per_block())
+        self.metrics.set_info("kv", {"dtype": self.pool.kv_dtype})
         self.scheduler = Scheduler(
             self.pool, max_batch=self.max_batch,
             token_budget=int(token_budget),
@@ -396,6 +532,11 @@ class LLMEngine:
         self._step_fns = {}
         self._phases = {}   # current step's {phase: (t0, t1)} when tracing
         self._retrace_warned = False
+        # stamped by AsyncLLMEngine.start(): while that thread is alive,
+        # stepping from any OTHER thread would race the arena donation
+        # mid-flight (the PR 16 documented hazard) — `_guard_thread`
+        # raises a pointed RuntimeError instead of corrupting
+        self._engine_thread = None
         self._key = jax.random.PRNGKey(seed)
         # fault injection (serving/faults.py): arm the PADDLE_TPU_FAULTS
         # plan if one is configured; with no plan every hook site below is
@@ -438,15 +579,23 @@ class LLMEngine:
         return self.add(req)
 
     def mesh_info(self):
-        """Topology of this replica — {tp_degree, device_count, backend} —
-        for /healthz, the ``mesh_*`` gauges, and benches. Single-chip
-        engines report degree/count 1 on the default backend."""
+        """Topology of this replica — {tp_degree, device_count, backend,
+        kv_dtype} — for /healthz, the ``mesh_*`` gauges, and benches.
+        Single-chip engines report degree/count 1 on the default
+        backend. `kv_dtype` is the ACTIVE arena dtype (int8 when
+        quantized), so capacity numbers on the same surface are
+        interpretable."""
         if self._smesh is not None:
-            return self._smesh.info()
-        import jax
+            info = self._smesh.info()
+        else:
+            import jax
 
-        return {"tp_degree": 1, "device_count": 1,
-                "backend": jax.default_backend()}
+            info = {"tp_degree": 1, "device_count": 1,
+                    "backend": jax.default_backend()}
+        pool = getattr(self, "pool", None)
+        info["kv_dtype"] = (pool.kv_dtype if pool is not None
+                            else (self.kv_dtype or "float32"))
+        return info
 
     def kv_capacity_blocks(self):
         """Usable KV blocks — what ONE SHARD of the arena actually holds
@@ -602,15 +751,22 @@ class LLMEngine:
 
         smesh = self._smesh
         K = self._draft_capacity(W)
+        quantized = self.pool.quantized
+        quant_ops = self.quant_collectives
 
         def forward(params, buffers, k_arena, v_arena, ids, block_tables,
-                    slots, offs, qpos, q_start, kv_live, q_lens):
+                    slots, offs, qpos, q_start, kv_live, q_lens,
+                    k_scale=None, v_scale=None, touched=None,
+                    touch_idx=None):
             # runs at TRACE time only — the test's recompile alarm
             metrics.inc("jit_traces")
             state = PagedState(k_arena, v_arena, block_tables, slots, offs,
                                qpos, q_start=q_start, kv_live=kv_live,
                                q_lens=q_lens,
-                               mesh=None if smesh is None else smesh.mesh)
+                               mesh=None if smesh is None else smesh.mesh,
+                               k_scale=k_scale, v_scale=v_scale,
+                               touched=touched, touch_idx=touch_idx,
+                               quant_collectives=quant_ops)
             # mask the process-global TRAINING mesh for the trace (thread-
             # local — a concurrent training trace on another thread keeps
             # its mesh): the serving step's sharding is fully explicit
@@ -629,15 +785,8 @@ class LLMEngine:
                 )
             return logits, state
 
-        def step(params, buffers, k_arena, v_arena, ids, block_tables,
-                 slots, offs, qpos, q_start, kv_live, last_idx, spec_lens,
-                 temps, top_ks, top_ps, key):
-            # per-row live width for the ragged kernel: chunk tokens
-            # through last_idx plus the drafted candidates
-            q_lens = last_idx + 1 + spec_lens
-            logits, state = forward(params, buffers, k_arena, v_arena, ids,
-                                    block_tables, slots, offs, qpos,
-                                    q_start, kv_live, q_lens)
+        def _decide(logits, state, ids, last_idx, spec_lens, temps, top_ks,
+                    top_ps, key):
             # the scored window: K + 1 consecutive positions starting at
             # each row's last chunk token — position last_idx + j scores
             # the distribution following fed token last_idx + j, which is
@@ -676,12 +825,49 @@ class LLMEngine:
                 [run, n_acc[:, None], row_ok.astype(jnp.int32)[:, None]],
                 axis=1,
             )
-            return packed, state.k, state.v
+            return packed
 
+        if quantized:
+            # int8 arena variant: the scale sidecars ride the signature
+            # as donated state right after the payload arenas, and the
+            # scatter's touched-block lists ride the host marshalling —
+            # ONE kv_dtype switch, same (B, W) keying, kinds still don't
+            # key programs
+            def step(params, buffers, k_arena, v_arena, k_scale, v_scale,
+                     ids, block_tables, slots, offs, qpos, q_start,
+                     kv_live, touched, touch_idx, last_idx, spec_lens,
+                     temps, top_ks, top_ps, key):
+                q_lens = last_idx + 1 + spec_lens
+                logits, state = forward(
+                    params, buffers, k_arena, v_arena, ids, block_tables,
+                    slots, offs, qpos, q_start, kv_live, q_lens,
+                    k_scale=k_scale, v_scale=v_scale, touched=touched,
+                    touch_idx=touch_idx)
+                packed = _decide(logits, state, ids, last_idx, spec_lens,
+                                 temps, top_ks, top_ps, key)
+                return (packed, state.k, state.v, state.k_scale,
+                        state.v_scale)
+        else:
+            def step(params, buffers, k_arena, v_arena, ids, block_tables,
+                     slots, offs, qpos, q_start, kv_live, last_idx,
+                     spec_lens, temps, top_ks, top_ps, key):
+                # per-row live width for the ragged kernel: chunk tokens
+                # through last_idx plus the drafted candidates
+                q_lens = last_idx + 1 + spec_lens
+                logits, state = forward(params, buffers, k_arena, v_arena,
+                                        ids, block_tables, slots, offs,
+                                        qpos, q_start, kv_live, q_lens)
+                packed = _decide(logits, state, ids, last_idx, spec_lens,
+                                 temps, top_ks, top_ps, key)
+                return packed, state.k, state.v
+
+        # donated arena state: payload arenas, plus the f32 scale
+        # sidecars when the arena is int8
+        arena_args = (2, 3, 4, 5) if quantized else (2, 3)
         if smesh is None:
             fn = jax.jit(step,
                          # jaxlint: disable=JL004 -- single-device arena donation, deliberately ungated (gating would copy the whole arena every step on CPU); the aliasing it relies on is machine-checked by IR contract IR002 (analysis/contracts.py) on the lowered tp=1 programs
-                         donate_argnums=(2, 3))
+                         donate_argnums=arena_args)
         else:
             # mesh-aware program, same (B, W) keying: weights and arenas
             # pinned to their tp shardings, every host-marshalled step
@@ -693,12 +879,15 @@ class LLMEngine:
 
             rep = smesh.replicated()
             arena = smesh.arena_sharding()
-            host_in = (rep,) * 13  # ids..top_ps marshalling + PRNG key
+            n_arena = len(arena_args)
+            # ids..top_ps marshalling + PRNG key (+ touched/touch_idx
+            # when quantized)
+            host_in = (rep,) * (15 if quantized else 13)
             in_sh = (self._param_shardings, self._buffer_shardings,
-                     arena, arena) + host_in
-            out_sh = (rep, arena, arena)
+                     ) + (arena,) * n_arena + host_in
+            out_sh = (rep,) + (arena,) * n_arena
             fn = jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
-                         donate_argnums=mesh_donate_argnums((2, 3)))
+                         donate_argnums=mesh_donate_argnums(arena_args))
         self._step_fns[(B, W)] = fn
         return fn
 
@@ -711,6 +900,14 @@ class LLMEngine:
         narrow ones what fits; width 1 degenerates K to 0 and the window
         to the plain one-token sampler."""
         return min(self.num_spec_tokens if self.spec_decoding else 0, W - 1)
+
+    def _touched_width(self, W):
+        """Columns in the quantized step's per-row ``touched`` block
+        list: ``W`` consecutive fed positions straddle at most
+        ``(W + bs - 2) // bs + 1`` arena blocks, plus slot 0 reserved
+        for the null block — part of the compiled (B, W) shape key, so
+        it must be THE one formula for both tracing and marshalling."""
+        return (W + self.block_size - 2) // self.block_size + 2
 
     def expected_program_count(self):
         """THE program-count contract, in one place: the engine compiles
@@ -762,13 +959,20 @@ class LLMEngine:
         snap = self.metrics.counters.get("jit_traces", 0)
         h = lambda shape, dt=jnp.int32: jax.ShapeDtypeStruct(shape, dt)
         lowered = {}
+        quantized = self.pool.quantized
         try:
             for name, (B, W) in shapes.items():
                 fn = self._get_step_fn(B, W)
+                arenas = (self.pool.k, self.pool.v)
+                mid = ()
+                if quantized:
+                    arenas += (self.pool.k_scale, self.pool.v_scale)
+                    mid = (h((B, self._touched_width(W))),  # touched
+                           h((B, W)))                       # touch_idx
                 lowered[name] = fn.lower(
-                    self._params, self._buffers, self.pool.k, self.pool.v,
+                    self._params, self._buffers, *arenas,
                     h((B, W)), h((B, self.max_blocks)), h((B, W)), h((B, W)),
-                    h((B, W)), h((B,)), h((B,)),
+                    h((B, W)), h((B,)), h((B,)), *mid,
                     h((B,)),                      # last_idx
                     h((B,)),                      # spec_lens
                     h((B,), jnp.float32), h((B,)), h((B,), jnp.float32),
@@ -788,8 +992,9 @@ class LLMEngine:
         on this engine (single-chip engines donate unconditionally; mesh
         engines route through `parallel.spmd.mesh_donate_argnums`, which
         turns donation off on the cpu host platform). The unified program
-        returns ``(packed, k_arena, v_arena)``, so the arenas land at
-        outputs (1, 2) for every width."""
+        returns ``(packed, k_arena, v_arena)`` — plus the two f32 scale
+        sidecars when the arena is int8 — so the arena state lands at
+        outputs (1, 2[, 3, 4]) for every width."""
         import jax
 
         n_state = (len(jax.tree_util.tree_leaves(self._params))
@@ -805,10 +1010,13 @@ class LLMEngine:
             # in tests/test_ir_contracts.py patches the gate ungated and
             # must fail the contract)
             donation_on = jax.default_backend() != "cpu"
+        n_arena = 4 if self.pool.quantized else 2
         return {
-            "arena_param_indices": (n_state, n_state + 1),
+            "arena_param_indices": tuple(
+                range(n_state, n_state + n_arena)),
             "arena_output_indices": {
-                name: (1, 2) for name in self.step_program_shapes()
+                name: tuple(range(1, 1 + n_arena))
+                for name in self.step_program_shapes()
             },
             "donation_expected": donation_on,
         }
@@ -841,6 +1049,17 @@ class LLMEngine:
         dt = self.pool.k.dtype
         idx = jax.ShapeDtypeStruct((c,), jnp.int32)
         chunk = jax.ShapeDtypeStruct((L, H, c, Bs, D), dt)
+        if self.pool.quantized:
+            sc_chunk = jax.ShapeDtypeStruct((L, H, c), jnp.float32)
+            return {
+                "swap_out": t._gather_jit().lower(
+                    self.pool.k, self.pool.v, self.pool.k_scale,
+                    self.pool.v_scale, idx),
+                "swap_in": t._scatter_jit().lower(
+                    self.pool.k, self.pool.v, self.pool.k_scale,
+                    self.pool.v_scale, chunk, chunk, sc_chunk, sc_chunk,
+                    idx),
+            }
         return {
             "swap_out": t._gather_jit().lower(self.pool.k, self.pool.v,
                                               idx),
@@ -862,9 +1081,10 @@ class LLMEngine:
             donation_on = True
         else:
             donation_on = jax.default_backend() != "cpu"
+        n_arena = 4 if self.pool.quantized else 2
         return {
-            "arena_param_indices": (0, 1),
-            "arena_output_indices": {"swap_in": (0, 1)},
+            "arena_param_indices": tuple(range(n_arena)),
+            "arena_output_indices": {"swap_in": tuple(range(n_arena))},
             "donation_expected": donation_on,
             "no_alias": ("swap_out",),
         }
@@ -891,17 +1111,27 @@ class LLMEngine:
         import jax.numpy as jnp
 
         self._key, sub = jax.random.split(self._key)
+        pool = self.pool
+        arenas = (pool.k, pool.v)
+        mid = ()
+        if pool.quantized:
+            arenas += (pool.k_scale, pool.v_scale)
+            mid = (jnp.asarray(a["touched"]), jnp.asarray(a["touch_idx"]))
         args = (
-            self._params, self._buffers, self.pool.k, self.pool.v,
+            self._params, self._buffers, *arenas,
             jnp.asarray(a["ids"]), jnp.asarray(a["tables"]),
             jnp.asarray(a["slots"]), jnp.asarray(a["offs"]),
             jnp.asarray(a["qpos"]), jnp.asarray(a["q_start"]),
-            jnp.asarray(a["kv_live"]), jnp.asarray(last_idx),
+            jnp.asarray(a["kv_live"]), *mid, jnp.asarray(last_idx),
             jnp.asarray(spec_lens), jnp.asarray(a["temps"]),
             jnp.asarray(a["top_ks"]), jnp.asarray(a["top_ps"]), sub,
         )
         with self._annotation(step_id):
-            packed, self.pool.k, self.pool.v = fn(*args)
+            if pool.quantized:
+                (packed, pool.k, pool.v,
+                 pool.k_scale, pool.v_scale) = fn(*args)
+            else:
+                packed, pool.k, pool.v = fn(*args)
         return packed
 
     # -- fault hooks (serving/faults.py; armed plans only) -----------------
@@ -986,6 +1216,7 @@ class LLMEngine:
         had to contain this step (non-finite logits) emit no StepOutput;
         they are aborted internally and reported in ``self.step_faults``
         as ``(request_id, detail)`` pairs."""
+        self._guard_thread("step()")
         tr = self.tracer
         t_plan0 = time.monotonic() if tr is not None else 0.0
         self.step_faults = []
@@ -1116,6 +1347,15 @@ class LLMEngine:
             "q_start": np.zeros(B, np.int32),
             # idle lanes walk just the null block
             "kv_live": np.ones(B, np.int32),
+            **({
+                # int8 arena: per-row touched-block list (slot 0 = the
+                # null block, so zeroed rows are inert) + each token's
+                # index into it — block_pool._quantize_scatter's
+                # scatter-max targets
+                "touched": np.zeros(
+                    (B, self._touched_width(S)), np.int32),
+                "touch_idx": np.zeros((B, S), np.int32),
+            } if self.pool.quantized else {}),
         }
 
     def _fill_row(self, a, i, req, start, w, S):
@@ -1132,6 +1372,16 @@ class LLMEngine:
         a["top_ps"][i] = 1.0 if req.top_p is None else req.top_p
         a["q_start"][i] = start
         a["kv_live"][i] = (start + w - 1) // self.block_size + 1
+        if self.pool.quantized:
+            # unique non-null blocks this row's scatter writes, listed
+            # after the null slot; invalid/pad tokens keep touch_idx 0
+            # and requantize only the null block (whose scale pins at
+            # the floor, see _quantize_scatter)
+            sl = a["slots"][i, :w]
+            uniq = np.unique(sl[sl != 0])
+            a["touched"][i, 1:1 + len(uniq)] = uniq
+            lut = {int(b): j + 1 for j, b in enumerate(uniq)}
+            a["touch_idx"][i, :w] = [lut.get(int(s), 0) for s in sl]
 
     def _run_rows(self, rows, W, step_id=0):
         """Run one unified ragged step at width bucket `W`: every
@@ -1303,6 +1553,8 @@ class LLMEngine:
         depths — enough to see saturation without scraping /metrics."""
         usable = self.pool.num_blocks - 1
         stats = {
+            "kv_dtype": self.pool.kv_dtype,
+            "kv_bytes_per_block": self.pool.bytes_per_block(),
             "blocks_total": usable,
             "blocks_truly_free": self.pool.num_truly_free,
             "blocks_cached_free": self.pool.num_cached_blocks,
@@ -1357,9 +1609,30 @@ class LLMEngine:
 
     # -- conveniences ------------------------------------------------------
 
+    def _guard_thread(self, what):
+        """The PR 16 race, closed at the throat: while an AsyncLLMEngine's
+        background loop owns this engine, any OTHER thread calling the
+        synchronous drive surface would interleave two schedulers over one
+        block pool and one donated arena — silent KV corruption at worst,
+        a trace-cache stampede at best. The async frontend stamps its
+        thread into ``_engine_thread`` on start(); a live foreign caller
+        gets a pointed error instead of corrupted state. The owning
+        thread itself passes (that IS the async loop stepping)."""
+        owner = self._engine_thread
+        if (owner is not None and owner.is_alive()
+                and threading.current_thread() is not owner):
+            raise RuntimeError(
+                f"{what} called while an AsyncLLMEngine background loop "
+                f"({owner.name}) is driving this engine — two schedulers "
+                "would interleave over one block pool. Submit through "
+                "the AsyncLLMEngine (submit()/stream()), or stop() it "
+                "before driving the engine synchronously."
+            )
+
     def stream(self, prompt_ids, **kwargs):
         """Add one request and yield its StepOutputs as tokens land; other
         in-flight requests keep decoding in the same steps."""
+        self._guard_thread("stream()")
         rid = self.add_request(prompt_ids, **kwargs)
         req = self._requests[rid]
         emitted = 0
@@ -1381,6 +1654,7 @@ class LLMEngine:
     def generate(self, prompts, **kwargs):
         """Batch convenience: add every prompt, run to completion, return
         each request's generated token list (in input order)."""
+        self._guard_thread("generate()")
         rids = [self.add_request(p, **kwargs) for p in prompts]
         while self.has_unfinished():
             self.step()

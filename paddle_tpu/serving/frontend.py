@@ -224,6 +224,11 @@ class AsyncLLMEngine:
         self._thread = threading.Thread(
             target=self._engine_loop, name="paddle-tpu-engine", daemon=True
         )
+        # ownership stamp BEFORE start (the happens-before edge above
+        # covers it): while this thread lives, the engine's synchronous
+        # drive surface (step/generate/stream) rejects foreign threads —
+        # see LLMEngine._guard_thread for the race it closes
+        self.engine._engine_thread = self._thread
         self._thread.start()
         if self._watchdog is not None:
             self._watchdog.start()

@@ -82,6 +82,11 @@ class KVTier:
         L, H, _, Bs, D = pool.k.shape
         self._shape = (L, H, Bs, D)   # per-block logical shape
         self._dtype = np.dtype(jnp.dtype(pool.k.dtype).name)
+        # int8 arenas (pool.quantized): blocks demote WITH their
+        # per-(layer, head) scales — payload slabs stay int8 (half the
+        # host bytes of f32) and f32 scale slabs [L, heads, host_blocks]
+        # ride along through save/restore/export/import
+        self.quantized = bool(getattr(pool, "quantized", False))
         # per-shard host slabs [(h0, h1, k_slab, v_slab)]: one entry per
         # tp head range (single-chip: one full-width entry). Plain numpy
         # is the "pinned host slab" on the host platform; on real
@@ -97,6 +102,14 @@ class KVTier:
              np.zeros((L, h1 - h0, self.host_blocks, Bs, D), self._dtype))
             for h0, h1 in ranges
         ]
+        self._sc_slabs = None
+        if self.quantized:
+            self._sc_slabs = [
+                (h0, h1,
+                 np.zeros((L, h1 - h0, self.host_blocks), np.float32),
+                 np.zeros((L, h1 - h0, self.host_blocks), np.float32))
+                for h0, h1 in ranges
+            ]
         self._lock = threading.Lock()
         self._index = OrderedDict()   # hash -> slot (LRU order, MRU last)
         self._slot_gen = [0] * self.host_blocks  # bumps on slot reuse
@@ -154,13 +167,13 @@ class KVTier:
             # pad to the compiled chunk width by repeating the last index
             # (idempotent — the duplicate columns are never read back)
             src = src + [src[-1]] * (self.swap_chunk - len(src))
-            k_g, v_g = self._gather(np.asarray(src, np.int32))
+            arrs = self._gather(np.asarray(src, np.int32))
             entries = [(h, slot, gen, j)
                        for j, (h, _, slot, gen) in enumerate(chunk)]
             with self._lock:
                 for h, slot, gen, j in entries:
-                    self._pending[h] = (slot, gen, j, k_g, v_g)
-            self._queue.put((entries, k_g, v_g))
+                    self._pending[h] = (slot, gen, j) + arrs
+            self._queue.put((entries,) + arrs)
 
     def _take_slot_locked(self):
         """One host slot, evicting the host-LRU entry when full. Returns
@@ -190,14 +203,22 @@ class KVTier:
         import jax
 
         if self._gather_fn is None:
+            fn = _swap_out_q if self.quantized else _swap_out
+            n_out = 4 if self.quantized else 2
+            # the arena PartitionSpec (None, 'tp') shards the head axis of
+            # the [L, H, host_blocks] scale gathers just like the payloads
             self._gather_fn = jax.jit(
-                _swap_out, **({} if self.mesh is None else
-                              {"out_shardings": (self.mesh.arena_sharding(),
-                                                 self.mesh.arena_sharding())})
+                fn, **({} if self.mesh is None else
+                       {"out_shardings":
+                        (self.mesh.arena_sharding(),) * n_out})
             )
         return self._gather_fn
 
     def _gather(self, src):
+        if self.quantized:
+            return self._gather_jit()(self.pool.k, self.pool.v,
+                                      self.pool.k_scale, self.pool.v_scale,
+                                      src)
         return self._gather_jit()(self.pool.k, self.pool.v, src)
 
     # -- drain thread ------------------------------------------------------
@@ -212,7 +233,7 @@ class KVTier:
             finally:
                 self._queue.task_done()
 
-    def _write_chunk(self, entries, k_g, v_g):
+    def _write_chunk(self, entries, k_g, v_g, ks_g=None, vs_g=None):
         """Device->host transfer of one gathered chunk, then slab writes
         under the lock. The `np.asarray` sync happens OUTSIDE the lock;
         a generation mismatch (host-LRU evicted the slot while the copy
@@ -220,6 +241,11 @@ class KVTier:
         host = [(h0, h1, self._shard_to_host(k_g, h0, h1),
                  self._shard_to_host(v_g, h0, h1))
                 for h0, h1, _, _ in self._slabs]
+        sc_host = None
+        if ks_g is not None:
+            sc_host = [(self._shard_to_host(ks_g, h0, h1),
+                        self._shard_to_host(vs_g, h0, h1))
+                       for h0, h1, _, _ in self._sc_slabs]
         written = 0
         with self._lock:
             for h, slot, gen, j in entries:
@@ -233,6 +259,11 @@ class KVTier:
                         self._slabs, host):
                     k_slab[:, :, slot] = hk[:, :, j]
                     v_slab[:, :, slot] = hv[:, :, j]
+                if sc_host is not None:
+                    for (_, _, ks_slab, vs_slab), (hks, hvs) in zip(
+                            self._sc_slabs, sc_host):
+                        ks_slab[:, :, slot] = hks[:, :, j]
+                        vs_slab[:, :, slot] = hvs[:, :, j]
                 written += 1
                 self.swap_outs += 1
         if self.metrics is not None and written:
@@ -288,15 +319,26 @@ class KVTier:
             return 0
         # pending entries' bytes are still device-side: sync them outside
         # the lock (np.asarray on the gathered chunk), then read slabs
-        pend_host = {
-            h: (j, [(self._shard_to_host(k_g, h0, h1),
-                     self._shard_to_host(v_g, h0, h1))
-                    for h0, h1, _, _ in self._slabs])
-            for h, (_, _, j, k_g, v_g) in pend_sync.items()
-        }
+        pend_host = {}
+        for h, pend in pend_sync.items():
+            j, k_g, v_g = pend[2], pend[3], pend[4]
+            shards = [(self._shard_to_host(k_g, h0, h1),
+                       self._shard_to_host(v_g, h0, h1))
+                      for h0, h1, _, _ in self._slabs]
+            sc_shards = None
+            if self.quantized:
+                ks_g, vs_g = pend[5], pend[6]
+                sc_shards = [(self._shard_to_host(ks_g, h0, h1),
+                              self._shard_to_host(vs_g, h0, h1))
+                             for h0, h1, _, _ in self._sc_slabs]
+            pend_host[h] = (j, shards, sc_shards)
         L, H, Bs, D = self._shape
         hk = np.empty((L, H, n, Bs, D), self._dtype)
         hv = np.empty((L, H, n, Bs, D), self._dtype)
+        hks = hvs = None
+        if self.quantized:
+            hks = np.empty((L, H, n), np.float32)
+            hvs = np.empty((L, H, n), np.float32)
         with self._lock:
             for i, h in enumerate(hashes[:n]):
                 slot = self._index.get(h)
@@ -304,44 +346,70 @@ class KVTier:
                     n = i          # evicted between match and here: trim
                     break
                 if h in pend_host:
-                    j, shards = pend_host[h]
+                    j, shards, sc_shards = pend_host[h]
                     for (h0, h1, _, _), (pk, pv) in zip(self._slabs, shards):
                         hk[:, h0:h1, i] = pk[:, :, j]
                         hv[:, h0:h1, i] = pv[:, :, j]
+                    if sc_shards is not None:
+                        for (h0, h1, _, _), (pks, pvs) in zip(
+                                self._sc_slabs, sc_shards):
+                            hks[:, h0:h1, i] = pks[:, :, j]
+                            hvs[:, h0:h1, i] = pvs[:, :, j]
                 else:
                     for h0, h1, k_slab, v_slab in self._slabs:
                         hk[:, h0:h1, i] = k_slab[:, :, slot]
                         hv[:, h0:h1, i] = v_slab[:, :, slot]
+                    if self.quantized:
+                        for h0, h1, ks_slab, vs_slab in self._sc_slabs:
+                            hks[:, h0:h1, i] = ks_slab[:, :, slot]
+                            hvs[:, h0:h1, i] = vs_slab[:, :, slot]
             self.swap_ins += n
             self.swap_in_hit_tokens += n * self.pool.block_size
         if n == 0:
             return 0
         self._scatter(hk[:, :, :n], hv[:, :, :n],
-                      np.asarray(blocks[:n], np.int32))
+                      np.asarray(blocks[:n], np.int32),
+                      None if hks is None else hks[:, :, :n],
+                      None if hvs is None else hvs[:, :, :n])
         if self.metrics is not None:
             self.metrics.inc("swap_ins", n)
             self.metrics.inc("swap_in_hit_tokens",
                              n * self.pool.block_size)
         return n
 
-    def _scatter(self, hk, hv, dst):
+    def _scatter(self, hk, hv, dst, hks=None, hvs=None):
         """Donated jitted scatter of host chunks into the arena, padded to
         the compiled chunk width by repeating the last (dst, data) column
         (idempotent; never pads with block 0)."""
         c = self.swap_chunk
         fn = self._scatter_jit()
+
+        def pad3(a, pad):
+            return np.concatenate([a] + [a[:, :, -1:]] * pad, axis=2)
+
         for i in range(0, hk.shape[2], c):
             ck, cv = hk[:, :, i:i + c], hv[:, :, i:i + c]
+            cks = None if hks is None else hks[:, :, i:i + c]
+            cvs = None if hvs is None else hvs[:, :, i:i + c]
             cd = dst[i:i + c]
             if ck.shape[2] < c:
                 pad = c - ck.shape[2]
-                ck = np.concatenate([ck] + [ck[:, :, -1:]] * pad, axis=2)
-                cv = np.concatenate([cv] + [cv[:, :, -1:]] * pad, axis=2)
+                ck, cv = pad3(ck, pad), pad3(cv, pad)
+                if cks is not None:
+                    cks, cvs = pad3(cks, pad), pad3(cvs, pad)
                 cd = np.concatenate([cd, np.repeat(cd[-1:], pad)])
             dk, dv = self._device_put(ck), self._device_put(cv)
-            self.pool.k, self.pool.v = fn(
-                self.pool.k, self.pool.v, dk, dv,
-                np.asarray(cd, np.int32))
+            cd = np.asarray(cd, np.int32)
+            if cks is None:
+                self.pool.k, self.pool.v = fn(
+                    self.pool.k, self.pool.v, dk, dv, cd)
+            else:
+                dks, dvs = self._device_put(cks), self._device_put(cvs)
+                (self.pool.k, self.pool.v,
+                 self.pool.k_scale, self.pool.v_scale) = fn(
+                    self.pool.k, self.pool.v,
+                    self.pool.k_scale, self.pool.v_scale,
+                    dk, dv, dks, dvs, cd)
 
     def _scatter_jit(self):
         """The jitted donated swap-in scatter (built lazily) — the other
@@ -349,21 +417,24 @@ class KVTier:
         import jax
 
         if self._scatter_fn is None:
+            fn = _swap_in_q if self.quantized else _swap_in
+            n_arena = 4 if self.quantized else 2
             if self.mesh is None:
                 self._scatter_fn = jax.jit(
-                    _swap_in,
-                    # jaxlint: disable=JL004 -- swap-in scatter donates the single-device KV arenas in place (an undonated scatter would copy the whole arena per restore on the decode critical path); the aliasing is machine-checked by IR contract IR002 on the engine's lowered swap programs (analysis/contracts.py)
-                    donate_argnums=(0, 1))
+                    fn,
+                    # jaxlint: disable=JL004 -- swap-in scatter donates the single-device KV arenas (and, int8, their scale sidecars) in place (an undonated scatter would copy the whole arena per restore on the decode critical path); the aliasing is machine-checked by IR contract IR002 on the engine's lowered swap programs (analysis/contracts.py)
+                    donate_argnums=tuple(range(n_arena)))
             else:
                 from ..parallel.spmd import mesh_donate_argnums
 
                 arena = self.mesh.arena_sharding()
                 self._scatter_fn = jax.jit(
-                    _swap_in,
-                    in_shardings=(arena, arena, arena, arena,
-                                  self.mesh.replicated()),
-                    out_shardings=(arena, arena),
-                    donate_argnums=mesh_donate_argnums((0, 1)))
+                    fn,
+                    in_shardings=(arena,) * (2 * n_arena)
+                    + (self.mesh.replicated(),),
+                    out_shardings=(arena,) * n_arena,
+                    donate_argnums=mesh_donate_argnums(
+                        tuple(range(n_arena))))
         return self._scatter_fn
 
     def _device_put(self, host_chunk):
@@ -398,7 +469,17 @@ class KVTier:
                 for h0, h1, k_slab, v_slab in self._slabs:
                     k[:, h0:h1] = k_slab[:, :, slot]
                     v[:, h0:h1] = v_slab[:, :, slot]
-                entries.append((h, k, v))
+                if self.quantized:
+                    # int8 entries carry their [L, H] dequant scales —
+                    # a migrated block is useless without them
+                    ks = np.empty((L, H), np.float32)
+                    vs = np.empty((L, H), np.float32)
+                    for h0, h1, ks_slab, vs_slab in self._sc_slabs:
+                        ks[:, h0:h1] = ks_slab[:, :, slot]
+                        vs[:, h0:h1] = vs_slab[:, :, slot]
+                    entries.append((h, k, v, ks, vs))
+                else:
+                    entries.append((h, k, v))
             self.migrated_blocks_out += len(entries)
         if self.metrics is not None and entries:
             self.metrics.inc("kv_migrated_blocks_out", len(entries))
@@ -420,7 +501,8 @@ class KVTier:
                 f"{self._dtype.name}/bs{self.pool.block_size}")
         n = 0
         with self._lock:
-            for h, k, v in payload["entries"]:
+            for entry in payload["entries"]:
+                h, k, v = entry[0], entry[1], entry[2]
                 if h in self._index:
                     self._index.move_to_end(h)
                     continue
@@ -430,6 +512,11 @@ class KVTier:
                 for h0, h1, k_slab, v_slab in self._slabs:
                     k_slab[:, :, slot] = k[:, h0:h1]
                     v_slab[:, :, slot] = v[:, h0:h1]
+                if self.quantized:
+                    ks, vs = entry[3], entry[4]
+                    for h0, h1, ks_slab, vs_slab in self._sc_slabs:
+                        ks_slab[:, :, slot] = ks[:, h0:h1]
+                        vs_slab[:, :, slot] = vs[:, h0:h1]
                 self._index[h] = slot
                 n += 1
             self.migrated_blocks_in += n
@@ -462,6 +549,7 @@ class KVTier:
         s["swap_chunk"] = self.swap_chunk
         s["block_shape"] = list(self._shape)
         s["dtype"] = self._dtype.name
+        s["quantized"] = self.quantized
         s["shards"] = [[h0, h1] for h0, h1, _, _ in self._slabs]
         return s
 
@@ -485,3 +573,19 @@ def _swap_in(k, v, hk, hv, dst):
     donated — the same in-place contract as the step program and COW)."""
     return (k.at[:, :, dst].set(hk.astype(k.dtype)),
             v.at[:, :, dst].set(hv.astype(v.dtype)))
+
+
+def _swap_out_q(k, v, ks, vs, src):
+    """Int8-arena gather: payload blocks plus their scale columns."""
+    import jax.numpy as jnp
+
+    return (jnp.take(k, src, axis=2), jnp.take(v, src, axis=2),
+            jnp.take(ks, src, axis=2), jnp.take(vs, src, axis=2))
+
+
+def _swap_in_q(k, v, ks, vs, hk, hv, hks, hvs, dst):
+    """Int8-arena scatter: payloads and scale sidecars donated together."""
+    return (k.at[:, :, dst].set(hk.astype(k.dtype)),
+            v.at[:, :, dst].set(hv.astype(v.dtype)),
+            ks.at[:, :, dst].set(hks.astype(ks.dtype)),
+            vs.at[:, :, dst].set(hvs.astype(vs.dtype)))

@@ -124,6 +124,9 @@ _HELP = {
     "spec_mean_accepted_len": "Accepted draft tokens per drafted row",
     "jit_retraces": "Re-traces of already-compiled step programs "
                     "(recompile sentinel; 0 in steady state)",
+    "pool_kv_bytes_per_block": "Device bytes one KV block costs in the "
+                               "active KV dtype (int8 arenas include the "
+                               "f32 scale sidecars)",
     "pool_blocks_total": "Usable KV blocks in the pool (excludes the "
                          "null block)",
     "pool_blocks_truly_free": "KV blocks free and holding no cached "

@@ -459,7 +459,10 @@ class ServingServer(_HTTPServerBase):
             # with the window, not freeze at the last isolation).
             m = self.engine.metrics
             for k, v in self.engine.engine.pool_stats().items():
-                m.set_gauge(f"pool_{k}", v)
+                # kv_dtype is a string — it rides the `kv` info family
+                # (and /healthz), not the numeric pool_* gauges
+                if isinstance(v, (int, float)):
+                    m.set_gauge(f"pool_{k}", v)
             self.engine.supervisor.poison_stats()
             writer.write(_http_response(
                 "200 OK", m.prometheus_text(),

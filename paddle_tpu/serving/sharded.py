@@ -205,19 +205,27 @@ def serving_param_specs(model, smesh):
     return specs
 
 
-def serving_collective_budget(cfg, tp_degree):
+def serving_collective_budget(cfg, tp_degree, quant_collectives=()):
     """EXACT expected collective counts in ONE compiled serving step at
     this tp degree — the layout table above, stated as arithmetic, and
     the IR collective-budget contract's input (analysis/contracts.py
     IR001, gated in tier-1 by tests/test_ir_contracts.py):
 
-    - ``all-reduce``: one per RowParallel output projection (attn proj +
-      ffn fc2 = 2 per layer) plus one for the vocab-parallel embedding's
-      masked-lookup psum -> ``2 * num_layers + 1``;
-    - ``all-gather``: exactly ONE — the sampler-boundary gather that
-      materializes the sampled positions' full vocab rows replicated
-      (engine.py pins it with a sharding constraint so no other sampler
-      reduction pays its own collective);
+    - ``all-reduce``: one per F32 RowParallel output projection (attn
+      proj + ffn fc2 = 2 per layer, minus any in `quant_collectives`)
+      plus one for the vocab-parallel embedding's masked-lookup psum ->
+      ``(2 - n_quant) * num_layers + 1``;
+    - ``all-gather``: ONE sampler-boundary gather that materializes the
+      sampled positions' full vocab rows replicated (engine.py pins it
+      with a sharding constraint so no other sampler reduction pays its
+      own collective) — plus, per EQuARX-quantized projection in
+      `quant_collectives` (``"attn_proj"`` / ``"ffn_fc2"``), TWO
+      all-gathers per layer: the int8 partial-sum payload and its f32
+      per-shard scale (models/gpt.py routes the op through
+      `quantized_row_parallel` instead of the psum'd f32 matmul) ->
+      ``2 * n_quant * num_layers + 1``. An f32 all-reduce sneaking back
+      into a quantized op, or a quantized gather appearing unrequested,
+      moves BOTH counts and trips IR001;
     - everything else (``all-to-all``, ``reduce-scatter``, ...): zero.
       The head-major arena + per-head-grouped fused QKV exist precisely
       so the attention path needs NO re-gather of the sharded axis; a
@@ -229,27 +237,95 @@ def serving_collective_budget(cfg, tp_degree):
         return {"all-reduce": 0, "all-gather": 0, "all-to-all": 0,
                 "reduce-scatter": 0, "collective-permute": 0,
                 "collective-broadcast": 0}
-    return {"all-reduce": 2 * int(cfg.num_layers) + 1, "all-gather": 1,
+    n_quant = len(set(quant_collectives) & {"attn_proj", "ffn_fc2"})
+    L = int(cfg.num_layers)
+    return {"all-reduce": (2 - n_quant) * L + 1,
+            "all-gather": 2 * n_quant * L + 1,
             "all-to-all": 0, "reduce-scatter": 0, "collective-permute": 0,
             "collective-broadcast": 0}
 
 
 def kv_capacity_blocks(kv_bytes, num_layers, num_heads, block_size,
-                       head_dim, dtype_itemsize, tp_degree=1):
+                       head_dim, dtype_itemsize, tp_degree=1,
+                       scale_itemsize=0):
     """KV blocks a PER-CHIP byte budget buys. The arena is head-sharded
     over tp, so one chip stores ``num_heads / tp_degree`` heads per block
     — the same budget holds ``tp_degree``x the blocks of the naive
     logical-head-count formula. Admission (`LLMEngine.validate`, and the
     frontend's ``max_kv_commit_blocks`` gate that reuses it) must reject
     against what one shard can actually hold, which is THIS number, so
-    every capacity derivation funnels here. Returns the raw block count
-    (possibly 0/1) — the engine rejects an unusably small budget loudly
-    at construction rather than booting a replica that 4xxes every
-    request."""
+    every capacity derivation funnels here. `dtype_itemsize` is the
+    ACTIVE kv dtype's (1 for the int8 arena — the ~2x block count the
+    quantized pool admits flows from here into admission, the router
+    bench, and the gauges); a quantized arena also pays `scale_itemsize`
+    (4, f32) for the two per-(layer, head) scale sidecar columns each
+    block carries. Returns the raw block count (possibly 0/1) — the
+    engine rejects an unusably small budget loudly at construction
+    rather than booting a replica that 4xxes every request."""
     local_heads = -(-int(num_heads) // max(1, int(tp_degree)))
     per_block = (2 * int(num_layers) * local_heads * int(block_size)
-                 * int(head_dim) * int(dtype_itemsize))
+                 * int(head_dim) * int(dtype_itemsize)
+                 + 2 * int(num_layers) * local_heads * int(scale_itemsize))
     return int(kv_bytes) // per_block
+
+
+def quantized_row_parallel(x, w, bias, mesh, tp_axis=ServingMesh.TP_AXIS):
+    """EQuARX-style quantized RowParallel projection: the tp output
+    collective moves int8, not f32.
+
+    The f32 path lets GSPMD insert one all-reduce over the per-shard
+    partial sums of ``x @ w`` (w sharded on its IN dim). That collective
+    is the dominant cross-chip traffic of every decode step, and its
+    payload tolerates quantization well because each shard's partial sum
+    is a dense activation with a narrow dynamic range per step. Following
+    EQuARX (arXiv:2506.17615) each shard:
+
+    1. computes its local f32 partial sum ``[.., hidden]``,
+    2. quantizes it with ONE per-shard scalar scale (absmax/127),
+    3. all-gathers the int8 payload + f32 scale over ``tp``
+       (2 gathers — the shapes IR001 locks via
+       `serving_collective_budget(quant_collectives=...)`),
+    4. dequantizes and sums the tp partials in f32.
+
+    The reduction itself stays f32 — only the wire format is int8, so
+    error does not compound across shards (each partial is quantized
+    once). The replicated bias adds AFTER the summed dequant, outside
+    the quantization, exactly like the f32 path. ~4x less collective
+    traffic for one extra rounding per shard partial.
+
+    x: [.., in] activations (feature axis tp-sharded or replicated — the
+    in_spec slices either); w: [in, out] tp-sharded on in; bias: [out]
+    replicated or None; `mesh` the raw ``jax.sharding.Mesh`` (what
+    ``PagedState.mesh`` carries inside the traced step). Returns
+    replicated [.., out] f32. Gated per-op by
+    ``LLMEngine(quant_allreduce=...)`` -> ``PagedState.quant_collectives``
+    (models/gpt.py hooks)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel._compat import shard_map
+
+    tp = tp_axis
+
+    def local(xs, ws):
+        part = jax.lax.dot_general(
+            xs.astype(jnp.float32), ws.astype(jnp.float32),
+            (((xs.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        sc = jnp.maximum(jnp.max(jnp.abs(part)) / 127.0, 1e-12)
+        q = jnp.clip(jnp.round(part / sc), -127, 127).astype(jnp.int8)
+        qg = jax.lax.all_gather(q, tp)           # [tp, .., out] int8
+        sg = jax.lax.all_gather(sc, tp)          # [tp] f32
+        return jnp.tensordot(sg, qg.astype(jnp.float32), ((0,), (0,)))
+
+    in_spec_x = P(*([None] * (x.ndim - 1) + [tp]))
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(in_spec_x, P(tp, None)), out_specs=P())
+    out = fn(x, w)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
 
 
 # The per-shard Pallas dispatch (shard_map over the head axis) lives next

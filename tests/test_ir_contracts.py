@@ -64,12 +64,16 @@ def test_program_set_covers_the_registry(artifacts):
             for tp in (1, 2) for name in eng.step_program_shapes()}
     want |= {f"serve/tp{tp}/{name}"
              for tp in (1, 2) for name in eng.swap_program_shapes()}
+    # the int8 end-to-end family: w1 decode + the 4-array swap pair
+    want |= {f"serve_int8/tp{tp}/w1" for tp in (1, 2)}
+    want |= {f"serve_int8/tp{tp}/{name}"
+             for tp in (1, 2) for name in eng.swap_program_shapes()}
     want.add("train/dp2_mp2")
-    # one artifact per ragged width bucket plus the host-tier swap pair —
-    # the engine helpers are the ONE place the program-count contract
-    # lives
+    # one artifact per ragged width bucket plus the host-tier swap pair
+    # (x2 for the int8 family's w1 + swaps) — the engine helpers are the
+    # ONE place the program-count contract lives
     assert len(want) == (2 * eng.expected_program_count()
-                         + 2 * len(eng.swap_program_shapes()) + 1)
+                         + 4 * len(eng.swap_program_shapes()) + 2 + 1)
     assert names == want, names
 
 
@@ -93,6 +97,40 @@ def test_tp2_collectives_match_the_layout_budget(artifacts):
         assert by_name[f"serve/tp2/{name}"].collectives == tp2.collectives
     for name in ("w1", "w4", "w8"):
         assert not any(by_name[f"serve/tp1/{name}"].collectives.values())
+
+
+def test_int8_tp2_collectives_match_the_quantized_budget(artifacts):
+    """EQuARX per-op gating, locked by IR001: with both RowParallel
+    projections quantized, each f32 all-reduce becomes an int8-payload
+    all-gather + f32-scalar all-gather pair — 2L quantized ops leave
+    exactly ONE f32 all-reduce (the vocab-parallel embedding psum) and
+    2*2*L+1 all-gathers (incl. the sampler boundary)."""
+    by_name = {a.name: a for a in artifacts}
+    q = by_name["serve_int8/tp2/w1"]
+    assert q.collectives == serving_collective_budget(
+        ir.tiny_gpt_config(), 2, quant_collectives=("attn_proj",
+                                                    "ffn_fc2"))
+    assert q.collectives["all-reduce"] == 1
+    assert q.collectives["all-gather"] == 2 * 2 * 2 + 1
+    # single-chip int8: no collectives at all, like the f32 family
+    assert not any(by_name["serve_int8/tp1/w1"].collectives.values())
+
+
+def test_int8_step_reads_fewer_bytes(artifacts):
+    """The perf claim behind the int8 arena, checked on XLA's own cost
+    model: the quantized decode step accesses fewer bytes than the f32
+    program at the same (B, W) — the attention working set quarters and
+    the scale sidecar must not eat the win."""
+    by_name = {a.name: a for a in artifacts}
+    for tp in (1, 2):
+        f32 = by_name[f"serve/tp{tp}/w1"].facts["bytes_accessed"]
+        q = by_name[f"serve_int8/tp{tp}/w1"].facts["bytes_accessed"]
+        assert q < f32, (tp, q, f32)
+    # and the host-tier swap copies move ~4x fewer bytes per block
+    for tp in (1, 2):
+        f32 = by_name[f"serve/tp{tp}/swap_out"].facts["bytes_accessed"]
+        q = by_name[f"serve_int8/tp{tp}/swap_out"].facts["bytes_accessed"]
+        assert q < 0.5 * f32, (tp, q, f32)
 
 
 def test_donation_aliases_match_the_gate(artifacts):
@@ -167,6 +205,31 @@ def test_ungated_donation_trips_the_donation_contract(monkeypatch):
     msg = violations[0].format()
     assert "IR002" in msg and "donation-verified" in msg
     assert "input_output_alias" in msg and "param" in msg, msg
+
+
+def test_silently_disabled_equarx_gate_trips_the_quantized_budget(
+        monkeypatch):
+    """The int8 family's IR001 is a REGRESSION tripwire, not just a
+    description: if the per-op quantization hook stops firing (here:
+    `_serving_row_parallel` patched back to a plain layer call — the
+    shape of a refactor that loses the gate), the engine still REPORTS
+    quantized collectives, the budget still expects the all-gather
+    pairs, and the now-f32 program must fail the contract instead of
+    silently serving unquantized."""
+    from paddle_tpu.models import gpt as gpt_mod
+
+    monkeypatch.setattr(gpt_mod, "_serving_row_parallel",
+                        lambda layer, x, op_name, cache: layer(x))
+    arts = ir.serving_artifacts(tp_degrees=(2,), kinds=["w1"],
+                                kv_dtype="int8", quant_allreduce=True,
+                                prefix="serve_int8")
+    (art,) = arts
+    # the broken gate falls back to plain psum all-reduces
+    assert art.collectives["all-reduce"] > 1, art.collectives
+    violations = contracts.evaluate(arts, select=["IR001"])
+    assert violations, "a disabled EQuARX gate must blow the budget"
+    msg = violations[0].format()
+    assert "IR001" in msg and "collective-budget" in msg, msg
 
 
 # ---------------------------------------------------------------------------

@@ -315,7 +315,8 @@ def test_debug_kvtier_endpoint_and_pool_agreement(model):
     pre = "paddle_tpu_serving_"
     gauges = {n: v for n, lab, v in samples if n.startswith(pre + "pool_")}
     health = json.loads(hz[1])
-    want = {f"{pre}pool_{k}": float(v) for k, v in health["pool"].items()}
+    want = {f"{pre}pool_{k}": float(v) for k, v in health["pool"].items()
+            if not isinstance(v, str)}                   # kv_dtype: info fam
     assert gauges == want                                # same live numbers
     assert gauges[pre + "pool_host_blocks_total"] == 24
     assert gauges[pre + "pool_swap_outs"] > 0

@@ -470,8 +470,11 @@ def test_metrics_exposes_pool_saturation_gauges(model):
         if line.startswith("paddle_tpu_serving_pool_"):
             name, val = line.rsplit(" ", 1)
             gauges[name] = float(val)
+    # kv_dtype is the one non-numeric pool stat: /healthz carries the
+    # string, /metrics carries it on the `kv` info family, not a gauge
+    assert health["pool"]["kv_dtype"] == "float32"
     want = {f"paddle_tpu_serving_pool_{k}": float(v)
-            for k, v in health["pool"].items()}
+            for k, v in health["pool"].items() if not isinstance(v, str)}
     assert gauges == want                      # same live numbers
     assert gauges["paddle_tpu_serving_pool_blocks_total"] > 0
     assert gauges["paddle_tpu_serving_pool_blocks_allocated"] == 0  # idle

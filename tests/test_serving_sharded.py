@@ -269,7 +269,7 @@ def test_tp2_arena_and_param_placement(model, ref_wave):
     shard = next(iter(eng.pool.k.addressable_shards))
     assert shard.data.shape[1] == model.cfg.num_heads // 2
     assert eng.mesh_info() == {"tp_degree": 2, "device_count": 2,
-                               "backend": "cpu"}
+                               "backend": "cpu", "kv_dtype": "float32"}
 
 
 # ---------------------------------------------------------------------------
