@@ -704,6 +704,134 @@ def bench_gpt_serve_router(on_tpu, errors, deadline_s):
     return out
 
 
+def bench_gpt_serve_autoscale(on_tpu, errors, deadline_s):
+    """Elastic-fleet closed loop (serving/autoscale.py): one replica born
+    from a streamed sharded checkpoint (skeleton model + warmup wave)
+    serves a burst that saturates it; the SLO-driven autoscaler spawns a
+    second replica through the same factory path. One JSON line reports
+    `time_to_first_token_after_spawn_ms` (decision → first served token
+    on the new replica — the bounded-birth measurement), the spawn's
+    total wall time, and per-fleet deadline attainment BEFORE (1-replica
+    wave) vs AFTER (2-replica wave) the scale-up."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import save_sharded_model
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.nn.layer import skeleton_init
+    from paddle_tpu.serving import (AsyncLLMEngine, AutoScaler, LLMEngine,
+                                    ReplicaRouter, SLOLedger)
+
+    del on_tpu  # a control-loop wave: CPU-sized model either way
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=256, attn_impl="xla")
+    eager = GPT(cfg)
+    eager.eval()
+    ckpt = tempfile.mkdtemp(prefix="bench_autoscale_ckpt_")
+    save_sharded_model(eager, None, ckpt)
+    del eager
+    with skeleton_init():
+        skel = GPT(cfg)   # shapes only — every replica streams from ckpt
+    skel.eval()
+    rs = np.random.RandomState(0)
+    gen = 8 if _fast() else 16
+    n_req = 8 if _fast() else 12
+
+    def factory(_i):
+        # the birth path under test: streamed load + warmup wave, so the
+        # spawned replica's first served request retraces nothing
+        return AsyncLLMEngine(LLMEngine(
+            skel, block_size=16, max_batch=2, slo=True,
+            checkpoint_path=ckpt, warmup=True))
+
+    async def wave(router, tag):
+        for r in router.replicas:
+            r.engine.engine.slo.reset()
+        t0 = time.perf_counter()
+        streams = []
+        for _ in range(n_req):
+            streams.append(await router.submit(
+                rs.randint(0, cfg.vocab_size, (24,)).tolist(),
+                max_new_tokens=gen, temperature=0.0, tenant="burst",
+                deadline_s=120.0))
+            await asyncio.sleep(0.005)
+        outs = [await s.collect() for s in streams]
+        dt = time.perf_counter() - t0
+        failed = sum(1 for _, r in outs if r not in ("length", "stop"))
+        if failed:
+            errors.append(f"gpt_serve_autoscale: {failed} {tag}-wave "
+                          "requests failed")
+        merged = SLOLedger.merged_rollup(
+            [r.engine.engine.slo for r in router.replicas])
+        return {"tok_s": round(sum(len(t) for t, _ in outs) / dt, 1),
+                "deadline_attainment":
+                    merged["total"]["deadline"]["attainment"]}
+
+    async def run():
+        router = ReplicaRouter([factory(0)], factory=factory,
+                               sweep_interval_s=0.05)
+        await router.start()
+        # aggressive knobs so a saturating burst trips the loop within
+        # the bench budget: queue pressure alone (predicted wait) scales
+        # up; down_streak effectively disables scale-down mid-bench
+        scaler = AutoScaler(router, factory=factory, min_replicas=1,
+                            max_replicas=2, interval_s=0.05,
+                            cooldown_s=0.5, up_streak=1, down_streak=10_000,
+                            wait_high_s=0.02, wait_low_s=0.0,
+                            min_window_events=2)
+        await scaler.start()
+        before = await wave(router, "before-scale")
+        # the burst should have tripped a spawn; give the factory (stream
+        # + compile, off-loop) time to land it, nudging with more traffic
+        # if the first wave drained before the loop could observe it
+        t_wait = time.monotonic()
+        while (len(router.replicas) < 2
+               and time.monotonic() - t_wait < 120.0
+               and time.monotonic() < deadline_s):
+            st = await router.submit(
+                rs.randint(0, cfg.vocab_size, (24,)).tolist(),
+                max_new_tokens=gen, temperature=0.0, tenant="burst")
+            await st.collect()
+        up = next((d for d in scaler.decisions if d["action"] == "up"),
+                  None)
+        out = {"before_scale": before, "replicas_after": len(router.replicas)}
+        if up is None or len(router.replicas) < 2:
+            errors.append("gpt_serve_autoscale: the burst never tripped a "
+                          "scale-up")
+        else:
+            out["scale_up_reason"] = up["reason"]
+            out["spawn_total_s"] = up.get("spawn_s")
+            ttft = up.get("spawn_ttft_s")
+            if ttft is None:
+                errors.append("gpt_serve_autoscale: spawn TTFT probe "
+                              "failed on the new replica")
+            else:
+                out["time_to_first_token_after_spawn_ms"] = round(
+                    ttft * 1e3, 1)
+            out["after_scale"] = await wave(router, "after-scale")
+        await scaler.stop()
+        await router.shutdown()
+        return out
+
+    try:
+        out = asyncio.run(run())
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+    out["value"] = out.get("time_to_first_token_after_spawn_ms", 0.0)
+    out["attainment_before_scale"] = (
+        out["before_scale"]["deadline_attainment"])
+    if "after_scale" in out:
+        out["attainment_after_scale"] = (
+            out["after_scale"]["deadline_attainment"])
+        _log(f"autoscale serve: spawn ttft {out['value']} ms, attainment "
+             f"{out['attainment_before_scale']} -> "
+             f"{out['attainment_after_scale']}")
+    return out
+
+
 def _hit_rates(engines):
     """(hit_tokens, lookup_tokens, swap_in_hit_tokens) summed across
     engines — prefix_cache_hit_tokens already includes host-tier
@@ -1516,6 +1644,7 @@ _BENCHES = {
     "gpt_serve": bench_gpt_serve,
     "gpt_serve_multichip": bench_gpt_serve_multichip,
     "gpt_serve_router": bench_gpt_serve_router,
+    "gpt_serve_autoscale": bench_gpt_serve_autoscale,
     "gpt_serve_longdoc_qa": bench_gpt_serve_longdoc_qa,
     "gpt_serve_nbest": bench_gpt_serve_nbest,
     "resnet50": bench_resnet50,

@@ -271,11 +271,17 @@ def extract_facts(compiled):
         arg = int(getattr(ma, "argument_size_in_bytes", 0))
         out = int(getattr(ma, "output_size_in_bytes", 0))
         tmp = int(getattr(ma, "temp_size_in_bytes", 0))
-        alias = int(getattr(ma, "alias_size_in_bytes", 0))
         facts.update(
             argument_bytes=arg, output_bytes=out, temp_bytes=tmp,
-            # donated buffers alias in place, so they count once
-            peak_bytes=arg + out + tmp - alias,
+            # Donated buffers count on BOTH sides here (upper-bound
+            # accounting). memory_analysis().alias_size_in_bytes is NOT
+            # subtracted: a persistent-cache-deserialized executable
+            # reports 0 for it while a fresh compile of the same program
+            # reports the donated bytes, so any formula involving it
+            # flaps with cache hit/miss and breaks the IR004 baseline
+            # band. Donation correctness is IR002's job; this number
+            # only needs to be a deterministic drift detector.
+            peak_bytes=arg + out + tmp,
         )
     return facts
 

@@ -12,11 +12,31 @@ covers. Loading reassembles per-array numpy buffers from the slices it
 needs and `jax.device_put`s them with the TARGET sharding — re-sharding is
 just placement, XLA/jax lay out the bytes. Replicated shards are deduped by
 slice signature, so a fully-replicated array stores one copy.
+
+**Streaming load** (`stream_load_state`, `load_state(..., stream=True)`):
+the serving spin-up path. Instead of assembling each array's FULL host
+buffer and re-sharding it on device (two full materializations — the
+thing a model bigger than one chip cannot survive), every target shard
+slice is read straight out of the stored npz members (memory-mapped:
+`np.savez` stores members uncompressed, so each is a plain ``.npy`` at a
+computable offset and slicing touches only its pages), `device_put` onto
+exactly its owning device, and the global array assembled with
+`jax.make_array_from_single_device_arrays` — the allocate-sharded-from-
+the-start discipline of spmd's jit-with-out_shardings zeros builder,
+applied to placement-from-disk. Host staging peaks at ONE shard slice;
+no chip ever holds more than its shards. The returned
+`StreamLoadReport` carries the measured bounds
+(``peak_host_bytes`` / ``max_chip_bytes``) that
+tests/test_stream_checkpoint.py and the engine's ``param_hbm_bytes``
+budget assert.
 """
 from __future__ import annotations
 
 import json
 import os
+import struct
+import time
+import zipfile
 
 import numpy as np
 
@@ -165,12 +185,21 @@ def _assemble(path, key, entry):
     return out
 
 
-def load_state(path, shardings=None, keys=None):
+def load_state(path, shardings=None, keys=None, stream=False):
     """Load a sharded checkpoint, re-sharding onto `shardings`.
 
     shardings: None (host numpy arrays), a single jax Sharding applied to
     every array, or a {path-key: Sharding} dict (missing keys load
-    replicated-on-default-device). Returns the nested dict structure."""
+    replicated-on-default-device). Returns the nested dict structure.
+
+    stream=True switches to the shard-streaming path (`stream_load_state`):
+    each array is placed slice-by-slice straight onto its target devices —
+    the full array is never staged in one host buffer and no chip ever
+    holds more than its own shards. All arrays come back as jax Arrays
+    (keys without a sharding land replicated on the default device)."""
+    if stream:
+        tree, _ = stream_load_state(path, shardings, keys=keys)
+        return tree
     with open(os.path.join(path, "index.json")) as f:
         index = json.load(f)
     if index.get("format") != _FORMAT:
@@ -186,6 +215,210 @@ def load_state(path, shardings=None, keys=None):
             sh = shardings.get(key) if isinstance(shardings, dict) else shardings
             flat[key] = jax.device_put(arr, sh) if sh is not None else jnp.asarray(arr)
     return _unflatten(flat)
+
+
+class _ShardReader:
+    """Lazy, zero-copy access to the members of a checkpoint's npz shards.
+
+    `np.savez` (no compression — how save_state writes) stores each member
+    as a plain ``<key>.npy`` file inside the zip, byte-for-byte. So a
+    member can be memory-mapped in place: seek to the zip local file
+    header, skip its fixed 30 bytes plus the name/extra fields, parse the
+    npy header, and `np.memmap` the payload. Slicing the map then reads
+    ONLY the pages the slice touches — the member is never loaded whole.
+    Members that can't be mapped (compressed, Fortran-order, object
+    dtype) fall back to a whole-member `np.load`, which is still bounded
+    by one stored shard, not one global array."""
+
+    def __init__(self, path):
+        self._path = path
+        self._members = {}   # file -> {key: (payload_offset, dtype, shape) | None}
+        self._fallback = {}  # file -> NpzFile (only for unmappable members)
+
+    def _index_file(self, file):
+        fn = os.path.join(self._path, file)
+        members = {}
+        with zipfile.ZipFile(fn) as zf, open(fn, "rb") as f:
+            for info in zf.infolist():
+                name = info.filename
+                key = name[: -len(".npy")] if name.endswith(".npy") else name
+                if info.compress_type != zipfile.ZIP_STORED:
+                    members[key] = None
+                    continue
+                # zip local file header: 30 fixed bytes; name/extra lengths
+                # live at struct offsets 26/28 (the central directory's
+                # copies can differ, so read the local ones)
+                f.seek(info.header_offset)
+                hdr = f.read(30)
+                nlen, elen = struct.unpack("<HH", hdr[26:30])
+                f.seek(info.header_offset + 30 + nlen + elen)
+                version = np.lib.format.read_magic(f)
+                if version == (1, 0):
+                    shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+                elif version == (2, 0):
+                    shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+                else:
+                    members[key] = None
+                    continue
+                if fortran or dtype.hasobject:
+                    members[key] = None
+                    continue
+                members[key] = (f.tell(), dtype, shape)
+        self._members[file] = members
+
+    def view(self, file, key):
+        """A read-only array view of one stored member (memmap when
+        possible)."""
+        if file not in self._members:
+            self._index_file(file)
+        meta = self._members[file].get(key)
+        if meta is None:
+            npz = self._fallback.get(file)
+            if npz is None:
+                npz = self._fallback[file] = np.load(
+                    os.path.join(self._path, file))
+            return npz[key]
+        offset, dtype, shape = meta
+        return np.memmap(os.path.join(self._path, file), dtype=dtype,
+                         mode="r", offset=offset, shape=shape)
+
+
+class StreamLoadReport:
+    """Measured bounds of one streaming load — the proof the streaming
+    path is actually bounded, asserted by tests and the engine's
+    `param_hbm_bytes` budget.
+
+    - total_bytes: logical size of everything loaded (the full tree).
+    - peak_host_bytes: largest single host staging buffer — one shard
+      slice, NOT the tree (the old `_assemble` path peaks at the largest
+      full array and the engine path before it at the whole tree).
+    - chip_bytes / max_chip_bytes: bytes placed per device — each chip
+      holds exactly its shards.
+    """
+
+    def __init__(self):
+        self.arrays = 0
+        self.total_bytes = 0
+        self.peak_host_bytes = 0
+        self.chip_bytes = {}  # jax Device -> bytes placed on it
+        self.seconds = 0.0
+
+    @property
+    def max_chip_bytes(self):
+        return max(self.chip_bytes.values(), default=0)
+
+    def note_host(self, nbytes):
+        self.peak_host_bytes = max(self.peak_host_bytes, int(nbytes))
+
+    def note_chip(self, dev, nbytes):
+        self.chip_bytes[dev] = self.chip_bytes.get(dev, 0) + int(nbytes)
+
+    def summary(self):
+        return {
+            "arrays": self.arrays,
+            "total_bytes": self.total_bytes,
+            "peak_host_bytes": self.peak_host_bytes,
+            "max_chip_bytes": self.max_chip_bytes,
+            "devices": len(self.chip_bytes),
+            "seconds": round(self.seconds, 3),
+        }
+
+
+def _gather_slice(reader, key, stored, shape, dtype, want):
+    """Read the half-open box `want` ([[start, stop], ...] over the global
+    shape) out of the stored shards, touching only the bytes inside it."""
+    # fast path: one stored shard fully contains the wanted box — slice
+    # its memmap directly (a single contiguous-ified copy of exactly the
+    # slice, no assembly buffer)
+    for s in stored:
+        have = s["index"]
+        if all(ha <= wa and wb <= hb
+               for (wa, wb), (ha, hb) in zip(want, have)):
+            view = reader.view(s["file"], s["key"])
+            rel = tuple(slice(wa - ha, wb - ha)
+                        for (wa, wb), (ha, hb) in zip(want, have))
+            return np.ascontiguousarray(view[rel])
+    # general path (target sharding finer/skew vs stored): assemble the
+    # wanted box — still only slice-sized, never the global array
+    out = np.empty(tuple(b - a for a, b in want), dtype)
+    filled = np.zeros(out.shape, bool)
+    for s in stored:
+        have = s["index"]
+        inter = [(max(wa, ha), min(wb, hb))
+                 for (wa, wb), (ha, hb) in zip(want, have)]
+        if any(a >= b for a, b in inter):
+            continue
+        view = reader.view(s["file"], s["key"])
+        src = tuple(slice(a - ha, b - ha)
+                    for (a, b), (ha, _hb) in zip(inter, have))
+        dst = tuple(slice(a - wa, b - wa)
+                    for (a, b), (wa, _wb) in zip(inter, want))
+        out[dst] = view[src]
+        filled[dst] = True
+    if not filled.all():
+        raise ValueError(
+            f"checkpoint: array {key!r} slice {want} has missing regions — "
+            "were all ranks' shard files copied?"
+        )
+    return out
+
+
+def stream_load_state(path, shardings=None, keys=None):
+    """Stream a sharded checkpoint straight to device placement.
+
+    For every array, the target sharding's per-device slice boxes are
+    gathered one at a time from the stored (memory-mapped) npz shards,
+    `jax.device_put` onto exactly their owning device, and stitched into
+    the global array with `jax.make_array_from_single_device_arrays`. The
+    full array is never staged on the host and no device ever receives
+    more than its own shards — bounds the returned `StreamLoadReport`
+    records.
+
+    shardings: a jax Sharding, a {path-key: Sharding} dict, or None;
+    arrays without one land replicated on the default device (they're
+    still streamed — the host bound holds, the chip bound is theirs to
+    pay). Returns `(nested_state_dict, StreamLoadReport)`."""
+    with open(os.path.join(path, "index.json")) as f:
+        index = json.load(f)
+    if index.get("format") != _FORMAT:
+        raise ValueError(f"not a paddle_tpu dist checkpoint: {path}")
+    reader = _ShardReader(path)
+    report = StreamLoadReport()
+    t0 = time.monotonic()
+    default_sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    flat = {}
+    for key, entry in index["arrays"].items():
+        if keys is not None and key not in keys:
+            continue
+        sh = shardings.get(key) if isinstance(shardings, dict) else shardings
+        if sh is None:
+            sh = default_sh
+        shape = tuple(entry["shape"])
+        dtype = np.dtype(entry["dtype"])
+        stored = [dict(s, index=[tuple(ab) for ab in s["index"]])
+                  for s in entry["shards"]]
+        # group devices by slice box so a replicated/partially-replicated
+        # leaf is staged on the host once, not once per device
+        groups = {}
+        for dev, idx in sh.addressable_devices_indices_map(shape).items():
+            sig = tuple(map(tuple, _shard_slices(idx, shape)))
+            groups.setdefault(sig, []).append(dev)
+        pieces = []
+        for sig, devs in groups.items():
+            want = [list(ab) for ab in sig]
+            piece = _gather_slice(reader, key, stored, shape, dtype, want)
+            report.note_host(piece.nbytes)
+            for dev in devs:
+                arr = jax.device_put(piece, dev)
+                report.note_chip(dev, arr.nbytes)
+                pieces.append(arr)
+            del piece
+        flat[key] = jax.make_array_from_single_device_arrays(
+            shape, sh, pieces)
+        report.total_bytes += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        report.arrays += 1
+    report.seconds = time.monotonic() - t0
+    return _unflatten(flat), report
 
 
 def save_sharded_model(model, optimizer, path, opt_state=None, save_id=None):
@@ -212,9 +445,12 @@ def save_sharded_model(model, optimizer, path, opt_state=None, save_id=None):
     save_state(state, path, save_id=save_id)
 
 
-def load_sharded_model(model, optimizer, path, mesh=None, param_specs=None):
+def load_sharded_model(model, optimizer, path, mesh=None, param_specs=None,
+                       stream=False):
     """Load a sharded checkpoint into a model/optimizer, re-sharding params
-    onto `mesh` with `param_specs` ({name: PartitionSpec}) when given."""
+    onto `mesh` with `param_specs` ({name: PartitionSpec}) when given.
+    stream=True places shard-by-shard (see `stream_load_state`) instead of
+    assembling full host buffers first."""
     from jax.sharding import NamedSharding
 
     shardings = None
@@ -222,7 +458,7 @@ def load_sharded_model(model, optimizer, path, mesh=None, param_specs=None):
         shardings = {}
         for k, spec in param_specs.items():
             shardings[f"params{_SEP}{k}"] = NamedSharding(mesh, spec)
-    state = load_state(path, shardings=shardings)
+    state = load_state(path, shardings=shardings, stream=stream)
     pmap = model.named_parameters_dict()
     for k, arr in state.get("params", {}).items():
         if k in pmap:

@@ -309,3 +309,82 @@ class Layer:
             lines.extend("  " + l for l in sub_repr[1:])
         lines.append(")")
         return "\n".join(lines) if len(lines) > 2 else lines[0] + ")"
+
+
+# -- skeleton construction (streamed checkpoint serving) ---------------------
+
+import contextlib
+
+
+@contextlib.contextmanager
+def skeleton_init():
+    """Build a Layer tree WITHOUT materializing parameter data.
+
+    Inside this context every `create_parameter` call skips its
+    initializer and returns a `Parameter` whose ``_array`` is a
+    ``jax.ShapeDtypeStruct`` — shape/dtype metadata with zero bytes
+    behind it. The resulting model is a STRUCTURE: config, forward
+    graph, parameter names, and ``sharding_axes`` annotations are all
+    real, but the weights are abstract. It exists for the streamed
+    checkpoint construction path
+    (``LLMEngine(model, checkpoint_path=..., mesh=N)``): the engine
+    serves from its own streamed, mesh-placed param dict (threaded
+    through `functional_call`), so a model too large for one chip never
+    has to materialize anywhere::
+
+        with skeleton_init():
+            model = GPT(cfg)            # O(1) memory, any cfg size
+        eng = LLMEngine(model, checkpoint_path=ckpt, mesh=4)
+
+    A skeleton model cannot run eagerly (jnp ops reject
+    ShapeDtypeStruct loudly) and the engine refuses to build one without
+    ``checkpoint_path``. The patch is process-global while the context
+    is open — construct skeletons one at a time, not concurrently with
+    other layer construction.
+    """
+    import jax
+
+    from ..core.tensor import _new_name
+
+    def _skeleton_create_parameter(self, shape, attr=None, dtype=None,
+                                   is_bias=False, default_initializer=None):
+        del default_initializer, is_bias   # metadata-only construction
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype or get_default_dtype()
+        p = Parameter.__new__(Parameter)
+        p._array = jax.ShapeDtypeStruct(
+            tuple(int(s) for s in shape), convert_dtype(dtype))
+        p.stop_gradient = not attr.trainable
+        p._grad = None
+        p._node = None
+        p._out_index = 0
+        p._retain_grads = False
+        p.name = attr.name or _new_name()
+        p.is_leaf = True
+        p.persistable = True
+        p.trainable = attr.trainable
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        p.sharding_axes = None
+        p.process_mesh = None
+        return p
+
+    orig = Layer.create_parameter
+    Layer.create_parameter = _skeleton_create_parameter
+    try:
+        yield
+    finally:
+        Layer.create_parameter = orig
+
+
+def is_skeleton(layer):
+    """True when `layer` was built under `skeleton_init` (any parameter
+    is an abstract ShapeDtypeStruct instead of a placed array)."""
+    import jax
+
+    for _, p in layer.named_parameters():
+        return isinstance(p._array, jax.ShapeDtypeStruct)
+    return False

@@ -42,6 +42,21 @@ rejection, and rolling drain. `RouterServer` (server.py, or
 ``python -m paddle_tpu.serving.server --replicas N``) is the fleet HTTP
 surface. See README "Fleet routing".
 
+**Elastic fleet** (serving/lifecycle.py + serving/autoscale.py +
+distributed/checkpoint.py streaming load): replicas are born by
+streaming a sharded checkpoint straight to mesh placement —
+``LLMEngine(checkpoint_path=..., mesh=N)`` on a ``skeleton_init()``
+model never materializes the full tree on any host or chip
+(``param_hbm_bytes`` asserts the bound) — carry an explicit
+cold → loading → warm → serving → draining → stopped lifecycle
+(`ReplicaLifecycle`, on ``/healthz`` and ``/metrics``; ``warmup=True``
+precompiles every width bucket so the first served request retraces
+nothing), and are spawned/retired by the SLO-driven `AutoScaler` on the
+router (windowed deadline attainment + predicted queue wait →
+factory-spawned scale-up with a measured spawn-TTFT bound, drain +
+KV-migration scale-down; decisions at ``GET /debug/autoscale``). See
+README "Elastic fleet".
+
 Quickstart::
 
     from paddle_tpu.models.gpt import gpt_tiny
@@ -94,7 +109,9 @@ from .frontend import (  # noqa: F401
     EngineOverloadedError,
     RequestStream,
 )
+from .autoscale import AutoScaler  # noqa: F401
 from .kv_tier import KVTier  # noqa: F401
+from .lifecycle import LifecycleError, ReplicaLifecycle  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .postmortem import FlightRecorder  # noqa: F401
 from .router import (  # noqa: F401

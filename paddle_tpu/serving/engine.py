@@ -214,7 +214,9 @@ class LLMEngine:
                  postmortem_keep=None, width_buckets=None,
                  host_kv_blocks=None, host_swap_chunk=4,
                  kv_dtype=None, quantize=None, calib_prompts=None,
-                 quantize_iters=300, quant_allreduce=None):
+                 quantize_iters=300, quant_allreduce=None,
+                 checkpoint_path=None, param_hbm_bytes=None,
+                 warmup=False):
         import jax
 
         from .sharded import as_serving_mesh, kv_capacity_blocks
@@ -252,6 +254,14 @@ class LLMEngine:
             if quantize != "int8":
                 raise ValueError(
                     f"quantize={quantize!r} not supported — only 'int8'")
+            if checkpoint_path is not None:
+                raise ValueError(
+                    "checkpoint_path and quantize are mutually exclusive: "
+                    "AdaRound calibrates against eager weights the "
+                    "streamed engine never materializes — quantize a "
+                    "single-chip engine, save_sharded_model its weights, "
+                    "then serve THAT checkpoint (kv_dtype='int8' composes "
+                    "with streaming as-is)")
             if self._smesh is not None:
                 raise ValueError(
                     "quantize='int8' requires mesh=None: AdaRound "
@@ -404,6 +414,14 @@ class LLMEngine:
                 buckets.add(w)   # wider than any plannable row: useless
         self.width_buckets = sorted(buckets)
         self.metrics = ServingMetrics()
+        # replica lifecycle (serving/lifecycle.py): this constructor
+        # drives cold -> loading (weight placement below) -> warm (end of
+        # __init__, after the optional warmup wave); the async frontend
+        # and router drive serving/draining/stopped. Surfaced on
+        # /healthz, /metrics (lifecycle_state gauge), and /debug/router.
+        from .lifecycle import ReplicaLifecycle
+
+        self.lifecycle = ReplicaLifecycle(metrics=self.metrics)
         # tracing: off unless trace/PADDLE_TPU_TRACE asks for it. A value
         # in (0, 1) samples that fraction of requests; the step timeline
         # is always recorded while the tracer exists. When off, tracer is
@@ -454,9 +472,33 @@ class LLMEngine:
         self.slo = (SLOLedger(metrics=self.metrics)
                     if slo_on or self.request_log
                     or self.recorder is not None else None)
-        self._params, self._buffers = state_dict_arrays(model)
+        # weight placement — two paths:
+        #  - eager (checkpoint_path=None): the model's resident arrays are
+        #    the source; sharded engines device_put them once. The full
+        #    tree necessarily exists on the model's device first, which is
+        #    exactly what a model bigger than one chip cannot do.
+        #  - streamed (checkpoint_path=...): weights stream shard-by-shard
+        #    from disk straight onto their serving placement
+        #    (distributed/checkpoint.py stream_load_state) — no full host
+        #    buffer, no chip beyond its own shards. The model may be a
+        #    `skeleton_init()` shell (ShapeDtypeStruct "arrays" carrying
+        #    only shape/dtype/sharding_axes); the engine serves from
+        #    self._params via functional_call, so the shell never needs
+        #    real numbers.
+        from ..nn.layer import is_skeleton
+
+        self.checkpoint_path = checkpoint_path
+        self.load_report = None
+        self.lifecycle.to("loading", "placing weights")
+        if is_skeleton(model) and checkpoint_path is None:
+            raise ValueError(
+                "model was built under skeleton_init() (no real weight "
+                "arrays) — pass checkpoint_path= so the engine can stream "
+                "weights from disk, or build the model eagerly")
         self._param_shardings = self._buffer_shardings = None
-        if self._smesh is not None:
+        if checkpoint_path is not None:
+            self._stream_params_from_checkpoint(checkpoint_path)
+        elif self._smesh is not None:
             # place weights once at construction: attention heads / FFN
             # columns / vocab rows over 'tp' (serving_param_specs is the
             # model's own Megatron sharding_axes renamed mp -> tp),
@@ -465,6 +507,7 @@ class LLMEngine:
             # re-happens per step.
             from .sharded import serving_param_specs
 
+            self._params, self._buffers = state_dict_arrays(model)
             specs = serving_param_specs(model, self._smesh)
             self._param_shardings = {
                 k: self._smesh.named(*specs[k]) for k in self._params
@@ -480,6 +523,24 @@ class LLMEngine:
                 k: jax.device_put(v, self._buffer_shardings[k])
                 for k, v in self._buffers.items()
             }
+        else:
+            self._params, self._buffers = state_dict_arrays(model)
+        # per-chip parameter budget: fail AT CONSTRUCTION, naming the
+        # overage, when any single device holds more parameter bytes than
+        # allowed. `param_bytes_by_device` counts the model's own resident
+        # arrays too, so the eager path is (correctly) charged for its
+        # full-tree source copy — the streamed+skeleton path is not.
+        self.param_hbm_bytes = (None if param_hbm_bytes is None
+                                else int(param_hbm_bytes))
+        if self.param_hbm_bytes is not None:
+            peak = max(self.param_bytes_by_device().values(), default=0)
+            if peak > self.param_hbm_bytes:
+                raise ValueError(
+                    f"param_hbm_bytes {self.param_hbm_bytes}: a device "
+                    f"holds {peak} parameter bytes — the model does not "
+                    "fit one chip. Serve it from a sharded checkpoint "
+                    "(LLMEngine(skeleton, checkpoint_path=..., mesh=N)) "
+                    "so no chip ever materializes the full tree")
         dt = model.wte.weight._array.dtype
         self.pool = BlockPool(
             num_blocks, cfg.num_layers, self.block_size, cfg.num_heads,
@@ -547,6 +608,185 @@ class LLMEngine:
         self.step_count = 0      # planned steps run (bisection probes too)
         self.last_planned = []   # request ids of the most recent plan
         self.step_faults = []    # (rid, detail) rows contained this step
+        # warm: weights are placed; warmup=True additionally compiles the
+        # FULL width-bucket program table now (synthetic wave below) so
+        # the first served request never pays an XLA compile inside its
+        # TTFT — lifecycle.warmed records which guarantee holds.
+        if warmup:
+            self.warmup()
+        self.lifecycle.to("warm", "weights placed"
+                          + (" + programs compiled" if warmup else ""))
+
+    # -- construction helpers ----------------------------------------------
+
+    def _stream_params_from_checkpoint(self, path):
+        """Stream weights from a sharded checkpoint straight onto their
+        serving placement (distributed/checkpoint.py `stream_load_state`):
+        per-leaf, per-shard device_put against `serving_param_specs`. The
+        full tree never exists on one host buffer or one chip; the
+        measured bounds land in `self.load_report` (a StreamLoadReport)
+        and on /metrics."""
+        import jax
+
+        from ..distributed.checkpoint import stream_load_state
+
+        pmap = self.model.named_parameters_dict()
+        bmap = self.model.named_buffers_dict()
+        if self._smesh is not None:
+            from .sharded import serving_param_specs
+
+            specs = serving_param_specs(self.model, self._smesh)
+            self._param_shardings = {
+                k: self._smesh.named(*specs[k]) for k in pmap
+            }
+            self._buffer_shardings = {
+                k: self._smesh.replicated() for k in bmap
+            }
+        else:
+            one = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+            self._param_shardings = {k: one for k in pmap}
+            self._buffer_shardings = {k: one for k in bmap}
+        shardings = {f"params/{k}": s
+                     for k, s in self._param_shardings.items()}
+        shardings.update({f"buffers/{k}": s
+                          for k, s in self._buffer_shardings.items()})
+        state, report = stream_load_state(path, shardings,
+                                          keys=set(shardings))
+        got_p = state.get("params", {})
+        got_b = state.get("buffers", {})
+        missing = ([f"params/{k}" for k in pmap if k not in got_p]
+                   + [f"buffers/{k}" for k in bmap if k not in got_b])
+        if missing:
+            raise ValueError(
+                f"checkpoint {path!r} is missing model arrays "
+                f"{missing[:4]}{' ...' if len(missing) > 4 else ''} — was "
+                "it saved from this architecture (save_sharded_model)?")
+
+        def _check(kind, k, want, got):
+            if (tuple(got.shape) != tuple(want.shape)
+                    or got.dtype != want.dtype):
+                raise ValueError(
+                    f"checkpoint {path!r}: {kind} {k!r} is "
+                    f"{got.dtype}{tuple(got.shape)} but the model "
+                    f"declares {want.dtype}{tuple(want.shape)} — "
+                    "checkpoint and model config disagree")
+
+        for k, t in pmap.items():
+            _check("param", k, t._array, got_p[k])
+        for k, t in bmap.items():
+            _check("buffer", k, t._array, got_b[k])
+        self._params = {k: got_p[k] for k in pmap}
+        self._buffers = {k: got_b[k] for k in bmap}
+        self.load_report = report
+        self.metrics.set_gauge("ckpt_stream_peak_host_bytes",
+                               float(report.peak_host_bytes))
+        self.metrics.set_gauge("ckpt_stream_max_chip_bytes",
+                               float(report.max_chip_bytes))
+        self.metrics.set_gauge("ckpt_stream_seconds", report.seconds)
+
+    def param_bytes_by_device(self):
+        """Resident parameter/buffer bytes per device: the engine's placed
+        arrays PLUS any real arrays the model itself still holds (the
+        eager path's full-tree source copy — exactly why that path cannot
+        satisfy a per-chip budget a too-big model needs), deduped by
+        identity. The `param_hbm_bytes` budget checks the max of this."""
+        import jax
+
+        seen, out = set(), {}
+
+        def note(a):
+            if not isinstance(a, jax.Array) or id(a) in seen:
+                return
+            seen.add(id(a))
+            for sh in a.addressable_shards:
+                out[sh.device] = out.get(sh.device, 0) + int(sh.data.nbytes)
+
+        for a in self._params.values():
+            note(a)
+        for a in self._buffers.values():
+            note(a)
+        for m in (self.model.named_parameters_dict(),
+                  self.model.named_buffers_dict()):
+            for t in m.values():
+                note(getattr(t, "_array", None))
+        return out
+
+    def warmup(self):
+        """Compile the engine's ENTIRE width-bucket program table by
+        serving one synthetic request per bucket, one at a time (a batch
+        of mixed widths would compile only its widest bucket):
+
+        - a bucket ``W <= prefill_chunk`` is reached by a prompt of
+          exactly ``W`` tokens — its first prefill chunk has width W, the
+          planner picks the smallest covering bucket, W itself;
+        - a spec bucket wider than ``prefill_chunk`` is only reachable as
+          a drafted decode step, so its request carries a cyclic prompt
+          the n-gram drafter always matches, forcing one full-width
+          draft+verify step.
+
+        Prefix caching is suspended for the duration (synthetic prompts
+        must not seed the cache or dodge compilation via a hit). Programs
+        land in the ordinary jit dispatch cache — the same cache served
+        steps hit — so after warmup the first real step is 0 retraces
+        (the `jit_traces` sentinel's warm guarantee, recorded on
+        `lifecycle.warmed`). Returns the number of compiled programs."""
+        if self.has_unfinished():
+            raise RuntimeError(
+                "warmup() requires an idle engine — it serves synthetic "
+                "requests through the real step path")
+        t0 = time.monotonic()
+        expected = self.expected_program_count()
+        pc_engine, pc_sched = self.prefix_cache, self.scheduler.prefix_cache
+        self.prefix_cache = self.scheduler.prefix_cache = False
+        try:
+            for W in self.width_buckets:
+                if (self.max_batch, W) in self._step_fns:
+                    continue  # coinciding widths dedup
+                if W <= self.prefill_chunk:
+                    plen = min(W, self.max_seq_len - 1)
+                    prompt = [0] * plen
+                    mnt = 1
+                else:
+                    # drafted-only bucket (1 + num_spec_tokens beyond the
+                    # chunk): cyclic prompt -> the n-gram drafter proposes
+                    # a full draft on the first decode step
+                    mnt = self.num_spec_tokens + 2
+                    plen = max(1, min(self.prefill_chunk,
+                                      self.max_seq_len - mnt))
+                    prompt = [(i % 3) + 1 for i in range(plen)]
+                rid = self.add_request(prompt, max_new_tokens=mnt,
+                                       temperature=0.0,
+                                       tenant="_warmup")
+                for _ in range(8 * mnt + 8):
+                    if not self.has_unfinished():
+                        break
+                    self.step()
+                    if (self.max_batch, W) in self._step_fns:
+                        # bucket compiled — the rest of this request is
+                        # redundant work
+                        if rid in self._requests:
+                            self.abort(rid)
+                        break
+                else:
+                    raise RuntimeError(
+                        f"warmup: synthetic request for bucket {W} never "
+                        "finished")
+        finally:
+            self.prefix_cache = pc_engine
+            self.scheduler.prefix_cache = pc_sched
+        compiled = len(self._step_fns)
+        if compiled < expected:
+            missing = [W for W in self.width_buckets
+                       if (self.max_batch, W) not in self._step_fns]
+            raise RuntimeError(
+                f"warmup compiled {compiled}/{expected} width-bucket "
+                f"programs — buckets {missing} were never exercised")
+        self.lifecycle.warmed = True
+        self.lifecycle.programs_compiled = compiled
+        self.metrics.set_gauge("warmup_programs", float(compiled))
+        self.metrics.set_gauge("warmup_seconds",
+                               round(time.monotonic() - t0, 3))
+        return compiled
 
     # -- request lifecycle -------------------------------------------------
 

@@ -213,6 +213,34 @@ class AsyncLLMEngine:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def _lc_to(self, state, reason):
+        """Drive the engine's lifecycle word (serving/lifecycle.py) from
+        the frontend's admission/thread events. Guarded twice: a wrapped
+        engine without a lifecycle (test doubles) is a no-op, and racing
+        daemons (a watchdog trip vs the thread-death epilogue) may lose
+        the race to a terminal state — a late illegal edge is dropped
+        here, not raised into a crash handler."""
+        lc = getattr(self.engine, "lifecycle", None)
+        if lc is None:
+            return
+        from .lifecycle import LifecycleError
+        try:
+            lc.to(state, reason)
+        except LifecycleError:
+            pass
+
+    def lifecycle_state(self):
+        """The engine's lifecycle word (``"cold"``..``"stopped"``), or
+        None for engines without one. The fleet router's half-open probe
+        consults THIS instead of firing a trial request at a replica
+        that is still loading/compiling."""
+        lc = getattr(self.engine, "lifecycle", None)
+        return None if lc is None else lc.state
+
+    def lifecycle_snapshot(self):
+        lc = getattr(self.engine, "lifecycle", None)
+        return None if lc is None else lc.snapshot()
+
     async def start(self):
         """Bind to the running event loop and start the engine thread."""
         if self._thread is not None:
@@ -232,6 +260,7 @@ class AsyncLLMEngine:
         self._thread.start()
         if self._watchdog is not None:
             self._watchdog.start()
+        self._lc_to("serving", "start")
         return self
 
     @property
@@ -259,8 +288,14 @@ class AsyncLLMEngine:
         disagree. Precedence: a dead engine thread outranks everything
         (nothing can serve), sticky-unhealthy (watchdog trip, thread
         death recorded by the crash handler) outranks draining, and
-        draining (admission closed, or never started) outranks ok."""
+        draining (admission closed, or never started) outranks ok.
+        The snapshot carries the engine's lifecycle word (when it has
+        one) so every surface rendering health shows the replica's
+        birth/death phase too."""
         h = self.health.snapshot()
+        lc = getattr(self.engine, "lifecycle", None)
+        if lc is not None:
+            h["lifecycle"] = lc.state
         thread_dead = self._thread is not None and not self._thread.is_alive()
         if thread_dead or (not h["healthy"] and h.get("reason") in
                            ("engine_thread_died", "engine_thread_wedged")):
@@ -276,6 +311,7 @@ class AsyncLLMEngine:
         stopping the step loop — the load-balancer drain pattern: stop
         taking traffic first, `shutdown()` once drained."""
         self._closed = True
+        self._lc_to("draining", "stop_admitting")
 
     def resume_admitting(self):
         """Reopen admission after `stop_admitting` — the restartless half
@@ -297,6 +333,7 @@ class AsyncLLMEngine:
             )
         # jaxlint: disable=JL010 -- GIL-atomic bool flag, benign race by design: a submit racing a drain flip is re-checked on the engine thread (draining adds reject)
         self._closed = False
+        self._lc_to("serving", "resume_admitting")
 
     async def shutdown(self, drain=True, timeout_s=30.0):
         """Graceful drain: stop admitting, finish (or, past ``timeout_s``,
@@ -307,7 +344,11 @@ class AsyncLLMEngine:
         loop-side state is cleaned up anyway (streams terminated, callers
         released) and the daemon thread is left to the OS."""
         self._closed = True
+        self._lc_to("draining", "shutdown")
         if self._thread is None:
+            # never started: there is no engine loop whose epilogue would
+            # stamp the terminal state — do it here
+            self._lc_to("stopped", "shutdown before start")
             return
         self._cmds.put(("stop", bool(drain)))
         stopped = await self._await_stopped(
@@ -568,6 +609,7 @@ class AsyncLLMEngine:
         consumer gets a structured terminal error instead of silence."""
         self._sup.on_watchdog_trip(stuck_for_s)   # health + metrics + trace
         self._closed = True
+        self._lc_to("draining", "watchdog_trip")
         self._to_loop([(
             "fail_all", None, "error",
             f"step_stuck: engine step has been running for "
@@ -614,6 +656,10 @@ class AsyncLLMEngine:
                            health=self.health.snapshot())
         finally:
             self._closed = True
+            # terminal lifecycle stamp: exactly one, from the one thread
+            # that owns "the engine can no longer step" (clean stop and
+            # crash alike end here)
+            self._lc_to("stopped", "engine thread exited")
             if self._watchdog is not None:
                 self._watchdog.request_stop()
             try:

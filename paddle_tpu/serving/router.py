@@ -113,6 +113,8 @@ class Replica:
             "name": self.name,
             "state": self.state,
             "healthz": state,
+            "lifecycle": getattr(self.engine, "lifecycle_state",
+                                 lambda: None)(),
             "inflight": self.engine.inflight,
             "eject_reason": self.eject_reason,
             "probe_failures": self.probe_failures,
@@ -705,6 +707,13 @@ class ReplicaRouter:
             self.metrics.inc("router_migrated_blocks", n)
             self._log_event(replica, "migrate", f"{n} blocks salvaged")
 
+    @staticmethod
+    def engine_lifecycle(replica):
+        """The replica engine's lifecycle word, or None (test doubles
+        without one)."""
+        fn = getattr(replica.engine, "lifecycle_state", None)
+        return None if fn is None else fn()
+
     async def _sweep_loop(self):
         while True:
             await asyncio.sleep(self.sweep_interval_s)
@@ -748,7 +757,21 @@ class ReplicaRouter:
         """Half-open re-admission: restart a sticky-unhealthy/dead
         replica through the factory (if any), then prove it serves with
         ONE trial request. Pass → back in rotation; fail → ejected with
-        exponential probe backoff."""
+        exponential probe backoff. A replica still being BORN — lifecycle
+        cold/loading/warm, i.e. streaming its weights or compiling its
+        program table — is never probed with traffic: the trial would
+        time out against compile latency and punish the replica with
+        exponential backoff for being mid-birth. It is deferred at the
+        base probe interval (no failure counted) until its lifecycle
+        reaches serving/draining/stopped, then probed normally."""
+        lc = self.engine_lifecycle(replica)
+        if lc in ("cold", "loading", "warm"):
+            replica.state = EJECTED
+            replica.next_probe_at = time.monotonic() + self.probe_interval_s
+            self.metrics.inc("router_probe_deferrals")
+            self._log_event(replica, "probe_deferred", f"lifecycle:{lc}")
+            self._update_gauges()
+            return
         self.metrics.inc("router_probes")
         ok = False
         try:
@@ -882,6 +905,102 @@ class ReplicaRouter:
                     r.state = ACTIVE
                 self._update_gauges()
         return drained
+
+    # -- elastic fleet (serving/autoscale.py drives these) -------------------
+
+    def next_index(self):
+        """The next free replica index — what the autoscaler passes to
+        the factory for a spawn. Indices are never reused within a
+        router's life, so a replica's name stays unambiguous in the
+        event log across scale-up/-down cycles."""
+        return max((r.index for r in self._replicas), default=-1) + 1
+
+    async def add_replica(self, engine, name=None, index=None):
+        """Scale-up: wrap + start `engine` and put it in rotation.
+        The engine should arrive warm (the factory path: streamed
+        checkpoint load + warmup wave), so `start()` is the only latency
+        between this call and the replica taking traffic. Returns the
+        new `Replica`."""
+        if index is None:
+            index = self.next_index()
+        r = Replica(name or f"r{index}", self._wrap(engine), index)
+        bs = r.engine.engine.block_size
+        if bs != self._block_size:
+            raise ValueError(
+                f"new replica block_size {bs} != fleet {self._block_size}"
+                " — the prefix-affinity key space must stay shared")
+        if not r.engine.started:
+            await r.engine.start()
+        self._replicas.append(r)
+        self.metrics.inc("router_scale_ups")
+        self._log_event(r, "add")
+        self._update_gauges()
+        return r
+
+    async def retire_replica(self, replica=None, drain_timeout_s=60.0):
+        """Scale-down: drain ONE replica out of rotation for good — stop
+        routing to it, close its admission, wait for in-flight zero
+        (bounded by `drain_timeout_s`), hand its warm host-tier KV blocks
+        to the survivors (``migrate_on_drain``: the drained engine is
+        quiescent, so ``demote=True`` carries device-cached prefixes too
+        — scale-down is zero-rewarm), shut it down, and remove it.
+        `replica` may be a `Replica`, a name, or None (the highest-index
+        active replica). Refuses to retire the last active replica.
+        Returns the retired replica's name."""
+        if isinstance(replica, str):
+            name = replica
+            replica = next((r for r in self._replicas if r.name == name),
+                           None)
+            if replica is None:
+                raise ValueError(f"no replica named {name!r}")
+        active = [r for r in self._replicas if r.state == ACTIVE]
+        if replica is None:
+            replica = max(active, key=lambda r: r.index, default=None)
+        if replica is None or replica not in self._replicas:
+            raise ValueError("no replica eligible to retire")
+        if not [r for r in active if r is not replica]:
+            raise ValueError(
+                "cannot retire the last active replica — the fleet would "
+                "stop serving (lower autoscale min_replicas instead?)")
+        replica.router_draining = True
+        replica.state = DRAINING
+        replica.engine.stop_admitting()
+        self.metrics.inc("router_drains")
+        self._log_event(replica, "retire")
+        self._update_gauges()
+        t0 = time.monotonic()
+        while (replica.engine.inflight > 0
+               and time.monotonic() - t0 < drain_timeout_s):
+            await asyncio.sleep(0.02)
+        if self.migrate_on_drain:
+            try:
+                payload = await asyncio.to_thread(
+                    replica.engine.engine.export_kv_tier, demote=True)
+                n = 0
+                if payload and payload["entries"]:
+                    for r in self._replicas:
+                        if r is replica or r.state not in (ACTIVE,
+                                                           DRAINING):
+                            continue
+                        n += await asyncio.to_thread(
+                            r.engine.engine.import_kv_tier, payload)
+                if n:
+                    self.metrics.inc("router_migrations")
+                    self.metrics.inc("router_migrated_blocks", n)
+                    self._log_event(replica, "migrate", f"{n} blocks")
+            except Exception as e:  # noqa: BLE001 — cache carryover is
+                self._log_event(       # an optimization, never a gate
+                    replica, "migrate_failed", f"{type(e).__name__}: {e}")
+        try:
+            await replica.engine.shutdown(drain=True,
+                                          timeout_s=drain_timeout_s)
+        except Exception:  # noqa: BLE001 — a wedged replica must not
+            pass               # survive scale-down by being wedged
+        self._replicas.remove(replica)
+        self.metrics.inc("router_scale_downs")
+        self._log_event(replica, "remove")
+        self._update_gauges()
+        return replica.name
 
     # -- observability -------------------------------------------------------
 

@@ -63,6 +63,11 @@ health-aware ejection, retry-elsewhere, rolling drain). Endpoints:
 - ``GET /debug/router`` (RouterServer only) — the routing table: every
   replica's state machine + healthz word, recent lifecycle events
   (ejections, probes, restarts, drains), and the routing knobs.
+- ``GET /debug/autoscale`` (RouterServer only) — the SLO-driven
+  autoscaler's control-loop state (serving/autoscale.py): knobs,
+  streaks, cooldown, per-replica lifecycle, and the recent decision
+  log. 404 with a hint unless an `AutoScaler` is attached
+  (``--autoscale-max N`` on the CLI).
 
 `ServingServer.shutdown(drain=True)` is the graceful path: the listener
 closes (no new connections), the engine stops admitting and finishes or
@@ -573,6 +578,11 @@ class ServingServer(_HTTPServerBase):
         payload = {
             "status": state,
             "inflight": self.engine.inflight,
+            # replica birth/death phase (serving/lifecycle.py): cold /
+            # loading / warm / serving / draining / stopped plus the
+            # warmed flag (program table precompiled) and recent
+            # transition history
+            "lifecycle": self.engine.lifecycle_snapshot(),
             # mesh topology (tp_degree / device_count / backend): a
             # sharded replica's shape is visible to the LB/operator
             # without log-diving; /metrics exposes the same facts as
@@ -606,17 +616,23 @@ class RouterServer(_HTTPServerBase):
     ``/healthz`` reports every replica's state machine, ``/metrics``
     exposes the router's own series, ``/debug/slo`` merges the replicas'
     SLO ledgers into one fleet rollup, and ``/debug/router`` dumps the
-    routing table + lifecycle event log."""
+    routing table + lifecycle event log. Pass an `AutoScaler`
+    (serving/autoscale.py) and the server owns its control loop too:
+    started after the router, stopped before it drains, decisions at
+    ``/debug/autoscale``."""
 
     def __init__(self, router, host="127.0.0.1", port=0,
-                 model_name="paddle-tpu-gpt"):
+                 model_name="paddle-tpu-gpt", autoscaler=None):
         super().__init__(host=host, port=port, model_name=model_name)
         self.router = router
+        self.autoscaler = autoscaler
 
     # -- backend hooks -----------------------------------------------------
 
     async def _start_backend(self):
         await self.router.start()
+        if self.autoscaler is not None:
+            await self.autoscaler.start()
 
     async def _submit(self, kw):
         return await self.router.submit(**kw)
@@ -638,6 +654,10 @@ class RouterServer(_HTTPServerBase):
         self.router.stop_admitting()
 
     async def _shutdown_backend(self, drain, timeout_s):
+        # the control loop stops FIRST: a scale decision landing while
+        # the fleet drains would fight the shutdown
+        if self.autoscaler is not None:
+            await self.autoscaler.stop()
         await self.router.shutdown(drain=drain, timeout_s=timeout_s)
 
     # -- routes ------------------------------------------------------------
@@ -647,10 +667,32 @@ class RouterServer(_HTTPServerBase):
             return await self._healthz(writer)
         if path == "/metrics":
             self.router.refresh_metrics()
+            text = self.router.metrics.prometheus_text()
+            if self.autoscaler is not None:
+                # autoscale_* series ride the same scrape (names are
+                # disjoint from the router_* families, so plain
+                # concatenation is a valid exposition)
+                text += self.autoscaler.metrics.prometheus_text()
             writer.write(_http_response(
-                "200 OK", self.router.metrics.prometheus_text(),
+                "200 OK", text,
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             ))
+            return await writer.drain()
+        if path == "/debug/autoscale":
+            if self.autoscaler is None:
+                writer.write(_http_response(
+                    "404 Not Found",
+                    _error_body(
+                        404,
+                        "the autoscaler is off — construct an AutoScaler "
+                        "(serving/autoscale.py) and pass it to "
+                        "RouterServer(autoscaler=...), or boot with "
+                        "--autoscale-max N, for the SLO-driven replica "
+                        "control loop and its decision log", "not_found"),
+                ))
+                return await writer.drain()
+            writer.write(_http_response(
+                "200 OK", self.autoscaler.snapshot()))
             return await writer.drain()
         if path == "/debug/router":
             writer.write(_http_response("200 OK", self.router.snapshot()))
@@ -773,6 +815,40 @@ def main(argv=None):
                    help="size the KV pool from a per-chip byte budget "
                         "(per-shard under --tp-degree) instead of "
                         "max_batch * max_seq_len")
+    p.add_argument("--checkpoint", default=None, metavar="DIR",
+                   help="stream weights shard-by-shard from a sharded "
+                        "checkpoint directory (save_sharded_model) "
+                        "straight to mesh placement — the model skeleton "
+                        "carries shapes only, so no host ever holds the "
+                        "full tree (README 'Elastic fleet')")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile every width-bucket program via a "
+                        "synthetic warmup wave before serving: the first "
+                        "real request hits a warm program table (0 "
+                        "retraces)")
+    p.add_argument("--param-hbm-bytes", type=int, default=None,
+                   help="per-chip parameter budget: engine construction "
+                        "fails if any device holds more than this many "
+                        "parameter bytes (proves the streaming bound)")
+    p.add_argument("--autoscale-max", type=int, default=None, metavar="N",
+                   help="enable the SLO-driven autoscaler "
+                        "(serving/autoscale.py) with at most N replicas; "
+                        "implies the fleet router even with --replicas 1")
+    p.add_argument("--autoscale-min", type=int, default=1,
+                   help="autoscaler floor: never drain below this many "
+                        "replicas (default 1)")
+    p.add_argument("--autoscale-target-attainment", type=float,
+                   default=0.99, metavar="FRAC",
+                   help="scale up when any (tenant, priority) class's "
+                        "windowed deadline attainment drops below this "
+                        "(default 0.99; needs --slo for the signal)")
+    p.add_argument("--autoscale-cooldown-s", type=float, default=3.0,
+                   help="seconds between scale decisions (hysteresis; "
+                        "default 3)")
+    p.add_argument("--spawn-ttft-budget-s", type=float, default=None,
+                   help="bound on time-to-first-token after a scale-up "
+                        "spawn; breaches are counted and flagged in the "
+                        "decision log")
     p.add_argument("--max-waiting", type=int, default=64,
                    help="wait-queue bound beyond max_batch lanes (429 past it)")
     p.add_argument("--stream-queue-size", type=int, default=64,
@@ -827,7 +903,16 @@ def main(argv=None):
     from .engine import LLMEngine
 
     paddle.seed(0)
-    model = (gpt_tiny if args.model == "tiny" else gpt_small)(attn_impl="xla")
+    build_model = gpt_tiny if args.model == "tiny" else gpt_small
+    if args.checkpoint:
+        # shapes only — every replica (and every autoscaler spawn)
+        # streams its weights from the checkpoint at construction
+        from ..nn.layer import skeleton_init
+
+        with skeleton_init():
+            model = build_model(attn_impl="xla")
+    else:
+        model = build_model(attn_impl="xla")
 
     def build_engine():
         return LLMEngine(
@@ -847,6 +932,9 @@ def main(argv=None):
             # None/unset)
             mesh=args.tp_degree,
             kv_hbm_bytes=args.kv_hbm_bytes,
+            checkpoint_path=args.checkpoint or None,
+            param_hbm_bytes=args.param_hbm_bytes,
+            warmup=args.warmup,
         )
 
     if args.request_log:
@@ -855,7 +943,7 @@ def main(argv=None):
         logging.basicConfig(level=logging.INFO, format="%(message)s")
 
     async def run():
-        if args.replicas > 1:
+        if args.replicas > 1 or args.autoscale_max is not None:
             from .router import ReplicaRouter
 
             def wrap(engine):
@@ -875,7 +963,20 @@ def main(argv=None):
                 retry_budget=args.retry_budget,
                 default_timeout_s=args.timeout_s,
             )
-            server = RouterServer(router, host=args.host, port=args.port)
+            autoscaler = None
+            if args.autoscale_max is not None:
+                from .autoscale import AutoScaler
+
+                autoscaler = AutoScaler(
+                    router,
+                    min_replicas=args.autoscale_min,
+                    max_replicas=args.autoscale_max,
+                    target_attainment=args.autoscale_target_attainment,
+                    cooldown_s=args.autoscale_cooldown_s,
+                    spawn_ttft_budget_s=args.spawn_ttft_budget_s,
+                )
+            server = RouterServer(router, host=args.host, port=args.port,
+                                  autoscaler=autoscaler)
         else:
             server = ServingServer(
                 build_engine(), host=args.host, port=args.port,
@@ -887,8 +988,13 @@ def main(argv=None):
                 max_kv_commit_blocks=args.max_kv_commit_blocks,
             )
         await server.start()
-        mode = (f"{args.replicas}-replica router" if args.replicas > 1
-                else "single replica")
+        if args.autoscale_max is not None:
+            mode = (f"{args.replicas}-replica router, autoscaling "
+                    f"{args.autoscale_min}..{args.autoscale_max}")
+        elif args.replicas > 1:
+            mode = f"{args.replicas}-replica router"
+        else:
+            mode = "single replica"
         print(f"serving on http://{server.host}:{server.port} ({mode}; "
               f"POST /v1/completions, GET /healthz, GET /metrics)",
               flush=True)

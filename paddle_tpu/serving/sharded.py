@@ -59,15 +59,22 @@ every chip. The mesh changes WHERE flops run, never which tokens come
 out (tests/test_serving_sharded.py locks both on the 8-fake-device CPU
 mesh, prefix-cache hits and speculative decoding included).
 
-Known limit: the engine places SHARDED COPIES of the model's weights
-(`jax.device_put` per `serving_param_specs`) and serves from those; the
-caller's eager model keeps its own single-device arrays — the engine does
-not mutate state it does not own (test fixtures share one model across
-sharded and reference engines). A model too large for one chip therefore
-needs its parameters built/loaded sharded before engine construction
-(checkpoint-streaming placement is follow-on work with the checkpoint
-machinery); for models that fit, the cost is one transient full replica
-held by the caller.
+Weight placement has two paths. The eager path places SHARDED COPIES of
+the model's weights (`jax.device_put` per `serving_param_specs`) and
+serves from those; the caller's eager model keeps its own single-device
+arrays — the engine does not mutate state it does not own (test fixtures
+share one model across sharded and reference engines) — so the caller
+transiently holds one full replica. For a model too large for that, use
+the checkpoint-streaming recipe (distributed/checkpoint.py
+`stream_load_state`, README "Elastic fleet"): build the model under
+``nn.layer.skeleton_init()`` (shapes only, no arrays), then
+``LLMEngine(model, mesh=N, checkpoint_path=ckpt_dir)`` streams each
+leaf's shards straight from the `save_sharded_model` directory to mesh
+placement — peak host memory is one shard slice and each chip only ever
+holds its own shards, so the full tree is never materialized anywhere
+(``LLMEngine(param_hbm_bytes=...)`` turns that bound into a construction
+-time assertion; tests/test_stream_checkpoint.py proves the eager path
+busts the same budget the streamed path meets).
 """
 from __future__ import annotations
 

@@ -1,0 +1,229 @@
+"""Replica lifecycle (serving/lifecycle.py) + the warm guarantee.
+
+The state machine is the contract the router and autoscaler program
+against: cold → loading → warm → serving ⇄ draining → stopped, every
+edge validated (`LifecycleError` on an illegal jump), exactly one
+terminal stamp, and the state surfaced on `/healthz`, `/metrics`
+(`lifecycle_state` gauge), and the router's replica snapshots — so the
+half-open probe can DEFER instead of firing a trial request into a
+still-compiling replica. `warm` is a guarantee, not a label:
+`LLMEngine(warmup=True)` compiles every width bucket via a synthetic
+warmup wave, so the first served request after `start()` /
+`resume_admitting()` / a factory restart runs with ZERO retraces
+(the `jit_traces` sentinel).
+"""
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.serving import (
+    AsyncLLMEngine,
+    LifecycleError,
+    LLMEngine,
+    ReplicaLifecycle,
+    ReplicaRouter,
+    ServingMetrics,
+)
+from paddle_tpu.serving.lifecycle import LEGAL, STATES
+from paddle_tpu.serving.router import ACTIVE, EJECTED
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, attn_impl="xla", dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 64)
+    return LLMEngine(model, **kw)
+
+
+# -- the state machine, exhaustively ------------------------------------------
+
+
+def test_every_edge_of_the_matrix():
+    for src in STATES:
+        for dst in STATES:
+            lc = ReplicaLifecycle()
+            lc._state = src          # jump straight to the source state
+            if dst == src:
+                assert lc.to(dst) is False   # same-state no-op
+                assert lc.state == src
+            elif dst in LEGAL[src]:
+                assert lc.to(dst, "edge test") is True
+                assert lc.state == dst
+            else:
+                with pytest.raises(LifecycleError, match=f"{src} -> {dst}"):
+                    lc.to(dst)
+                assert lc.state == src       # failed jump changes nothing
+
+
+def test_terminal_is_terminal():
+    lc = ReplicaLifecycle()
+    lc.to("stopped", "crash before load")
+    assert lc.terminal
+    assert lc.to("stopped") is False         # idempotent stamp
+    for dst in ("cold", "loading", "warm", "serving", "draining"):
+        with pytest.raises(LifecycleError):
+            lc.to(dst)
+
+
+def test_history_and_transitions():
+    lc = ReplicaLifecycle()
+    for s in ("loading", "warm", "serving", "draining", "serving",
+              "draining", "stopped"):
+        lc.to(s, f"to {s}")
+    assert lc.transitions() == [
+        ("cold", "loading"), ("loading", "warm"), ("warm", "serving"),
+        ("serving", "draining"), ("draining", "serving"),
+        ("serving", "draining"), ("draining", "stopped")]
+    snap = lc.snapshot()
+    assert snap["state"] == "stopped"
+    assert snap["history"][-1]["state"] == "stopped"
+    # every recorded edge is legal and exactly one terminal stamp exists
+    assert all(b in LEGAL[a] for a, b in lc.transitions())
+    assert sum(1 for _, b in lc.transitions() if b == "stopped") == 1
+
+
+def test_gauge_tracks_state():
+    m = ServingMetrics()
+    lc = ReplicaLifecycle(metrics=m)
+    assert m.gauges["lifecycle_state"] == STATES.index("cold")
+    lc.to("loading")
+    lc.to("warm")
+    assert m.gauges["lifecycle_state"] == STATES.index("warm")
+
+
+# -- engine + frontend integration --------------------------------------------
+
+
+def test_engine_lifecycle_through_serve_and_drain(model):
+    eng = _engine(model)
+    assert eng.lifecycle.state == "warm"     # built + weights placed
+    assert eng.lifecycle.transitions() == [("cold", "loading"),
+                                           ("loading", "warm")]
+
+    async def run():
+        fe = AsyncLLMEngine(eng)
+        await fe.start()
+        assert fe.lifecycle_state() == "serving"
+        fe.stop_admitting()
+        assert fe.lifecycle_state() == "draining"
+        fe.resume_admitting()
+        assert fe.lifecycle_state() == "serving"
+        out, reason = await fe.submit([1, 2, 3], max_new_tokens=2,
+                                      temperature=0.0).collect()
+        assert reason in ("length", "stop") and len(out) == 2
+        await fe.shutdown()
+        assert fe.lifecycle_state() == "stopped"
+        snap = fe.lifecycle_snapshot()
+        assert snap["state"] == "stopped"
+        return fe
+
+    fe = asyncio.run(run())
+    tr = eng.lifecycle.transitions()
+    assert all(b in LEGAL[a] for a, b in tr)
+    assert sum(1 for _, b in tr if b == "stopped") == 1
+    # the /healthz surface carries the word
+    state, _ = fe.healthz_state()
+    assert state in ("draining", "engine_dead")
+
+
+def test_warmup_compiles_every_bucket_zero_retraces_on_serve(model):
+    eng = _engine(model, warmup=True)
+    expected = eng.expected_program_count()
+    assert eng.metrics.counters["jit_traces"] == expected
+    assert eng.lifecycle.warmed and eng.lifecycle.programs_compiled == expected
+    assert eng.metrics.gauges["warmup_programs"] == expected
+    # warmup leaves no residue: no live requests, pool fully idle
+    assert not eng.has_unfinished()
+    assert eng.pool._refcount == {}
+
+    async def serve():
+        fe = AsyncLLMEngine(eng)
+        await fe.start()
+        fe.stop_admitting()
+        fe.resume_admitting()   # the satellite: warm survives re-admission
+        out, reason = await fe.submit(
+            list(np.random.RandomState(7).randint(0, 128, (9,))),
+            max_new_tokens=3, temperature=0.0).collect()
+        assert reason in ("length", "stop") and len(out) == 3
+        await fe.shutdown()
+
+    asyncio.run(serve())
+    # THE warm guarantee: the first served wave retraced NOTHING
+    assert eng.metrics.counters["jit_traces"] == expected
+
+
+def test_warmup_reaches_the_drafted_spec_bucket(model):
+    eng = _engine(model, warmup=True, spec_decoding=True, num_spec_tokens=3)
+    expected = eng.expected_program_count()
+    assert expected == len(eng.width_buckets)
+    assert eng.metrics.counters["jit_traces"] == expected
+    # every bucket's program exists under the unified (B, W) keying
+    assert {w for _, w in eng._step_fns} == set(eng.width_buckets)
+
+
+def test_factory_restart_starts_warm(model):
+    """The autoscaler/router birth path: a factory-built warmed engine's
+    FIRST served request after start() retraces nothing."""
+    eng = _engine(model, warmup=True)
+    traced = eng.metrics.counters["jit_traces"]
+
+    async def run():
+        fe = AsyncLLMEngine(eng)
+        await fe.start()
+        out, _ = await fe.submit([5, 6, 7, 8], max_new_tokens=2,
+                                 temperature=0.0).collect()
+        await fe.shutdown()
+        return out
+
+    assert len(asyncio.run(run())) == 2
+    assert eng.metrics.counters["jit_traces"] == traced
+
+
+# -- the router consults lifecycle --------------------------------------------
+
+
+def test_probe_defers_on_mid_birth_replica(model):
+    """An ejected replica whose engine is still cold/loading/warm gets
+    its probe DEFERRED (rescheduled, no failure counted) — never a trial
+    request into a still-compiling engine."""
+
+    async def run():
+        router = ReplicaRouter(
+            [AsyncLLMEngine(_engine(model)) for _ in range(2)],
+            sweep_interval_s=3600.0)
+        await router.start()
+        victim = router.replicas[1]
+        victim.state = EJECTED
+        victim.next_probe_at = 0.0
+        for fake in ("cold", "loading", "warm"):
+            victim.engine.lifecycle_state = lambda s=fake: s
+            await router._probe(victim)
+            assert victim.state == EJECTED           # still out, no flap
+            assert victim.next_probe_at > time.monotonic()
+            victim.next_probe_at = 0.0
+        assert router.metrics.counters["router_probe_deferrals"] == 3
+        assert victim.probe_failures == 0    # deferral is not a failure
+        # lifecycle rides the routing table snapshot
+        snap = router.snapshot()
+        assert all("lifecycle" in r for r in snap["replicas"])
+        # a replica that reached `serving` probes normally and re-enters
+        del victim.engine.lifecycle_state            # restore the real one
+        await router._probe(victim)
+        assert victim.state == ACTIVE
+        await router.shutdown()
+
+    asyncio.run(run())

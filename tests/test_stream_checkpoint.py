@@ -1,0 +1,179 @@
+"""Streaming sharded checkpoint load (distributed/checkpoint.py
+`stream_load_state` + `LLMEngine(checkpoint_path=...)`).
+
+The acceptance bar is the MEMORY BOUND, proven, not asserted by
+docstring: streaming places every leaf shard-by-shard straight onto its
+owning device, so peak host staging stays one shard slice and each chip
+holds only its own shards — the full tree is never materialized on any
+host buffer or chip. The regression lock: under the same per-chip
+`param_hbm_bytes` budget, the eager placement path (caller holds a full
+replica) FAILS engine construction while the streamed skeleton path
+succeeds — and the streamed tp=4 serve stays greedy token-identical to
+a single-chip reference built from the same checkpoint.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import (
+    load_state,
+    save_sharded_model,
+    save_state,
+    stream_load_state,
+)
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.nn.layer import is_skeleton, skeleton_init
+from paddle_tpu.serving import LLMEngine
+
+CFG = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+           max_seq_len=64, attn_impl="xla", dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    """(path, eager model): one tiny GPT saved as a sharded checkpoint."""
+    paddle.seed(0)
+    m = GPT(GPTConfig(**CFG))
+    m.eval()
+    path = tmp_path_factory.mktemp("stream_ckpt") / "gpt"
+    save_sharded_model(m, None, str(path))
+    return str(path), m
+
+
+def _skeleton():
+    with skeleton_init():
+        m = GPT(GPTConfig(**CFG))
+    m.eval()
+    return m
+
+
+# -- the loader ---------------------------------------------------------------
+
+
+def test_stream_load_matches_eager_load(ckpt):
+    path, _ = ckpt
+    eager = load_state(path)
+    tree, report = stream_load_state(path)
+    assert sorted(tree) == sorted(eager)
+    for group in tree:
+        assert sorted(tree[group]) == sorted(eager[group])
+        for k, arr in tree[group].items():
+            assert isinstance(arr, jax.Array)
+            np.testing.assert_array_equal(np.asarray(arr),
+                                          np.asarray(eager[group][k]))
+    # the host bound: staging peaks at ONE leaf slice, never the tree
+    assert 0 < report.peak_host_bytes < report.total_bytes
+    assert report.arrays == sum(len(v) for v in eager.values())
+    assert report.summary()["total_bytes"] == report.total_bytes
+
+
+def test_load_state_stream_flag_is_equivalent(ckpt):
+    path, _ = ckpt
+    a, b = load_state(path), load_state(path, stream=True)
+    for group in a:
+        for k in a[group]:
+            np.testing.assert_array_equal(np.asarray(a[group][k]),
+                                          np.asarray(b[group][k]))
+
+
+def test_stream_load_reshards_onto_mesh(tmp_path):
+    """A leaf saved single-device streams back sharded: each device gets
+    exactly its slice and per-chip bytes come out 1/tp of the leaf."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    w = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    save_state({"params": {"w": jax.numpy.asarray(w)}}, str(tmp_path / "c"))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    sh = NamedSharding(mesh, P("tp", None))
+    tree, report = stream_load_state(str(tmp_path / "c"),
+                                     shardings={"params/w": sh})
+    got = tree["params"]["w"]
+    assert got.sharding.is_equivalent_to(sh, got.ndim)
+    np.testing.assert_array_equal(np.asarray(got), w)
+    assert report.max_chip_bytes == w.nbytes // 4
+    assert report.peak_host_bytes == w.nbytes // 4
+
+
+# -- skeleton construction ----------------------------------------------------
+
+
+def test_skeleton_model_has_shapes_not_arrays():
+    skel = _skeleton()
+    assert is_skeleton(skel)
+    for _, p in skel.named_parameters_dict().items():
+        assert isinstance(p._array, jax.ShapeDtypeStruct)
+    paddle.seed(0)
+    assert not is_skeleton(GPT(GPTConfig(**CFG)))
+
+
+def test_skeleton_engine_requires_checkpoint():
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        LLMEngine(_skeleton(), block_size=8, max_batch=2, max_seq_len=64)
+
+
+def test_checkpoint_and_quantize_are_exclusive(ckpt):
+    path, model = ckpt
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                  quantize="int8", checkpoint_path=path)
+
+
+# -- the engine path: bound + parity ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def streamed_tp4(ckpt):
+    path, _ = ckpt
+    return LLMEngine(_skeleton(), block_size=8, max_batch=2, max_seq_len=64,
+                     mesh=4, checkpoint_path=path)
+
+
+def test_streamed_engine_reports_the_bound(streamed_tp4):
+    rep = streamed_tp4.load_report
+    assert rep is not None
+    # per-chip: each device holds its own shards, NOT the full tree
+    # (small replicated leaves ride along, so the bound is strict but
+    # not 1/tp exact)
+    assert 0 < rep.max_chip_bytes < rep.total_bytes
+    assert len(rep.chip_bytes) == 4
+    # host: peak staging is one shard slice, never the full tree
+    assert 0 < rep.peak_host_bytes < rep.total_bytes
+    assert streamed_tp4.metrics.gauges["ckpt_stream_max_chip_bytes"] == (
+        rep.max_chip_bytes)
+
+
+def test_too_big_for_eager_serves_streamed(ckpt, streamed_tp4):
+    """THE regression: the same per-chip parameter budget that the
+    streamed path provably meets fails the eager full-materialize path
+    at construction (its source copy of the full tree is charged to the
+    device holding it)."""
+    path, model = ckpt
+    budget = max(streamed_tp4.param_bytes_by_device().values())
+    # streamed: constructs under the budget
+    eng = LLMEngine(_skeleton(), block_size=8, max_batch=2, max_seq_len=64,
+                    mesh=4, checkpoint_path=path, param_hbm_bytes=budget)
+    assert max(eng.param_bytes_by_device().values()) <= budget
+    # eager: the caller-held full replica busts the same budget
+    with pytest.raises(ValueError, match="param_hbm_bytes"):
+        LLMEngine(model, block_size=8, max_batch=2, max_seq_len=64,
+                  mesh=4, param_hbm_bytes=budget)
+
+
+def test_streamed_tp4_greedy_parity(ckpt, streamed_tp4):
+    """Greedy serve off the streamed tp=4 engine is token-identical to a
+    single-chip engine built by streaming the SAME checkpoint."""
+    path, _ = ckpt
+    ref = LLMEngine(_skeleton(), block_size=8, max_batch=2, max_seq_len=64,
+                    checkpoint_path=path)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 128, (n,)).tolist() for n in (5, 11, 8)]
+    outs = []
+    for eng in (ref, streamed_tp4):
+        rids = [eng.add_request(p, max_new_tokens=6, temperature=0.0)
+                for p in prompts]
+        while eng.has_unfinished():
+            eng.step()
+        outs.append([eng.get_request(r).output_ids for r in rids])
+    assert outs[0] == outs[1]
