@@ -242,6 +242,12 @@ def bench_gpt(on_tpu, errors, deadline_s):
     else:
         batches = (16, 8, 32) if on_tpu else (2,)
         iters = 20 if on_tpu else 3
+    # per-chip optimizer-state bytes of the state the sweep runs on —
+    # measured BEFORE the sweep donates it (the explicit-ZeRO train wave
+    # reports the dp-sharded counterpart; the trajectory compares them)
+    from paddle_tpu.parallel.spmd import per_chip_opt_state_bytes
+
+    opt_bytes = per_chip_opt_state_bytes(opt_state)
     sweep = _sweep(run, batches, iters, errors, deadline_s, name="gpt")
     if not sweep:
         return None
@@ -254,7 +260,190 @@ def bench_gpt(on_tpu, errors, deadline_s):
         "mfu": round(tokens_per_sec * flops_per_token / peak, 4),
         "batch": best_batch,
         "sweep": {str(k): round(v, 1) for k, v in sweep.items()},
+        # train-side drift fields (PR 19): the single-chip flagship runs
+        # the unsharded step — zero_stage 0, no quantized grads, share
+        # measured from a short xplane capture (~0 with no collectives);
+        # bench_gpt_train_zero carries the dp-sharded numbers
+        "zero_stage": 0,
+        "quant_grads": False,
+        "per_chip_opt_state_bytes": int(opt_bytes),
+        "collective_time_share": _capture_collective_share(
+            lambda: run(best_batch, 2), errors, deadline_s, name="gpt"),
     }
+
+
+def _capture_collective_share(run_steps, errors, deadline_s, name=""):
+    """Fraction of device busy time spent in collective ops over an
+    xplane capture of `run_steps()` — `profiler.flops.collective_time`
+    aggregated across device planes (EQuARX's motivating measurement:
+    is the step compute-bound or interconnect-bound). None when the
+    capture can't run (deadline, profiler unavailable) — recorded in
+    `errors`, never fatal to the bench that asked."""
+    import shutil
+    import tempfile
+
+    if time.monotonic() > deadline_s:
+        errors.append(f"{name}: deadline before collective_time capture")
+        return None
+    try:
+        import jax
+
+        from paddle_tpu.profiler.flops import collective_time
+
+        td = tempfile.mkdtemp(prefix="bench_xplane_")
+        try:
+            with jax.profiler.trace(td):
+                run_steps()
+            planes = collective_time(td)
+            coll = sum(p["collective_ms"] for p in planes.values())
+            total = sum(p["total_ms"] for p in planes.values())
+            return round(coll / total, 4) if total else 0.0
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort
+        errors.append(f"{name}: collective_time capture: "
+                      f"{type(e).__name__}: {str(e)[:200]}")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# GPT explicit-ZeRO train wave (parallel/spmd.py explicit weight update)
+# ---------------------------------------------------------------------------
+
+def bench_gpt_train_zero(on_tpu, errors, deadline_s):
+    """Explicit ZeRO weight-update train wave on the 8-fake-device CPU
+    mesh: the SAME dp=4 batch trained through stage 0 (GSPMD reference),
+    stage 2 (explicit reduce-scatter + shard-local update + gather of
+    updated shards, arXiv:2004.13336), and stage 2 with int8 quantized
+    gradient reduce-scatter (EQuARX wire format). ALWAYS runs on the fake
+    CPU host platform, even with a TPU reachable — like the multichip
+    serve wave it certifies the sharded program's correctness, layout,
+    and collective shape, not accelerator speed. One JSON line reports
+    per-stage tok/s, `per_chip_opt_state_bytes` (the ~dp-fold drop
+    IR004 locks), lowered collective counts (the train-side sibling of
+    serving's `collectives` object — IR001 drift visible in the BENCH
+    trajectory itself), `collective_time_share` from an xplane capture
+    of the stage-2 step, a `loss_parity: ok|mismatch` verdict (stage-2
+    losses must track stage 0 within f32 reduction-order noise — the
+    BIT-identity gate lives in tier-1 on the deterministic tiny config,
+    tests/test_zero_explicit.py; at this size 1-ulp grad-reduction
+    differences surface after the first update), and the int8 drift."""
+    del on_tpu  # forced to the fake CPU mesh by _child
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+    from paddle_tpu.parallel.spmd import (make_sharded_train_step,
+                                          per_chip_opt_state_bytes)
+
+    cfg = GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                    num_heads=8, max_seq_len=128, attn_impl="xla")
+    dp, batch, seq = 4, 8, 128
+    mesh = init_mesh({"dp": dp})
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    labels = rs.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    iters = 4 if _fast() else 10
+
+    def wave(zero_stage, quant=False, capture=False):
+        paddle.seed(0)
+        model = GPT(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        step = make_sharded_train_step(model, gpt_loss_fn, opt, mesh,
+                                       zero_stage=zero_stage,
+                                       quant_grads=quant)
+        params, buffers, opt_state = step.init_state()
+        opt_bytes = per_chip_opt_state_bytes(opt_state)
+        b = step.shard_batch(ids, labels)
+        lr, key = jnp.float32(1e-4), jax.random.PRNGKey(0)
+        loss, params, buffers, opt_state = step(
+            params, buffers, opt_state, lr, key, *b)      # compile
+        losses = [float(np.asarray(loss))]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            # the per-step host sync is deliberate: the loss trajectory
+            # IS the parity verdict this wave exists to record
+            loss, params, buffers, opt_state = step(
+                params, buffers, opt_state, lr, key, *b)
+            losses.append(float(np.asarray(loss)))
+        dt = time.perf_counter() - t0
+        out = {
+            "tok_s": round(batch * seq * iters / dt, 1) if dt else 0.0,
+            "zero_stage": zero_stage,
+            "quant_grads": quant,
+            "explicit_update": step.explicit_update,
+            "per_chip_opt_state_bytes": int(opt_bytes),
+        }
+        if time.monotonic() < deadline_s:
+            # lowered collective counts of THE program just measured —
+            # the hlolint train/* artifacts lock these tier-1; the bench
+            # line records them so the trajectory sees drift too
+            from paddle_tpu.analysis.ir import (collective_counts,
+                                                parse_hlo_ops)
+
+            lowered, _ = step.lower_step(
+                *[jax.ShapeDtypeStruct(x.shape, x.dtype) for x in b])
+            counts = collective_counts(
+                parse_hlo_ops(lowered.compile().as_text()))
+            out["collectives"] = {k: n for k, n in counts.items() if n}
+        if capture:
+            def more_steps(params=params, buffers=buffers,
+                           opt_state=opt_state):
+                lo, p, bu, o = step(params, buffers, opt_state, lr, key, *b)
+                lo, p, bu, o = step(p, bu, o, lr, key, *b)
+                float(np.asarray(lo))
+            out["collective_time_share"] = _capture_collective_share(
+                more_steps, errors, deadline_s, name="gpt_train_zero")
+        return out, losses
+
+    zs0, l0 = wave(0)
+    if time.monotonic() > deadline_s:
+        errors.append("gpt_train_zero: deadline before stage-2 wave")
+        return None
+    zs2, l2 = wave(2, capture=True)
+    drift = max(abs(a - b) for a, b in zip(l2, l0))
+    parity = "ok" if drift < 1e-4 else "mismatch"
+    if parity != "ok":
+        errors.append("gpt_train_zero: stage-2 losses diverged from the "
+                      f"stage-0 reference beyond reduction-order noise "
+                      f"(drift {drift}): {l2} vs {l0}")
+    out = {
+        "value": zs2["tok_s"],
+        "dp": dp, "batch": batch, "seq": seq, "iters": iters,
+        "n_devices": len(jax.devices()),
+        "zs0": zs0, "zs2": zs2,
+        "loss_parity": parity,
+        "loss_drift": round(drift, 7),
+        "opt_state_shrink": round(
+            zs0["per_chip_opt_state_bytes"]
+            / zs2["per_chip_opt_state_bytes"], 2)
+        if zs2["per_chip_opt_state_bytes"] else 0.0,
+        # the primary fields mirror the measured stage-2 config
+        "zero_stage": 2,
+        "quant_grads": False,
+        "per_chip_opt_state_bytes": zs2["per_chip_opt_state_bytes"],
+        "collective_time_share": zs2.get("collective_time_share"),
+    }
+    if out["opt_state_shrink"] < dp - 1:
+        errors.append(f"gpt_train_zero: opt-state shrink "
+                      f"{out['opt_state_shrink']} below ~dp-fold (dp={dp})")
+    if time.monotonic() <= deadline_s:
+        try:
+            q8, lq = wave(2, quant=True)
+        except Exception as e:  # noqa: BLE001 — f32 waves already landed
+            errors.append(f"gpt_train_zero: int8 wave: "
+                          f"{type(e).__name__}: {str(e)[:200]}")
+        else:
+            q8["int8_loss_drift"] = round(
+                max(abs(a - b) for a, b in zip(lq, l0)), 5)
+            out["zs2_q8"] = q8
+    _log(f"train zero: zs2 {zs2['tok_s']} tok/s parity {parity} "
+         f"opt-state shrink {out['opt_state_shrink']}x "
+         f"collectives {zs2.get('collectives')}")
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1641,6 +1830,7 @@ def bench_lenet(on_tpu, errors, deadline_s):
 
 _BENCHES = {
     "gpt": bench_gpt,
+    "gpt_train_zero": bench_gpt_train_zero,
     "gpt_serve": bench_gpt_serve,
     "gpt_serve_multichip": bench_gpt_serve_multichip,
     "gpt_serve_router": bench_gpt_serve_router,
@@ -1655,8 +1845,8 @@ _BENCHES = {
 
 def _child(name, soft_deadline_s):
     """Run ONE benchmark and print its JSON on the last line."""
-    if name == "gpt_serve_multichip":
-        # the sharded wave ALWAYS runs on the 8-fake-device CPU host
+    if name in ("gpt_serve_multichip", "gpt_train_zero"):
+        # the sharded waves ALWAYS run on the 8-fake-device CPU host
         # platform — flip it before any jax backend init (the env var
         # alone is not enough; same trick as tests/conftest.py)
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1739,6 +1929,11 @@ def _emit(gpt, extras, errors):
         out["mfu"] = gpt["mfu"]
         out["batch"] = gpt["batch"]
         out["sweep"] = gpt["sweep"]
+        # train-side drift fields (PR 19) ride the primary line
+        for k in ("zero_stage", "quant_grads", "per_chip_opt_state_bytes",
+                  "collective_time_share"):
+            if k in gpt:
+                out[k] = gpt[k]
     out.update(extras)
     if errors:
         out["errors"] = errors
@@ -1799,6 +1994,17 @@ def main():
     gpt = r.get("result")
     completed += bool(gpt)
     _emit(gpt, {}, errors)  # flushed immediately — this line alone is valid
+
+    # explicit-ZeRO train wave: stage 0/2/2+int8 tok/s, opt-state shrink,
+    # loss-parity verdict and lowered collective counts on the fake CPU
+    # mesh (correctness + collective shape, not accelerator speed)
+    r = _run_isolated("gpt_train_zero", min(240.0, _remaining()))
+    errors.extend(r.get("errors") or [])
+    z = _emit_model("gpt_train_zero", r, "tokens/sec",
+                    metric="gpt_train_zero_tokens_per_sec")
+    if z:
+        completed += 1
+        extras["gpt_train_zero"] = z
 
     # gpt_serve rides the same per-model cap as the secondary benches so a
     # slow serve (BENCH_r05: gpt itself can time out) can't eat the window

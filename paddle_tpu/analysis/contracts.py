@@ -243,7 +243,13 @@ class ProgramShapeBaseline(IRContract):
                 "arena — a peak-memory regression invisible to both "
                 "tests and tok/s benches")
 
-    CHECKED = ("flops", "bytes_accessed", "peak_bytes")
+    # per_chip_opt_state_bytes: train artifacts only (measured from the
+    # placed init_state arrays, ir.train_artifact) — the lock that the
+    # explicit ZeRO path's ~dp-fold optimizer-state drop cannot silently
+    # regress to a full replica per chip; absent from serving programs,
+    # where the baseline loop and the drift check both skip it
+    CHECKED = ("flops", "bytes_accessed", "peak_bytes",
+               "per_chip_opt_state_bytes")
 
     def check(self, artifact, context):
         baseline = (context or {}).get("baseline")

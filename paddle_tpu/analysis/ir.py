@@ -445,13 +445,22 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None,
     return arts
 
 
-def train_artifact(mesh_degrees=None):
-    """Lower + compile the spmd sharded train step (dp2 x mp2 by default:
-    both the dp grad psums and the Megatron tp collectives appear) on the
-    tiny GPT. The training mesh installs globally for the trace
-    (mp_layers' constraints consult it) and ALWAYS restores — a leaked
-    mesh would reject the serving engine's own placement (the PR 10 deep
-    fix)."""
+def train_artifact(mesh_degrees=None, zero_stage=0, gradient_merge_k=1,
+                   quant_grads=False, explicit_update=None, optimizer="SGD",
+                   name=None):
+    """Lower + compile ONE spmd sharded train step configuration on the
+    tiny GPT (dp2 x mp2 zero-0 by default: both the dp grad psums and the
+    Megatron tp collectives appear). Explicit-path configurations
+    (zero_stage >= 2 on a pure-dp mesh) get the EXACT layout-derived
+    IR001 budget from `spmd.train_collective_budget`; GSPMD-lowered
+    configurations have no arithmetic budget (collective counts are
+    XLA-emergent) and are locked by their IR004 baselines instead. Every
+    train artifact also carries the measured `per_chip_opt_state_bytes`
+    fact from the PLACED init_state arrays — the IR004-locked proof that
+    the explicit path's optimizer state actually drops ~dp-fold. The
+    training mesh installs globally for the trace (mp_layers' constraints
+    consult it) and ALWAYS restores — a leaked mesh would reject the
+    serving engine's own placement (the PR 10 deep fix)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -459,24 +468,37 @@ def train_artifact(mesh_degrees=None):
     import paddle_tpu as paddle
     from ..distributed.mesh import get_mesh, init_mesh, set_mesh
     from ..models.gpt import GPT, gpt_loss_fn
-    from ..parallel.spmd import make_sharded_train_step
+    from ..parallel.spmd import (
+        make_sharded_train_step,
+        per_chip_opt_state_bytes,
+        train_collective_budget,
+    )
 
     degrees = dict(mesh_degrees or {"dp": 2, "mp": 2})
-    name = "train/" + "_".join(f"{k}{v}" for k, v in degrees.items())
+    if name is None:
+        name = "train/" + "_".join(f"{k}{v}" for k, v in degrees.items())
     prev = get_mesh()
     mesh = init_mesh(degrees)
     try:
         model = GPT(tiny_gpt_config())
-        opt = paddle.optimizer.SGD(learning_rate=0.1,
-                                   parameters=model.parameters())
-        step = make_sharded_train_step(model, gpt_loss_fn, opt, mesh,
-                                       batch_specs=(P("dp"), P("dp")))
+        opt_cls = getattr(paddle.optimizer, optimizer)
+        opt = opt_cls(learning_rate=0.1, parameters=model.parameters())
+        step = make_sharded_train_step(
+            model, gpt_loss_fn, opt, mesh, batch_specs=(P("dp"), P("dp")),
+            zero_stage=zero_stage, gradient_merge_k=gradient_merge_k,
+            explicit_update=explicit_update, quant_grads=quant_grads)
         batch = jax.ShapeDtypeStruct((4, 16), jnp.int32)
         lowered, donation = step.lower_step(batch, batch)
+        if step.explicit_update:
+            budget = train_collective_budget(
+                len(model.named_parameters_dict()),
+                int(degrees.get("dp", 1)), quant_grads=quant_grads)
+        else:
+            # no arithmetic budget: GSPMD-lowered train collectives are
+            # XLA-emergent — IR004 locks these programs' shape
+            budget = None
         expected = {
-            # no collective budget: train collectives scale with ZeRO
-            # stage / gradient-merge config — IR001 does not apply
-            "collective_budget": None,
+            "collective_budget": budget,
             "donation": {
                 "expected": donation["donation_expected"],
                 "param_indices": donation["donated_param_indices"],
@@ -485,11 +507,42 @@ def train_artifact(mesh_degrees=None):
             },
             "custom_call_whitelist": DEFAULT_CUSTOM_CALL_WHITELIST,
         }
-        return artifact_from_compiled(
+        art = artifact_from_compiled(
             name, "train", int(degrees.get("mp", 1)),
             jax.default_backend(), lowered.compile(), expected)
+        _, _, opt_state = step.init_state()
+        art.facts["per_chip_opt_state_bytes"] = per_chip_opt_state_bytes(
+            opt_state)
+        return art
     finally:
         set_mesh(prev)
+
+
+def train_artifacts():
+    """The train/* artifact family: the legacy dp2 x mp2 GSPMD step, the
+    locked 'before' (constraint-hint zero-2 on the same mesh compiles to
+    the SAME collective counts as zero-0 — the measured motivation for
+    the explicit path), and the explicit weight-update matrix on the
+    pure-dp mesh: zero stages 0 (GSPMD reference) / 2 / 3, gradient-merge
+    on, and int8 quantized gradients — each explicit program carrying the
+    exact `train_collective_budget` (zero full-size grad all-reduce at
+    stage >= 2) and the per-chip optimizer-state-bytes fact. AdamW
+    everywhere the optimizer-state shard matters (SGD has no slots)."""
+    dp4 = {"dp": 4}
+    return [
+        train_artifact(),
+        train_artifact(zero_stage=2, optimizer="AdamW",
+                       name="train/dp2_mp2/zs2-legacy"),
+        train_artifact(dp4, optimizer="AdamW", name="train/dp4/zs0"),
+        train_artifact(dp4, zero_stage=2, optimizer="AdamW",
+                       name="train/dp4/zs2"),
+        train_artifact(dp4, zero_stage=3, optimizer="AdamW",
+                       name="train/dp4/zs3"),
+        train_artifact(dp4, zero_stage=2, gradient_merge_k=2,
+                       optimizer="AdamW", name="train/dp4/zs2_gm2"),
+        train_artifact(dp4, zero_stage=2, quant_grads=True,
+                       optimizer="AdamW", name="train/dp4/zs2_q8"),
+    ]
 
 
 def default_artifacts():
@@ -498,12 +551,13 @@ def default_artifacts():
     end-to-end family (quantized arena + EQuARX collectives; the w1
     decode step and the 4-array swap copies — the widths share one
     quantization story, so w1 pins the shape without tripling compile
-    time) + the dp2 x mp2 train step."""
+    time) + the train/* family (legacy dp2 x mp2, the locked zs2-legacy
+    'before', and the explicit weight-update matrix on dp4)."""
     arts = serving_artifacts()
     arts += serving_artifacts(kinds=("w1",), kv_dtype="int8",
                               quant_allreduce=True, prefix="serve_int8",
                               include_swap=True)
-    arts.append(train_artifact())
+    arts += train_artifacts()
     return arts
 
 

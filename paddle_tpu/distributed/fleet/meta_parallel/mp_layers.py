@@ -22,11 +22,32 @@ from ....ops import common_nn as F
 from ....ops.loss_ops import cross_entropy
 from ...mesh import get_mesh
 
+# When a train step traces the model inside a fully-manual shard_map, mesh
+# axes are "manual" and with_sharding_constraint over them is illegal (the
+# failure surfaces at lowering, past _constraint's try/except). The explicit
+# ZeRO path flips this flag around tracing; constraints become no-ops.
+_DISABLE_CONSTRAINTS = False
+
+
+class constraints_disabled:
+    """Context manager: make _constraint a no-op (manual shard_map tracing)."""
+
+    def __enter__(self):
+        global _DISABLE_CONSTRAINTS
+        self._prev = _DISABLE_CONSTRAINTS
+        _DISABLE_CONSTRAINTS = True
+        return self
+
+    def __exit__(self, *exc):
+        global _DISABLE_CONSTRAINTS
+        _DISABLE_CONSTRAINTS = self._prev
+        return False
+
 
 def _constraint(x, *spec):
     """with_sharding_constraint when tracing on a mesh; no-op eagerly."""
     mesh = get_mesh()
-    if mesh is None:
+    if mesh is None or _DISABLE_CONSTRAINTS:
         return x
     try:
         from jax.sharding import NamedSharding, PartitionSpec

@@ -57,6 +57,14 @@ class Optimizer:
     # subclasses define: _slots() -> list of slot names; _update rule
     _slot_names = ()
 
+    # True when `_update` is purely elementwise over (param, grad, slots) —
+    # the contract the explicit ZeRO weight-update path (parallel/spmd.py)
+    # relies on to run the update on a flattened 1/dp shard of each leaf.
+    # Rules with per-TENSOR reductions (Lars/Lamb trust ratios, DGC top-k)
+    # would compute them over the shard, not the leaf: they override this
+    # to False and the explicit path refuses them at construction.
+    _elementwise_update = True
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
         self._learning_rate = learning_rate
         self._parameter_list = list(parameters) if parameters is not None else None

@@ -203,6 +203,7 @@ class DGCMomentum(Optimizer):
     strategy promises, and it is reproduced exactly."""
 
     _slot_names = ("velocity", "residual")
+    _elementwise_update = False  # per-tensor reduction in _update (see Optimizer)
 
     def __init__(self, learning_rate=0.001, momentum=0.9, sparsity=0.999,
                  rampup_begin_step=0, parameters=None, weight_decay=None,
@@ -265,6 +266,7 @@ class Lars(Optimizer):
     ||w|| / (||g|| + wd * ||w|| + eps), momentum on the rescaled step."""
 
     _slot_names = ("velocity",)
+    _elementwise_update = False  # per-tensor reduction in _update (see Optimizer)
 
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, epsilon=0.0,
@@ -367,6 +369,7 @@ class Lars(Optimizer):
 
 class Lamb(Optimizer):
     _slot_names = ("moment1", "moment2", "beta1_pow", "beta2_pow")
+    _elementwise_update = False  # per-tensor reduction in _update (see Optimizer)
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name)

@@ -1,0 +1,89 @@
+"""Quantized cross-chip collectives shared by serving and training.
+
+EQuARX (arXiv:2506.17615) observes that the payload of a dense-activation
+or gradient collective tolerates int8 quantization when each shard's
+contribution is quantized ONCE with its own scale and the reduction
+itself accumulates in f32 — error never compounds across shards, only
+one rounding per contribution. PR 17 built that machinery for serving's
+RowParallel all-reduce; this module is the shared home so the training
+side's gradient reduce-scatter (ZeRO weight-update sharding,
+arXiv:2004.13336) reuses the identical quantize/dequantize math instead
+of growing a divergent copy.
+
+Every function here is MANUAL-collective code: call them inside a
+`shard_map` body where `axis_name` is a manual mesh axis. They are pure
+array->array math (no jit, no donation — the JL004-gated donation sites
+stay with the callers that own the step builders).
+
+Wire-format contract (locked by IR001 collective budgets on both the
+serve_int8 and train/* artifact families):
+
+- `quantized_allgather_sum`: 2 all-gathers (int8 payload + f32 scale)
+  replace 1 f32 all-reduce. Serving's RowParallel projection.
+- `quantized_psum_scatter`: 2 all-to-alls (int8 payload + f32 scale)
+  replace 1 f32 reduce-scatter. Training's gradient reduction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def absmax_quantize(x, axis=None):
+    """Symmetric int8 quantization with an absmax/127 scale.
+
+    `axis=None` -> ONE scalar scale for the whole tensor (serving's
+    per-shard partial sum); `axis=k` -> one scale per slice along every
+    OTHER axis (training quantizes each destination chunk of a gradient
+    independently, so one outlier chunk cannot flatten the rest of the
+    leaf). Returns ``(q, scale)`` with ``q`` int8 and ``scale`` f32
+    shaped like ``x`` reduced over `axis` (scalar when axis is None);
+    ``q * scale`` reconstructs ``x`` to within one rounding step."""
+    ax = None if axis is None else (axis,)
+    sc = jnp.maximum(
+        jnp.max(jnp.abs(x).astype(jnp.float32), axis=ax, keepdims=axis is not None)
+        / 127.0,
+        1e-12,
+    )
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / sc), -127, 127).astype(jnp.int8)
+    return q, (sc if axis is None else jnp.squeeze(sc, axis=axis))
+
+
+def quantized_allgather_sum(part, axis_name):
+    """Sum per-shard f32 partials over `axis_name` with an int8 wire.
+
+    The inner math of serving's `quantized_row_parallel` (EQuARX step
+    2-4): quantize the local partial with one scalar scale, all-gather
+    payload + scale (the TWO gathers `serving_collective_budget` counts
+    per quantized projection), dequantize and sum in f32. Must run
+    inside shard_map with `axis_name` manual."""
+    q, sc = absmax_quantize(part)
+    qg = jax.lax.all_gather(q, axis_name)        # [shards, ...] int8
+    sg = jax.lax.all_gather(sc, axis_name)       # [shards] f32
+    return jnp.tensordot(sg, qg.astype(jnp.float32), ((0,), (0,)))
+
+
+def quantized_psum_scatter(flat, axis_name, axis_size):
+    """Reduce-scatter a flat f32 vector over `axis_name`, int8 on the
+    wire: the gradient-reduction half of ZeRO weight-update sharding
+    with EQuARX's quantize-once-accumulate-f32 recipe.
+
+    Each shard cuts its local contribution into `axis_size` destination
+    chunks, quantizes each chunk with its OWN absmax scale, and trades
+    chunks via two all-to-alls (int8 payload + f32 scales — the pair
+    IR001 budgets as ``2 * n_leaves`` all-to-alls on the train/*_q8
+    artifacts, replacing that leaf's reduce-scatter). The receiving
+    shard dequantizes all `axis_size` contributions and sums in f32, so
+    each contribution is rounded exactly once regardless of dp degree.
+
+    `flat` is [n] f32 with n divisible by `axis_size`; returns this
+    shard's reduced [n // axis_size] chunk — same contract as
+    ``jax.lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+    tiled=True)`` minus the rounding."""
+    ch = flat.reshape(axis_size, -1)             # [shards, chunk]
+    q, sc = absmax_quantize(ch, axis=1)          # int8 [shards, chunk], f32 [shards]
+    qx = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+    sx = jax.lax.all_to_all(sc, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+    return jnp.sum(qx.astype(jnp.float32) * sx[:, None], axis=0)

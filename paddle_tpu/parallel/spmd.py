@@ -1,14 +1,36 @@
-"""Sharded compiled train step (GSPMD).
+"""Sharded compiled train step (GSPMD + explicit ZeRO weight-update path).
 
 The TPU-native replacement for the reference's distributed optimizer stack:
 - DP grad allreduce (EagerReducer reducer.cc): falls out of jit-ing the grad
   computation with a dp-sharded batch — XLA inserts the psum.
 - TP (mp_ops c_identity/c_allreduce): falls out of Parameter.sharding_axes
   annotations on the mp axis.
-- ZeRO-1/2/3 (dygraph_sharding_optimizer / GroupShardedStage2/3): expressed
-  as shardings on optimizer state (stage>=1) and parameters (stage 3) over
-  the 'sharding' axis; XLA's weight-update sharding + just-in-time
-  all-gathers implement the runtime machinery.
+- ZeRO-1/2/3 (dygraph_sharding_optimizer / GroupShardedStage2/3): two paths.
+
+  The LEGACY constraint-hint path expresses sharding as
+  `with_sharding_constraint` pins on optimizer state (stage>=1), grads
+  (stage>=2) and params (stage 3) over the 'sharding' axis and HOPES
+  GSPMD lowers the dp grad sync to reduce-scatter. Measured on the
+  dp2 x mp2 hlolint artifact it never does: zero stages 0/2/3 compile to
+  IDENTICAL collective counts (43 all-reduce, 0 reduce-scatter on the
+  tiny GPT) because `_zero_shard_spec` keys on a 'sharding' mesh axis the
+  dp x mp mesh doesn't carry — and even pointed at the dp axis, XLA keeps
+  the full-size all-reduce. The hints only bite on meshes with a real
+  'sharding' axis, and even there nothing verifies the lowering.
+
+  The EXPLICIT path (`explicit_update`, on by default for zero_stage>=2 on
+  pure-dp meshes) implements "Automatic Cross-Replica Sharding of Weight
+  Update in Data-Parallel Training" (arXiv:2004.13336) manually inside a
+  fully-manual `shard_map` over the mesh: each grad leaf is flattened,
+  padded to a dp multiple, and REDUCE-SCATTERED over dp (optionally int8
+  on the wire — EQuARX, parallel/collectives.py); the optimizer update
+  runs shard-locally on 1/dp of each param and its optimizer state (the
+  gradient-merge accumulator shards the same way); then only the UPDATED
+  param shards are all-gathered back (stage 2) or kept resident as dp-
+  sharded flat leaves (stage 3). The collective shape is exact and
+  layout-derived — `train_collective_budget` states it as arithmetic and
+  hlolint IR001 locks it on the train/* artifact family (analysis/ir.py),
+  so a silently-disabled reduce-scatter is a CI failure, not a hope.
 """
 from __future__ import annotations
 
@@ -47,6 +69,16 @@ def _sharded_zeros_fn(shape, dtype_name, sharding):
     eager-materialize-then-place class — at gradient-merge scale the
     accumulators are a full param-sized f32 replica)."""
     return jax.jit(lambda: jnp.zeros(shape, dtype_name),
+                   out_shardings=sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def _flatten_pad_fn(pad, sharding):
+    """Compiled flatten+pad+place for the explicit path's padded-flat
+    param layout — same allocate-sharded-from-the-start discipline as
+    `_sharded_zeros_fn` (the output lands dp-sharded without a full
+    logical copy materializing on one chip first)."""
+    return jax.jit(lambda x: jnp.pad(x.reshape(-1), (0, pad)),
                    out_shardings=sharding)
 
 
@@ -125,6 +157,72 @@ def grad_pspec(pspec: P, param_shape, mesh, zero_stage) -> P:
     return pspec
 
 
+def explicit_update_eligible(mesh: Mesh):
+    """True when the mesh is pure-dp — dp degree > 1 and every other axis
+    degree 1 — the topology the explicit weight-update path runs on (its
+    shard_map is fully manual over the whole mesh, so a live tp/sharding
+    axis would need the model's own collectives spelled manually too).
+    dp x mp meshes keep the legacy GSPMD path."""
+    dp = int(mesh.shape.get("dp", 1))
+    return dp > 1 and all(
+        int(d) == 1 for ax, d in mesh.shape.items() if ax != "dp")
+
+
+def train_collective_budget(n_param_leaves, dp_degree, quant_grads=False,
+                            n_buffer_leaves=0):
+    """EXACT collective counts of ONE explicit-path compiled train step —
+    the layout stated as arithmetic, IR001's input for the train/*
+    artifact family (the train-side sibling of
+    `serving_collective_budget`):
+
+    - ``reduce-scatter``: one per param leaf (the dp grad reduction,
+      arXiv:2004.13336) — ZERO when `quant_grads`, because the int8 wire
+      replaces each with...
+    - ``all-to-all``: TWO per param leaf when `quant_grads` (int8 payload
+      + f32 per-chunk scales — `collectives.quantized_psum_scatter`),
+      zero otherwise;
+    - ``all-gather``: one per param leaf — stage 2 gathers the UPDATED
+      shards after the update, stage 3 gathers the resident flat shards
+      before the forward; either way exactly one per leaf and never a
+      full-size grad;
+    - ``all-reduce``: one scalar loss psum, plus one per mutated-buffer
+      leaf (BN running stats average over dp). A full-size grad
+      all-reduce sneaking back in moves this count and trips IR001.
+
+    dp_degree <= 1 (or the legacy GSPMD path) has no layout-derived
+    budget — those programs are locked by measured IR004 baselines
+    instead; callers pass budget None."""
+    if int(dp_degree) <= 1:
+        return {k: 0 for k in ("all-reduce", "all-gather", "all-to-all",
+                               "reduce-scatter", "collective-permute",
+                               "collective-broadcast")}
+    n = int(n_param_leaves)
+    return {
+        "all-reduce": 1 + int(n_buffer_leaves),
+        "all-gather": n,
+        "all-to-all": 2 * n if quant_grads else 0,
+        "reduce-scatter": 0 if quant_grads else n,
+        "collective-permute": 0,
+        "collective-broadcast": 0,
+    }
+
+
+def per_chip_opt_state_bytes(opt_state):
+    """Bytes of optimizer state ONE chip actually holds: per leaf, the
+    first addressable shard's buffer size (uniform across chips — every
+    explicit-path leaf is either evenly dp-sharded or replicated). The
+    IR004 `per_chip_opt_state_bytes` fact and the bench field of the same
+    name — the measured ~dp-fold drop the explicit path exists for."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += int(shards[0].data.nbytes)
+        else:  # pragma: no cover - non-placed leaf (plain numpy)
+            total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
 def build_state_shardings(model, optimizer, mesh, zero_stage=0):
     """Shared spec derivation for every sharded-step builder (ShardedTrainStep
     and hapi Model's fleet path): returns (param_pspecs_raw, param_shardings,
@@ -150,7 +248,7 @@ class ShardedTrainStep:
     """One compiled XLA program: forward + loss + grad + optimizer update,
     with explicit in/out shardings over the mesh. Donates params/opt state."""
 
-    def __init__(self, model, loss_fn, optimizer, mesh, batch_specs, zero_stage=0, remat=False, gradient_merge_k=1, gradient_merge_avg=True):
+    def __init__(self, model, loss_fn, optimizer, mesh, batch_specs, zero_stage=0, remat=False, gradient_merge_k=1, gradient_merge_avg=True, explicit_update=None, quant_grads=False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -166,9 +264,56 @@ class ShardedTrainStep:
         self.gm_avg = bool(gradient_merge_avg)
         self._compiled = None
         self.param_specs = module_param_specs(model, mesh, zero_stage)
+        # --- explicit ZeRO weight-update path (module docstring) --------
+        eligible = explicit_update_eligible(mesh)
+        if explicit_update is None:
+            self.explicit_update = zero_stage >= 2 and eligible
+        elif explicit_update:
+            if zero_stage < 2:
+                raise ValueError(
+                    "explicit_update needs zero_stage >= 2 (the path IS "
+                    "the stage-2/3 grad reduce-scatter + sharded update)")
+            if not eligible:
+                raise ValueError(
+                    "explicit_update needs a pure-dp mesh (dp > 1, every "
+                    f"other axis degree 1); got {dict(mesh.shape)} — "
+                    "dp x mp / 'sharding'-axis meshes take the GSPMD path")
+            self.explicit_update = True
+        else:
+            self.explicit_update = False
+        self.quant_grads = bool(quant_grads)
+        if self.quant_grads and not self.explicit_update:
+            raise ValueError(
+                "quant_grads rides the explicit weight-update path "
+                "(int8 reduce-scatter) — it needs zero_stage >= 2 on a "
+                "pure-dp mesh, or explicit_update=True")
+        if self.explicit_update:
+            if optimizer._grad_clip is not None:
+                raise ValueError(
+                    "explicit_update cannot honor grad_clip: the global "
+                    "grad norm needs every leaf while the update only "
+                    "holds 1/dp shards — clip eagerly or use the GSPMD "
+                    "path (explicit_update=False)")
+            if not getattr(optimizer, "_elementwise_update", True):
+                raise ValueError(
+                    f"{type(optimizer).__name__} computes per-tensor "
+                    "reductions in its update rule; the shard-local "
+                    "explicit update would change its semantics — use "
+                    "the GSPMD path (explicit_update=False)")
+            self._dp = int(mesh.shape["dp"])
+            self._opt_init_fn = None  # cached jitted sharded-state builder
+            # per-leaf flat layout: natural shape, element count, pad to
+            # the next dp multiple (one derivation, used by init_state,
+            # the step body, and gather_params)
+            self._flat_meta = {}
+            for name, p in model.named_parameters_dict().items():
+                n = int(np.prod(p.shape)) if p.shape else 1
+                self._flat_meta[name] = (tuple(p.shape), n, (-n) % self._dp)
 
     # ---- state placement ---------------------------------------------------
     def init_state(self):
+        if self.explicit_update:
+            return self._explicit_init_state()
         params, buffers = state_dict_arrays(self.model)
         params = {
             k: jax.device_put(v, NamedSharding(self.mesh, self.param_specs[k]))
@@ -216,8 +361,219 @@ class ShardedTrainStep:
             out.append(jax.device_put(jnp.asarray(a), NamedSharding(self.mesh, spec)))
         return tuple(out)
 
+    # ---- explicit weight-update path (arXiv:2004.13336) --------------------
+    def _explicit_state_specs(self):
+        """PartitionSpec trees (params, buffers, opt state — pre-gm wrap)
+        for the explicit layout: stage-3 params and every param-shaped
+        optimizer slot live as padded-flat [n_pad] leaves sharded P('dp');
+        scalar slots (beta pows) and buffers replicate."""
+        stage3 = self.zero_stage >= 3
+        pspec = {k: (P("dp") if stage3 else P()) for k in self._flat_meta}
+        _, buffers = state_dict_arrays(self.model)
+        bspec = {k: P() for k in buffers}
+        named = self.model.named_parameters_dict()
+        flat_structs = {
+            k: jax.ShapeDtypeStruct((n + pad,), named[k]._array.dtype)
+            for k, (shape, n, pad) in self._flat_meta.items()
+        }
+        tmpl = jax.eval_shape(self.optimizer.init_state_arrays, flat_structs)
+        ospec = {
+            k: {s: (P("dp") if a.shape == flat_structs[k].shape else P())
+                for s, a in slots.items()}
+            for k, slots in tmpl.items()
+        }
+        return pspec, bspec, ospec
+
+    def _explicit_init_state(self):
+        ns = lambda spec: NamedSharding(self.mesh, spec)
+        params_nat, buffers = state_dict_arrays(self.model)
+        buffers = {k: jax.device_put(v, ns(P())) for k, v in buffers.items()}
+        # padded-flat leaves, dp-sharded from the start — stage 3's
+        # resident params, and the values the optimizer state (master
+        # weights included) seeds from at the flat layout
+        flat = {
+            k: _flatten_pad_fn(self._flat_meta[k][2], ns(P("dp")))(v)
+            for k, v in params_nat.items()
+        }
+        if self._opt_init_fn is None:
+            _, _, ospec = self._explicit_state_specs()
+            oshard = {k: {s: ns(sp) for s, sp in slots.items()}
+                      for k, slots in ospec.items()}
+            self._opt_init_fn = jax.jit(self.optimizer.init_state_arrays,
+                                        out_shardings=oshard)
+        opt_state = self._opt_init_fn(flat)
+        if self.zero_stage >= 3:
+            params = flat
+        else:
+            params = {k: jax.device_put(v, ns(P()))
+                      for k, v in params_nat.items()}
+        if self.gm_k > 1:
+            accum = {
+                k: _sharded_zeros_fn((n + pad,), "float32", ns(P("dp")))()
+                for k, (shape, n, pad) in self._flat_meta.items()
+            }
+            opt_state = {"inner": opt_state, "gm_accum": accum,
+                         "gm_count": jnp.zeros((), jnp.int32)}
+        return params, buffers, opt_state
+
+    def gather_params(self, params):
+        """Natural-shape replicated params from the explicit stage-3
+        resident layout (padded-flat dp-sharded leaves); pass-through on
+        every other path. For eval/checkpoint interop."""
+        if not (self.explicit_update and self.zero_stage >= 3):
+            return params
+        out = {}
+        for k, v in params.items():
+            shape, n, pad = self._flat_meta[k]
+            full = jax.device_put(v, NamedSharding(self.mesh, P()))
+            out[k] = full[:n].reshape(shape)
+        return out
+
+    def _build_explicit(self, n_batch):
+        from ..distributed.fleet.meta_parallel.mp_layers import (
+            constraints_disabled,
+        )
+        from ._compat import shard_map
+        from .collectives import quantized_psum_scatter
+
+        model = self.model
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+        dp = self._dp
+        meta = self._flat_meta
+        stage3 = self.zero_stage >= 3
+        quant = self.quant_grads
+
+        def pad_flat(x, pad):
+            return jnp.pad(x.reshape(-1), (0, pad))
+
+        def step(params, buffers, opt_state, lr, key, *batch):
+            # shard-local view: batch leaves are [B/dp, ...]; stage-3
+            # params (and every param-shaped opt slot) are [n_pad/dp]
+            if stage3:
+                nat = {
+                    k: jax.lax.all_gather(params[k], "dp", tiled=True)
+                    [: meta[k][1]].reshape(meta[k][0])
+                    for k in params
+                }
+            else:
+                nat = params
+            # independent dropout masks per replica; deterministic models
+            # never consume the key, preserving bit-parity with stage 0
+            key_local = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+
+            with constraints_disabled():
+                def compute_loss(p):
+                    def fwd(pp):
+                        return functional_call(
+                            model, pp, buffers, args=batch[: n_batch - 1],
+                            rng_key=key_local, training=True,
+                        )
+
+                    if self.remat:
+                        out, new_buf = jax.checkpoint(fwd)(p)
+                    else:
+                        out, new_buf = fwd(p)
+                    loss = loss_fn(out, batch[n_batch - 1])
+                    return loss, (out, new_buf)
+
+                (loss, (out, new_buf)), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True
+                )(nat)
+            loss = jax.lax.psum(loss, "dp") / dp
+            # mutated buffers (BN running stats) average over replicas —
+            # the LocalSGD discipline, one small all-reduce per leaf
+            new_buf = {
+                k: (jax.lax.psum(v.astype(jnp.float32), "dp") / dp
+                    ).astype(v.dtype)
+                for k, v in new_buf.items()
+            }
+            # the 2004.13336 core: reduce-scatter grads, update 1/dp
+            # shard-locally, gather only the updated shards
+            g_shards, p_shards = {}, {}
+            for k, g in grads.items():
+                shape, n, pad = meta[k]
+                flat = pad_flat(g / dp, pad)
+                if quant:
+                    gs = quantized_psum_scatter(
+                        flat.astype(jnp.float32), "dp", dp
+                    ).astype(flat.dtype)
+                else:
+                    gs = jax.lax.psum_scatter(
+                        flat, "dp", scatter_dimension=0, tiled=True)
+                g_shards[k] = gs
+                if stage3:
+                    p_shards[k] = params[k]
+                else:
+                    slen = (n + pad) // dp
+                    p_shards[k] = jax.lax.dynamic_slice_in_dim(
+                        pad_flat(params[k], pad),
+                        jax.lax.axis_index("dp") * slen, slen)
+            if self.gm_k > 1:
+                accum = {
+                    k: opt_state["gm_accum"][k]
+                    + g_shards[k].astype(jnp.float32)
+                    for k in g_shards
+                }
+                count = opt_state["gm_count"] + 1
+                apply_now = (count % self.gm_k) == 0
+                scale = (1.0 / self.gm_k) if self.gm_avg else 1.0
+                merged = {k: (a * scale).astype(g_shards[k].dtype)
+                          for k, a in accum.items()}
+                upd_p, upd_o = optimizer.apply_gradients_arrays(
+                    p_shards, merged, opt_state["inner"], lr
+                )
+                sel = lambda a, b: jax.tree_util.tree_map(
+                    lambda x, y: jnp.where(apply_now, x, y), a, b
+                )
+                new_pshards = sel(upd_p, p_shards)
+                new_opt = {
+                    "inner": sel(upd_o, opt_state["inner"]),
+                    "gm_accum": sel(
+                        {k: jnp.zeros_like(a) for k, a in accum.items()},
+                        accum,
+                    ),
+                    "gm_count": count,
+                }
+            else:
+                new_pshards, new_opt = optimizer.apply_gradients_arrays(
+                    p_shards, g_shards, opt_state, lr
+                )
+            if stage3:
+                new_params = new_pshards
+            else:
+                new_params = {
+                    k: jax.lax.all_gather(v, "dp", tiled=True)
+                    [: meta[k][1]].reshape(meta[k][0])
+                    for k, v in new_pshards.items()
+                }
+            return loss, new_params, new_buf, new_opt
+
+        pspec, bspec, ospec = self._explicit_state_specs()
+        if self.gm_k > 1:
+            ospec = {"inner": ospec,
+                     "gm_accum": {k: P("dp") for k in pspec},
+                     "gm_count": P()}
+        in_specs = (pspec, bspec, ospec, P(), P()) + tuple(self.batch_specs)
+        out_specs = (P(), pspec, bspec, ospec)
+        fn = shard_map(step, mesh=self.mesh, in_specs=in_specs,
+                       out_specs=out_specs)
+        ns = lambda spec: NamedSharding(self.mesh, spec)
+        tree_ns = lambda tree: jax.tree_util.tree_map(
+            ns, tree, is_leaf=lambda x: isinstance(x, P))
+        in_shardings = tuple(tree_ns(s) for s in in_specs)
+        out_shardings = tuple(tree_ns(s) for s in out_specs)
+        return jax.jit(
+            fn,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=mesh_donate_argnums((0, 2)),
+        )
+
     # ---- compile -----------------------------------------------------------
     def _build(self, n_batch):
+        if self.explicit_update:
+            return self._build_explicit(n_batch)
         model = self.model
         loss_fn = self.loss_fn
         optimizer = self.optimizer
@@ -356,12 +712,14 @@ class ShardedTrainStep:
         return lowered, donation
 
 
-def make_sharded_train_step(model, loss_fn, optimizer, mesh, batch_specs=None, zero_stage=0, remat=False, gradient_merge_k=1, gradient_merge_avg=True):
+def make_sharded_train_step(model, loss_fn, optimizer, mesh, batch_specs=None, zero_stage=0, remat=False, gradient_merge_k=1, gradient_merge_avg=True, explicit_update=None, quant_grads=False):
     """loss_fn(outputs_arrays, labels_array) -> scalar array, in trace mode."""
     if batch_specs is None:
         batch_specs = (P("dp"), P("dp"))
     return ShardedTrainStep(model, loss_fn, optimizer, mesh, batch_specs,
-                            zero_stage, remat, gradient_merge_k, gradient_merge_avg)
+                            zero_stage, remat, gradient_merge_k, gradient_merge_avg,
+                            explicit_update=explicit_update,
+                            quant_grads=quant_grads)
 
 
 class LocalSGDTrainStep:

@@ -312,6 +312,7 @@ def quantized_row_parallel(x, w, bias, mesh, tp_axis=ServingMesh.TP_AXIS):
     from jax.sharding import PartitionSpec as P
 
     from ..parallel._compat import shard_map
+    from ..parallel.collectives import quantized_allgather_sum
 
     tp = tp_axis
 
@@ -320,11 +321,9 @@ def quantized_row_parallel(x, w, bias, mesh, tp_axis=ServingMesh.TP_AXIS):
             xs.astype(jnp.float32), ws.astype(jnp.float32),
             (((xs.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        sc = jnp.maximum(jnp.max(jnp.abs(part)) / 127.0, 1e-12)
-        q = jnp.clip(jnp.round(part / sc), -127, 127).astype(jnp.int8)
-        qg = jax.lax.all_gather(q, tp)           # [tp, .., out] int8
-        sg = jax.lax.all_gather(sc, tp)          # [tp] f32
-        return jnp.tensordot(sg, qg.astype(jnp.float32), ((0,), (0,)))
+        # 2 all-gathers (int8 payload + f32 scale) — the shapes IR001
+        # locks via `serving_collective_budget(quant_collectives=...)`.
+        return quantized_allgather_sum(part, tp)
 
     in_spec_x = P(*([None] * (x.ndim - 1) + [tp]))
     fn = shard_map(local, mesh=mesh,

@@ -194,6 +194,7 @@ class TestDistributeFPN:
 
 
 class TestPPYOLOE:
+    @pytest.mark.slow  # tier-1 headroom (PR 19): heaviest always-on case; tier-2 covers it
     def test_predict_shapes_and_validity(self):
         from paddle_tpu.vision.models import ppyoloe_s
 

@@ -524,6 +524,7 @@ def test_sparse_add_multiply_stay_sparse():
     )
 
 
+@pytest.mark.slow  # tier-1 headroom (PR 19): heaviest always-on case; tier-2 covers it
 def test_resnet_nhwc_matches_nchw():
     """data_format="NHWC" (the TPU-optimal channels-minor layout) must be
     numerically identical to NCHW with the same weights, in eval AND train

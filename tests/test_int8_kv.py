@@ -269,6 +269,7 @@ def _mean_nll(model, seqs):
     return tot / n
 
 
+@pytest.mark.slow  # tier-1 headroom (PR 19): heaviest always-on case; tier-2 covers it
 def test_adaround_nll_gate_and_grid(model):
     """`LLMEngine(quantize="int8", ...)` rewrites block linears in place
     on an int8 grid; the held-out mean NLL may exceed f32 by at most

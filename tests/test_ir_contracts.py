@@ -68,20 +68,29 @@ def test_program_set_covers_the_registry(artifacts):
     want |= {f"serve_int8/tp{tp}/w1" for tp in (1, 2)}
     want |= {f"serve_int8/tp{tp}/{name}"
              for tp in (1, 2) for name in eng.swap_program_shapes()}
-    want.add("train/dp2_mp2")
+    # the train/* family: legacy dp2 x mp2, the locked zs2-legacy
+    # 'before', and the explicit weight-update matrix on dp4
+    train_names = {"train/dp2_mp2", "train/dp2_mp2/zs2-legacy",
+                   "train/dp4/zs0", "train/dp4/zs2", "train/dp4/zs3",
+                   "train/dp4/zs2_gm2", "train/dp4/zs2_q8"}
+    want |= train_names
     # one artifact per ragged width bucket plus the host-tier swap pair
     # (x2 for the int8 family's w1 + swaps) — the engine helpers are the
     # ONE place the program-count contract lives
     assert len(want) == (2 * eng.expected_program_count()
-                         + 4 * len(eng.swap_program_shapes()) + 2 + 1)
+                         + 4 * len(eng.swap_program_shapes()) + 2
+                         + len(train_names))
     assert names == want, names
 
 
 def test_gate_stays_under_budget(artifacts):
-    # the whole lower+compile pass must stay cheap enough for tier-1
-    assert _build_s[0] < 45.0, (
+    # the whole lower+compile pass must stay cheap enough for tier-1;
+    # budget raised 45s -> 95s with the PR 19 train/* family (7 train
+    # programs at ~6s each lock the explicit ZeRO collective shapes —
+    # paid for by slow-marking heavier always-on tests the same PR)
+    assert _build_s[0] < 95.0, (
         f"hlolint program set took {_build_s[0]:.1f}s to lower+compile "
-        "(budget 45s) — shrink the tiny config or trim the registry")
+        "(budget 95s) — shrink the tiny config or trim the registry")
 
 
 def test_tp2_collectives_match_the_layout_budget(artifacts):

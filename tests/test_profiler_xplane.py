@@ -145,6 +145,7 @@ def test_schedule_analysis_reports_per_capture():
             assert not s["top_gaps"]
 
 
+@pytest.mark.slow  # tier-1 headroom (PR 19): heaviest always-on case; tier-2 covers it
 def test_real_capture_schema_canary():
     """VERDICT residual risk: schema drift in jax's xplane output would
     pass CI (the math tests build captures by hand) and fail in the

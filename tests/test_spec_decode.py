@@ -207,6 +207,7 @@ def test_verify_rejection_sampling_respects_top_k(model):
 
 # -- greedy parity ---------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 headroom (PR 19): heaviest always-on case; tier-2 covers it
 def test_spec_greedy_parity_mixed_batch(model):
     """THE acceptance test: the same overlapping request mix served by a
     spec-enabled engine and a plain engine is token-for-token identical,
