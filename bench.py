@@ -893,6 +893,188 @@ def bench_gpt_serve_router(on_tpu, errors, deadline_s):
     return out
 
 
+def _serve_adapter_wave(model, cfg, rs, errors, deadline_s):
+    """N-adapter LoRA wave: the same workload round-robined across the
+    base model and N loaded adapters on ONE engine, vs the identical
+    workload on a plain (lora_slots=0) engine. Reports tok/s for both,
+    the overhead ratio, and `jit_traces_measured` — which adapters a
+    step mixes must never key a program (the zero-retrace claim)."""
+    from paddle_tpu.models import lora as lora_mod
+    from paddle_tpu.serving import LLMEngine
+
+    if time.monotonic() > deadline_s:
+        errors.append("gpt_serve_fairness: deadline before adapter wave")
+        return None
+    n_adapters = 4
+    gen = 8 if _fast() else 16
+    names = [f"adapter-{i}" for i in range(n_adapters)]
+    prompts = [rs.randint(0, cfg.vocab_size, (24,)).tolist()
+               for _ in range(3 * (n_adapters + 1))]
+
+    def wave(lora_slots):
+        eng = LLMEngine(model, block_size=16, max_batch=4, slo=True,
+                        lora_slots=lora_slots, lora_rank=8)
+        if lora_slots:
+            for i, nm in enumerate(names):
+                eng.load_adapter(nm, lora_mod.random_adapter(
+                    cfg, 8, lora_mod.LORA_TARGETS, seed=i + 1,
+                    scale=0.05))
+        # warm both programs outside the timing
+        list(eng.generate([rs.randint(0, cfg.vocab_size, (8,))],
+                          max_new_tokens=2))
+        warm_tokens = eng.metrics.counters["generated_tokens"]
+        warm_traces = eng.metrics.counters["jit_traces"]
+        eng.metrics.reset_schedule()
+        # base + every adapter in one continuous batch
+        cycle = [None] + (names if lora_slots else [None] * n_adapters)
+        for i, p in enumerate(prompts):
+            eng.add_request(p, max_new_tokens=gen,
+                            adapter=cycle[i % len(cycle)])
+        t0 = time.perf_counter()
+        while eng.has_unfinished():
+            if time.monotonic() > deadline_s:
+                errors.append("gpt_serve_fairness: deadline mid-adapter-"
+                              "wave; partial throughput")
+                break
+            eng.step()
+        dt = time.perf_counter() - t0
+        c = eng.metrics.counters
+        return {
+            "tok_s": round((c["generated_tokens"] - warm_tokens) / dt, 1),
+            "jit_traces_measured": int(c["jit_traces"] - warm_traces),
+        }
+
+    lora = wave(n_adapters)
+    base = wave(0)
+    out = {
+        "n_adapters": n_adapters,
+        "requests": len(prompts),
+        "tok_s": lora["tok_s"],
+        "tok_s_base": base["tok_s"],
+        # > 1.0 = the per-row gather + two rank-r matmuls cost; the
+        # trajectory catches this creeping, not just absolute tok/s
+        "overhead_ratio": (round(base["tok_s"] / lora["tok_s"], 3)
+                           if lora["tok_s"] else None),
+        "jit_traces_measured": lora["jit_traces_measured"],
+    }
+    if lora["jit_traces_measured"]:
+        errors.append(
+            f"gpt_serve_fairness: {lora['jit_traces_measured']} retraces "
+            "in the measured adapter wave — adapter mixing keyed a program")
+    return out
+
+
+def bench_gpt_serve_fairness(on_tpu, errors, deadline_s):
+    """Multi-tenant scheduling wave (serving/policy.py): a mixed-priority
+    overload — interactive / standard / batch tenants all submitted up
+    front against a max_batch far below the queue depth — served twice:
+    policy ON (strict priority + windowed tenant fairness) vs the FCFS
+    engine. One JSON line reports per-priority-class p95 TTFT, deadline
+    attainment, and finish counts; the policy must pull interactive's
+    p95 TTFT BELOW FCFS's interleaved arrival order, and the starvation
+    check asserts the lowest class still finished everything (strict
+    priority drains the queue, it never parks it). A second sub-wave
+    measures N-adapter LoRA serving on the same line (tok/s vs the
+    plain engine + the zero-retrace check)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import LLMEngine
+
+    del on_tpu  # a scheduling-policy wave: CPU-sized model either way
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=256, attn_impl="xla")
+    model = GPT(cfg)
+    model.eval()
+    rs = np.random.RandomState(0)
+    gen = 8 if _fast() else 16
+    per_class = 2 if _fast() else 4
+    # (priority, tenant): one tenant per class; arrival order interleaves
+    # the classes so FCFS serves them round-robin while the policy
+    # strictly reorders — the measured gap IS the policy
+    classes = (("interactive", "chat"), ("standard", "api"),
+               ("batch", "nightly"))
+    reqs = [(prio, tenant, rs.randint(0, cfg.vocab_size, (24,)).tolist())
+            for _ in range(per_class) for prio, tenant in classes]
+
+    def wave(policy):
+        eng = LLMEngine(model, block_size=16, max_batch=2, slo=True,
+                        policy=policy)
+        list(eng.generate([rs.randint(0, cfg.vocab_size, (8,))],
+                          max_new_tokens=2))
+        warm_tokens = eng.metrics.counters["generated_tokens"]
+        eng.metrics.reset_schedule()
+        eng.slo.reset()
+        # the overload: every request is waiting before the first step,
+        # so admission ORDER (not capacity) decides who goes first; the
+        # deadline is accounting-generous — attainment is 1.0 unless the
+        # tail regresses pathologically, the same drift-alarm discipline
+        # as bench_gpt_serve
+        for prio, tenant, p in reqs:
+            eng.add_request(p, max_new_tokens=gen, priority=prio,
+                            tenant=tenant, deadline_s=120.0)
+        t0 = time.perf_counter()
+        while eng.has_unfinished():
+            if time.monotonic() > deadline_s:
+                errors.append("gpt_serve_fairness: deadline mid-wave; "
+                              "partial throughput")
+                break
+            eng.step()
+        dt = time.perf_counter() - t0
+        generated = eng.metrics.counters["generated_tokens"] - warm_tokens
+        roll = eng.slo.rollup()
+        by_prio = {c["priority"]: c for c in roll["classes"]}
+        return {
+            "tok_s": round(generated / dt, 1),
+            "by_class": {
+                prio: {
+                    "ttft_p95_ms": by_prio[prio]["ttft_ms"]["p95"],
+                    "deadline_attainment":
+                        by_prio[prio]["deadline"]["attainment"],
+                    "finished": by_prio[prio]["finished"],
+                    "output_tokens": by_prio[prio]["output_tokens"],
+                } for prio, _ in classes if prio in by_prio},
+        }
+
+    pol = wave(True)
+    if time.monotonic() > deadline_s:
+        errors.append("gpt_serve_fairness: deadline before FCFS wave; "
+                      "comparison dropped")
+        fcfs = None
+    else:
+        fcfs = wave(None)
+    out = {"value": pol["tok_s"], "requests": len(reqs),
+           "per_class_requests": per_class, "policy": pol}
+    # the starvation check: strict priority must DRAIN the queue — the
+    # lowest class finishes every request and emitted real tokens
+    batch = pol["by_class"].get("batch", {})
+    out["starvation_free"] = (batch.get("finished") == per_class
+                              and batch.get("output_tokens", 0) > 0)
+    if not out["starvation_free"]:
+        errors.append(f"gpt_serve_fairness: batch class starved: {batch}")
+    for prio, _ in classes:
+        att = pol["by_class"].get(prio, {}).get("deadline_attainment")
+        if att is not None and att < 1.0:
+            errors.append(f"gpt_serve_fairness: {prio} attainment {att} "
+                          "< 1.0 under a 120s accounting deadline")
+    if fcfs is not None:
+        out["fcfs"] = fcfs
+        a = pol["by_class"].get("interactive", {}).get("ttft_p95_ms")
+        b = fcfs["by_class"].get("interactive", {}).get("ttft_p95_ms")
+        if a is not None and b is not None:
+            out["interactive_ttft_p95_gain_ms"] = round(b - a, 2)
+            if a >= b:
+                errors.append(
+                    f"gpt_serve_fairness: policy interactive p95 TTFT "
+                    f"{a}ms not below FCFS {b}ms")
+        _log(f"fairness serve: policy {pol['tok_s']} tok/s vs FCFS "
+             f"{fcfs['tok_s']} tok/s; interactive p95 TTFT {a} vs {b}")
+    adapters = _serve_adapter_wave(model, cfg, rs, errors, deadline_s)
+    if adapters:
+        out["lora"] = adapters
+    return out
+
+
 def bench_gpt_serve_autoscale(on_tpu, errors, deadline_s):
     """Elastic-fleet closed loop (serving/autoscale.py): one replica born
     from a streamed sharded checkpoint (skeleton model + warmup wave)
@@ -1834,6 +2016,7 @@ _BENCHES = {
     "gpt_serve": bench_gpt_serve,
     "gpt_serve_multichip": bench_gpt_serve_multichip,
     "gpt_serve_router": bench_gpt_serve_router,
+    "gpt_serve_fairness": bench_gpt_serve_fairness,
     "gpt_serve_autoscale": bench_gpt_serve_autoscale,
     "gpt_serve_longdoc_qa": bench_gpt_serve_longdoc_qa,
     "gpt_serve_nbest": bench_gpt_serve_nbest,
@@ -2035,6 +2218,17 @@ def main():
     if rt:
         completed += 1
         extras["gpt_serve_router"] = rt
+
+    # multi-tenant policy wave: mixed-priority overload, policy vs FCFS
+    # per-class TTFT/attainment + starvation check, and the N-adapter
+    # LoRA tok/s + zero-retrace sub-wave
+    r = _run_isolated("gpt_serve_fairness", min(240.0, _remaining()))
+    errors.extend(r.get("errors") or [])
+    fa = _emit_model("gpt_serve_fairness", r, "tokens/sec",
+                     metric="gpt_serve_fairness_tokens_per_sec")
+    if fa:
+        completed += 1
+        extras["gpt_serve_fairness"] = fa
 
     # host-tier workload scenarios: long-document QA over a shared
     # corpus, and n-best parallel sampling — both over device capacity,

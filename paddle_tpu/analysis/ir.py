@@ -357,23 +357,26 @@ def tiny_gpt_config():
 
 
 def build_serving_engine(model, tp_degree, kv_dtype=None,
-                         quant_allreduce=None):
+                         quant_allreduce=None, lora_slots=0, lora_rank=4):
     """The harness engine: spec decoding ON so every default width
     bucket exists (w1 decode, w4 spec, w8 chunk); mesh=1 is the explicit
     single-chip request (beats a stray PADDLE_TPU_TP env,
     serving/sharded.py). ``kv_dtype``/``quant_allreduce`` select the
-    int8 program family (quantized arena + EQuARX collectives)."""
+    int8 program family (quantized arena + EQuARX collectives);
+    ``lora_slots`` the serve_lora family (stacked adapter tables gathered
+    per row inside the same unified step)."""
     from ..serving.engine import LLMEngine
 
     return LLMEngine(model, block_size=8, max_batch=2, prefill_chunk=8,
                      mesh=tp_degree, spec_decoding=True, num_spec_tokens=3,
                      host_kv_blocks=8, kv_dtype=kv_dtype,
-                     quant_allreduce=quant_allreduce)
+                     quant_allreduce=quant_allreduce,
+                     lora_slots=lora_slots, lora_rank=lora_rank)
 
 
 def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None,
                       kv_dtype=None, quant_allreduce=None, prefix="serve",
-                      include_swap=None):
+                      include_swap=None, lora_slots=0, lora_rank=4):
     """Lower + compile the engine's width-bucket programs at each tp
     degree; returns [ProgramArtifact]. `kinds` restricts to a name
     subset (the seeded-regression tests lower just "w1");
@@ -381,7 +384,12 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None,
     full set" rule. `kv_dtype`/`quant_allreduce` build the int8 family
     under its own `prefix` — the budget derives from the ENGINE's
     resolved `quant_collectives` (per-op gating), so IR001 locks the
-    quantized collective shape exactly."""
+    quantized collective shape exactly. `lora_slots` builds the
+    serve_lora family: the budget is the SAME arithmetic
+    `serving_collective_budget` as the base family — the per-row
+    adapter gather adds tensors, never collectives (A replicated, B
+    sharded on the already-tp-sharded output axis), and IR001 pins
+    that at every tp degree."""
     import jax
 
     from ..models.gpt import GPT
@@ -394,7 +402,9 @@ def serving_artifacts(model=None, tp_degrees=(1, 2), kinds=None,
     arts = []
     for tp in tp_degrees:
         eng = build_serving_engine(model, tp, kv_dtype=kv_dtype,
-                                   quant_allreduce=quant_allreduce)
+                                   quant_allreduce=quant_allreduce,
+                                   lora_slots=lora_slots,
+                                   lora_rank=lora_rank)
         spec = eng.step_program_spec()
         budget = serving_collective_budget(
             model.cfg, tp, quant_collectives=eng.quant_collectives)
@@ -551,12 +561,18 @@ def default_artifacts():
     end-to-end family (quantized arena + EQuARX collectives; the w1
     decode step and the 4-array swap copies — the widths share one
     quantization story, so w1 pins the shape without tripling compile
-    time) + the train/* family (legacy dp2 x mp2, the locked zs2-legacy
-    'before', and the explicit weight-update matrix on dp4)."""
+    time) + the serve_lora family (2-slot adapter tables gathered per
+    row inside the w1 decode step; the collective budget is IDENTICAL
+    to the base family at both tp degrees — IR001's zero-new-collectives
+    pin — and IR004 locks the adapter-gather flops/bytes delta) + the
+    train/* family (legacy dp2 x mp2, the locked zs2-legacy 'before',
+    and the explicit weight-update matrix on dp4)."""
     arts = serving_artifacts()
     arts += serving_artifacts(kinds=("w1",), kv_dtype="int8",
                               quant_allreduce=True, prefix="serve_int8",
                               include_swap=True)
+    arts += serving_artifacts(kinds=("w1",), lora_slots=2,
+                              prefix="serve_lora")
     arts += train_artifacts()
     return arts
 

@@ -95,6 +95,28 @@ def _serving_row_parallel(layer, x, op_name, cache):
     return layer(x)
 
 
+def _serving_column_parallel(layer, x, op_name, cache):
+    """ColumnParallel projection on the paged serving path, with each
+    lane's LoRA delta added when the threaded-through `PagedState`
+    carries gathered adapter rows for `op_name` (models/lora.py —
+    ``y + x @ A[slot] @ B[slot]``, slot 0 all-zeros = base). The gate
+    lives on the state like `_serving_row_parallel`'s quant gate: ONE
+    model serves adapter-enabled and plain engines at once, the delta
+    inherits the base output's tp layout from B's sharded out axis (no
+    new collectives), and a lora-less engine traces the byte-identical
+    program it always has."""
+    y = layer(x)
+    st = getattr(cache, "state", cache)
+    lora = getattr(st, "lora", None)
+    if lora is None or op_name not in lora:
+        return y
+    from .lora import apply_adapter_rows
+
+    a_rows, b_rows = lora[op_name]
+    delta = apply_adapter_rows(x._array, a_rows, b_rows, cache.layer)
+    return Tensor._from_op(y._array + delta)
+
+
 class CausalSelfAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -111,7 +133,11 @@ class CausalSelfAttention(nn.Layer):
 
     def forward(self, x, cache=None):
         b, s, _ = x.shape
-        qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded on last dim)
+        if cache is not None and getattr(cache, "is_paged", False):
+            # [b, s, 3h] (mp-sharded on last dim) + per-lane LoRA delta
+            qkv = _serving_column_parallel(self.qkv, x, "attn_qkv", cache)
+        else:
+            qkv = self.qkv(x)  # [b, s, 3h] (mp-sharded on last dim)
         # per-head-grouped regroup (module-level so hlolint's seeded
         # regression can patch in the qkv-major layout it exists to catch)
         q, k, v = _split_fused_qkv(qkv, b, s, self.num_heads, self.head_dim)
@@ -190,8 +216,10 @@ class GPTBlock(nn.Layer):
         if cache is not None:
             attn_out, new_cache = self.attn(self.ln1(x), cache=cache)
             x = x + attn_out
+            h = _serving_column_parallel(self.fc1, self.ln2(x), "ffn_fc1",
+                                         cache)
             x = x + _serving_row_parallel(
-                self.fc2, self.act(self.fc1(self.ln2(x))), "ffn_fc2", cache)
+                self.fc2, self.act(h), "ffn_fc2", cache)
             return x, new_cache
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = _constraint(x, "dp", "sp", None)

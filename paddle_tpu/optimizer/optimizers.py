@@ -6,9 +6,49 @@ optimizer/__init__.py:15-25).
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax.numpy as jnp
 
 from .optimizer import L2Decay, Optimizer
+
+# When set (by `sharded_norms`), `_tensor_norm` folds a psum over this
+# mesh axis into every per-tensor norm — the bridge that lets Lars/Lamb
+# trust ratios run on the explicit ZeRO path's 1/dp flat shards
+# (parallel/spmd.py): each shard contributes its partial sum of squares
+# and every replica sees the FULL tensor's norm.
+_NORM_AXIS = None
+
+
+@contextlib.contextmanager
+def sharded_norms(axis):
+    """Trace-time context: per-tensor norms inside optimizer `_update`
+    rules psum their squared sums over mesh `axis`. Only meaningful
+    inside a `shard_map` over that axis (the explicit weight-update
+    path wraps its shard-local `apply_gradients_arrays` calls in this);
+    elsewhere the psum would fail to resolve the axis name at trace."""
+    global _NORM_AXIS
+    prev = _NORM_AXIS
+    _NORM_AXIS = axis
+    try:
+        yield
+    finally:
+        _NORM_AXIS = prev
+
+
+def _tensor_norm(x):
+    """L2 norm of a whole parameter/gradient tensor — the ONE norm
+    primitive trust-ratio rules (Lars/Lamb) may use. Outside
+    `sharded_norms` it is a plain sqrt-of-squared-sum; inside, the
+    squared sum is psum'd over the sharding axis first, so a rule fed a
+    flat 1/dp shard still scales by the full-tensor norm (zero padding
+    contributes nothing to a sum of squares)."""
+    sq = jnp.sum(jnp.square(x.astype(jnp.float32)))
+    if _NORM_AXIS is not None:
+        import jax
+
+        sq = jax.lax.psum(sq, _NORM_AXIS)
+    return jnp.sqrt(sq)
 
 
 class SGD(Optimizer):
@@ -267,6 +307,9 @@ class Lars(Optimizer):
 
     _slot_names = ("velocity",)
     _elementwise_update = False  # per-tensor reduction in _update (see Optimizer)
+    # ... but every reduction routes through _tensor_norm, so the
+    # explicit ZeRO path can run it shard-local under `sharded_norms`
+    _sharded_norm_ready = True
 
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, epsilon=0.0,
@@ -351,8 +394,8 @@ class Lars(Optimizer):
         g = grad.astype(jnp.float32)
         p32 = param.astype(jnp.float32)
         wd = self._lars_wd if apply_lars_wd else 0.0
-        w_norm = jnp.linalg.norm(p32)
-        g_norm = jnp.linalg.norm(g)
+        w_norm = _tensor_norm(p32)
+        g_norm = _tensor_norm(g)
         denom = g_norm + wd * w_norm + self._epsilon
         local_lr = jnp.where(
             (w_norm > 0) & (g_norm > 0),
@@ -370,6 +413,8 @@ class Lars(Optimizer):
 class Lamb(Optimizer):
     _slot_names = ("moment1", "moment2", "beta1_pow", "beta2_pow")
     _elementwise_update = False  # per-tensor reduction in _update (see Optimizer)
+    # trust ratio routes through _tensor_norm (see Lars) — explicit-path OK
+    _sharded_norm_ready = True
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name)
@@ -395,8 +440,8 @@ class Lamb(Optimizer):
         mhat = m / (1 - b1p)
         vhat = v / (1 - b2p)
         r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
-        w_norm = jnp.linalg.norm(p32)
-        r_norm = jnp.linalg.norm(r)
+        w_norm = _tensor_norm(p32)
+        r_norm = _tensor_norm(r)
         ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p32 - lr * ratio * r).astype(param.dtype), {
             "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,

@@ -294,7 +294,15 @@ class ShardedTrainStep:
                     "grad norm needs every leaf while the update only "
                     "holds 1/dp shards — clip eagerly or use the GSPMD "
                     "path (explicit_update=False)")
-            if not getattr(optimizer, "_elementwise_update", True):
+            if (not getattr(optimizer, "_elementwise_update", True)
+                    and not getattr(optimizer, "_sharded_norm_ready",
+                                    False)):
+                # trust-ratio rules that route every reduction through
+                # optimizers._tensor_norm declare _sharded_norm_ready:
+                # the step wraps their update in sharded_norms('dp') and
+                # each per-tensor norm psums shard-local partial squared
+                # sums — full-tensor semantics on 1/dp flat shards.
+                # Anything else (e.g. DGC's top-k) stays refused.
                 raise ValueError(
                     f"{type(optimizer).__name__} computes per-tensor "
                     "reductions in its update rule; the shard-local "
@@ -433,6 +441,7 @@ class ShardedTrainStep:
         from ..distributed.fleet.meta_parallel.mp_layers import (
             constraints_disabled,
         )
+        from ..optimizer.optimizers import sharded_norms
         from ._compat import shard_map
         from .collectives import quantized_psum_scatter
 
@@ -520,9 +529,10 @@ class ShardedTrainStep:
                 scale = (1.0 / self.gm_k) if self.gm_avg else 1.0
                 merged = {k: (a * scale).astype(g_shards[k].dtype)
                           for k, a in accum.items()}
-                upd_p, upd_o = optimizer.apply_gradients_arrays(
-                    p_shards, merged, opt_state["inner"], lr
-                )
+                with sharded_norms("dp"):
+                    upd_p, upd_o = optimizer.apply_gradients_arrays(
+                        p_shards, merged, opt_state["inner"], lr
+                    )
                 sel = lambda a, b: jax.tree_util.tree_map(
                     lambda x, y: jnp.where(apply_now, x, y), a, b
                 )
@@ -536,9 +546,10 @@ class ShardedTrainStep:
                     "gm_count": count,
                 }
             else:
-                new_pshards, new_opt = optimizer.apply_gradients_arrays(
-                    p_shards, g_shards, opt_state, lr
-                )
+                with sharded_norms("dp"):
+                    new_pshards, new_opt = optimizer.apply_gradients_arrays(
+                        p_shards, g_shards, opt_state, lr
+                    )
             if stage3:
                 new_params = new_pshards
             else:

@@ -113,6 +113,7 @@ from .autoscale import AutoScaler  # noqa: F401
 from .kv_tier import KVTier  # noqa: F401
 from .lifecycle import LifecycleError, ReplicaLifecycle  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .policy import SchedulingPolicy, as_policy  # noqa: F401
 from .postmortem import FlightRecorder  # noqa: F401
 from .router import (  # noqa: F401
     Replica,

@@ -54,7 +54,7 @@ def blocks_for(num_tokens, block_size):
     return max(1, -(-int(num_tokens) // int(block_size)))
 
 
-def chain_block_hashes(token_ids, block_size):
+def chain_block_hashes(token_ids, block_size, salt=None):
     """Chained content digests of each FULL block of `token_ids`.
 
     ``h_i = sha256(h_{i-1} || tokens[i*bs:(i+1)*bs])`` (empty seed), so a
@@ -66,10 +66,15 @@ def chain_block_hashes(token_ids, block_size):
     serves KV across requests, so an engineerable collision would silently
     hand one prompt another prompt's KV blocks (the vLLM prefix-cache
     collision advisory, CVE-2025-25183).
+
+    ``salt`` seeds the chain (models/lora.py adapter serving: a
+    sequence's KV depends on the adapter its tokens ran under, so the
+    same prompt under different adapters must NEVER share blocks — the
+    engine salts with the request's adapter name).
     """
     bs = int(block_size)
     hashes = []
-    h = b""
+    h = b"" if salt is None else str(salt).encode("utf-8")
     for i in range(len(token_ids) // bs):
         m = hashlib.sha256(h)
         m.update(np.asarray(token_ids[i * bs:(i + 1) * bs],
@@ -137,7 +142,7 @@ class PagedState:
     def __init__(self, k, v, block_tables, slots, offs, qpos,
                  q_start=None, kv_live=None, q_lens=None, mesh=None,
                  k_scale=None, v_scale=None, touched=None, touch_idx=None,
-                 quant_collectives=frozenset()):
+                 quant_collectives=frozenset(), lora=None):
         self.k = k
         self.v = v
         self.block_tables = block_tables
@@ -153,6 +158,11 @@ class PagedState:
         self.touched = touched
         self.touch_idx = touch_idx
         self.quant_collectives = quant_collectives
+        # per-row LoRA adapters (models/lora.py), already gathered for
+        # THIS step's lanes: {target op -> (a_rows [B,L,in,r],
+        # b_rows [B,L,r,out])} or None (no adapters in the program).
+        # models/gpt.py's column-parallel hook consults it per op.
+        self.lora = lora
 
     def layer(self, i):
         return PagedLayerView(self, i)

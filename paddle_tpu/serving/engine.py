@@ -216,7 +216,8 @@ class LLMEngine:
                  kv_dtype=None, quantize=None, calib_prompts=None,
                  quantize_iters=300, quant_allreduce=None,
                  checkpoint_path=None, param_hbm_bytes=None,
-                 warmup=False):
+                 policy=None, lora_slots=0, lora_rank=8,
+                 lora_targets=None, warmup=False):
         import jax
 
         from .sharded import as_serving_mesh, kv_capacity_blocks
@@ -580,6 +581,12 @@ class LLMEngine:
         self.metrics.set_gauge("kv_bytes_per_block",
                                self.pool.bytes_per_block())
         self.metrics.set_info("kv", {"dtype": self.pool.kv_dtype})
+        # scheduling policy (serving/policy.py): priority classes,
+        # windowed tenant fairness, deadline early-reject. None (the
+        # default) keeps the FCFS scheduler byte-identical.
+        from .policy import as_policy
+
+        self.policy = as_policy(policy)
         self.scheduler = Scheduler(
             self.pool, max_batch=self.max_batch,
             token_budget=int(token_budget),
@@ -587,8 +594,36 @@ class LLMEngine:
             prefill_interval=prefill_interval, metrics=self.metrics,
             prefix_cache=self.prefix_cache, drafter=drafter,
             tracer=self.tracer, slo=self.slo,
-            width_buckets=self.width_buckets,
+            width_buckets=self.width_buckets, policy=self.policy,
         )
+        # per-request LoRA adapters over the shared base model
+        # (models/lora.py): `lora_slots` device slots (slot 0 = the
+        # all-zeros "no adapter"), each holding a rank-<= lora_rank
+        # adapter over the column-parallel targets, gathered per-row
+        # INSIDE the unified ragged step. 0 slots = off: the step
+        # signature carries an empty table tree and the engine is
+        # byte-identical to the pre-LoRA engine.
+        self.lora_slots = int(lora_slots)
+        self.lora_rank = int(lora_rank)
+        self._lora_tables = {}
+        self._lora_shardings = {}      # step-jit in_shardings (empty = off)
+        self._adapters = {}        # name -> slot (1-based; 0 = base)
+        self._adapter_inflight = {}    # name -> live request count
+        self._adapter_lru = []         # names, least-recent first
+        self.lora_targets = ()
+        if self.lora_slots:
+            from ..models import lora as lora_mod
+
+            if self.lora_rank < 1:
+                raise ValueError("lora_rank must be >= 1 with lora_slots")
+            self.lora_targets = tuple(lora_targets
+                                      or lora_mod.LORA_TARGETS)
+            self._lora_tables = lora_mod.init_adapter_tables(
+                cfg, 1 + self.lora_slots, self.lora_rank,
+                self.lora_targets, smesh=self._smesh)
+            if self._smesh is not None:
+                self._lora_shardings = lora_mod.table_shardings(
+                    self.lora_targets, self._smesh)
         self._requests = {}
         self._step_fns = {}
         self._phases = {}   # current step's {phase: (t0, t1)} when tracing
@@ -794,7 +829,7 @@ class LLMEngine:
                     eos_token_id=None, request_id=None, top_k=None,
                     top_p=None, spec_decoding=None, num_spec_tokens=None,
                     trace=None, tenant=None, priority=None,
-                    deadline_s=None):
+                    deadline_s=None, adapter=None):
         """Enqueue one generation request; returns its id. Admission happens
         inside a later `step()` (continuous batching: requests join the
         running batch between decode steps, never blocking them). Prompts of
@@ -807,7 +842,9 @@ class LLMEngine:
         the lifecycle tracer regardless of its sampling fraction;
         `tenant`/`priority` label the request's SLO accounting class and
         `deadline_s` its attainment target (serving/slo.py — accounting
-        only here; the async frontend's ``timeout_s`` also enforces)."""
+        only here; the async frontend's ``timeout_s`` also enforces);
+        `adapter` names a loaded LoRA adapter (`load_adapter`) this
+        request decodes through (None = the shared base model)."""
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         req = Request(prompt_ids, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
@@ -815,7 +852,7 @@ class LLMEngine:
                       spec_decoding=spec_decoding,
                       num_spec_tokens=num_spec_tokens, trace=trace,
                       tenant=tenant, priority=priority,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, adapter=adapter)
         return self.add(req)
 
     def mesh_info(self):
@@ -848,6 +885,96 @@ class LLMEngine:
         never a logical-head recomputation."""
         return self.pool.num_blocks - 1
 
+    # -- LoRA adapter registry (models/lora.py owns the math) --------------
+
+    def _touch_adapter(self, name):
+        """Move `name` to the recently-used end of the LRU order."""
+        try:
+            self._adapter_lru.remove(name)
+        except ValueError:
+            pass
+        self._adapter_lru.append(name)
+
+    def _find_adapter_slot(self, name):
+        """Slot for a (re)load of `name`: its current slot, else a free
+        one, else the least-recently-used idle adapter's (evicting it).
+        Raises when every slot holds an adapter with requests in
+        flight."""
+        if name in self._adapters:
+            return self._adapters[name]
+        used = set(self._adapters.values())
+        for slot in range(1, 1 + self.lora_slots):
+            if slot not in used:
+                return slot
+        for victim in self._adapter_lru:
+            if not self._adapter_inflight.get(victim, 0):
+                slot = self._adapters.pop(victim)
+                self._adapter_lru.remove(victim)
+                self._adapter_inflight.pop(victim, None)
+                self.metrics.inc("lora_adapter_evictions")
+                self.metrics.inc_labeled("lora_adapter_evictions",
+                                         {"adapter": victim})
+                return slot
+        raise RuntimeError(
+            f"all {self.lora_slots} adapter slots hold adapters with "
+            "requests in flight — raise lora_slots or drain first "
+            f"(inflight: { {k: v for k, v in self._adapter_inflight.items() if v} })"
+        )
+
+    def load_adapter(self, name, weights, alpha=None):
+        """Load (or replace) a named LoRA adapter into a device slot so
+        requests can decode through it (``add_request(adapter=name)``).
+        `weights` maps target op names to ``(A [L, in, r], B [L, r, out])``
+        host arrays with ``r <= lora_rank`` (`models.lora.pack_adapter`
+        validates; `alpha` folds the conventional ``alpha/r`` scale into
+        B at load time). Slots are bounded: when all ``lora_slots`` are
+        taken, the least-recently-used adapter with NO requests in flight
+        is evicted; if every adapter is busy this raises. The table
+        update is functional and the new tree is swapped in with one
+        rebind — in-flight steps keep reading the tree they captured.
+        Returns the device slot index."""
+        if not self.lora_slots:
+            raise RuntimeError(
+                "engine built without LoRA slots (lora_slots=0)")
+        from ..models import lora as lora_mod
+
+        name = str(name)[:64]
+        packed = lora_mod.pack_adapter(self.model.cfg, weights,
+                                       self.lora_rank, self.lora_targets,
+                                       alpha=alpha)
+        slot = self._find_adapter_slot(name)
+        self._lora_tables = lora_mod.write_slot(self._lora_tables, slot,
+                                                packed)
+        self._adapters[name] = slot
+        self._adapter_inflight.setdefault(name, 0)
+        self._touch_adapter(name)
+        self.metrics.set_gauge("lora_adapters_loaded", len(self._adapters))
+        return slot
+
+    def unload_adapter(self, name):
+        """Free a named adapter's slot. Refuses while any request on it
+        is still in flight (their gathered rows index this slot — zeroing
+        it mid-decode would silently serve base-model tokens). The freed
+        slot is zeroed so no stale weights linger."""
+        if name not in self._adapters:
+            raise ValueError(f"unknown adapter {name!r} "
+                             f"(loaded: {sorted(self._adapters)})")
+        n = self._adapter_inflight.get(name, 0)
+        if n:
+            raise RuntimeError(
+                f"adapter {name!r} has {n} request(s) in flight — drain "
+                "or abort them before unloading")
+        from ..models import lora as lora_mod
+
+        slot = self._adapters.pop(name)
+        self._adapter_inflight.pop(name, None)
+        try:
+            self._adapter_lru.remove(name)
+        except ValueError:
+            pass
+        self._lora_tables = lora_mod.zero_slot(self._lora_tables, slot)
+        self.metrics.set_gauge("lora_adapters_loaded", len(self._adapters))
+
     def validate(self, req):
         """Admission-time request validation, shared by `add` and the async
         frontend's `submit` (which must reject bad requests BEFORE they
@@ -860,6 +987,18 @@ class LLMEngine:
         whole serve instead of the one offender. Returns the request's
         worst-case KV block need (the frontend's ``max_kv_commit_blocks``
         gate reuses it — ONE definition of worst case)."""
+        if req.adapter is not None:
+            if not self.lora_slots:
+                raise ValueError(
+                    f"request {req.request_id}: adapter {req.adapter!r} "
+                    "on an engine built without LoRA slots (lora_slots=0)"
+                )
+            if req.adapter not in self._adapters:
+                raise ValueError(
+                    f"request {req.request_id}: unknown adapter "
+                    f"{req.adapter!r} — load_adapter() it first "
+                    f"(loaded: {sorted(self._adapters)})"
+                )
         if req.num_tokens + req.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"request {req.request_id}: prompt {req.num_tokens} + "
@@ -883,11 +1022,26 @@ class LLMEngine:
         self.validate(req)
         if req.request_id in self._requests:
             raise ValueError(f"duplicate request id {req.request_id}")
+        if req.adapter is not None:
+            # validate() above guarantees the adapter is loaded; pin its
+            # slot for the request's whole lifetime (across preemptions —
+            # replayed KV must go through the same adapter) and hold the
+            # slot against LRU eviction while any request is in flight
+            req.adapter_slot = self._adapters[req.adapter]
+            self._adapter_inflight[req.adapter] = (
+                self._adapter_inflight.get(req.adapter, 0) + 1)
+            self._touch_adapter(req.adapter)
+            self.metrics.inc("lora_requests")
+            self.metrics.inc_labeled("lora_requests",
+                                     {"adapter": req.adapter})
         if self.prefix_cache and not req.block_hashes:
             # chained once per request; the scheduler reuses them for every
-            # admission (including post-preemption re-admissions)
+            # admission (including post-preemption re-admissions). The
+            # adapter name salts the chain: KV is computed THROUGH the
+            # adapter, so the same prompt under different adapters must
+            # never share cached blocks
             req.block_hashes = chain_block_hashes(
-                req.prompt_ids, self.block_size
+                req.prompt_ids, self.block_size, salt=req.adapter
             )
         self._requests[req.request_id] = req
         if self.slo is not None:
@@ -994,10 +1148,12 @@ class LLMEngine:
         quantized = self.pool.quantized
         quant_ops = self.quant_collectives
 
-        def forward(params, buffers, k_arena, v_arena, ids, block_tables,
-                    slots, offs, qpos, q_start, kv_live, q_lens,
-                    k_scale=None, v_scale=None, touched=None,
-                    touch_idx=None):
+        from ..models.lora import gather_adapter_rows
+
+        def forward(params, buffers, k_arena, v_arena, lora_tables,
+                    adapter_slots, ids, block_tables, slots, offs, qpos,
+                    q_start, kv_live, q_lens, k_scale=None, v_scale=None,
+                    touched=None, touch_idx=None):
             # runs at TRACE time only — the test's recompile alarm
             metrics.inc("jit_traces")
             state = PagedState(k_arena, v_arena, block_tables, slots, offs,
@@ -1006,7 +1162,13 @@ class LLMEngine:
                                mesh=None if smesh is None else smesh.mesh,
                                k_scale=k_scale, v_scale=v_scale,
                                touched=touched, touch_idx=touch_idx,
-                               quant_collectives=quant_ops)
+                               quant_collectives=quant_ops,
+                               # per-lane adapter rows gathered INSIDE the
+                               # program (models/lora.py) — None when the
+                               # engine has no adapter slots, keeping the
+                               # trace byte-identical to the pre-LoRA one
+                               lora=gather_adapter_rows(lora_tables,
+                                                        adapter_slots))
             # mask the process-global TRAINING mesh for the trace (thread-
             # local — a concurrent training trace on another thread keeps
             # its mesh): the serving step's sharding is fully explicit
@@ -1074,13 +1236,14 @@ class LLMEngine:
             # ONE kv_dtype switch, same (B, W) keying, kinds still don't
             # key programs
             def step(params, buffers, k_arena, v_arena, k_scale, v_scale,
-                     ids, block_tables, slots, offs, qpos, q_start,
-                     kv_live, touched, touch_idx, last_idx, spec_lens,
-                     temps, top_ks, top_ps, key):
+                     lora_tables, ids, block_tables, slots, offs, qpos,
+                     q_start, kv_live, touched, touch_idx, adapter_slots,
+                     last_idx, spec_lens, temps, top_ks, top_ps, key):
                 q_lens = last_idx + 1 + spec_lens
                 logits, state = forward(
-                    params, buffers, k_arena, v_arena, ids, block_tables,
-                    slots, offs, qpos, q_start, kv_live, q_lens,
+                    params, buffers, k_arena, v_arena, lora_tables,
+                    adapter_slots, ids, block_tables, slots, offs, qpos,
+                    q_start, kv_live, q_lens,
                     k_scale=k_scale, v_scale=v_scale, touched=touched,
                     touch_idx=touch_idx)
                 packed = _decide(logits, state, ids, last_idx, spec_lens,
@@ -1088,15 +1251,17 @@ class LLMEngine:
                 return (packed, state.k, state.v, state.k_scale,
                         state.v_scale)
         else:
-            def step(params, buffers, k_arena, v_arena, ids, block_tables,
-                     slots, offs, qpos, q_start, kv_live, last_idx,
-                     spec_lens, temps, top_ks, top_ps, key):
+            def step(params, buffers, k_arena, v_arena, lora_tables, ids,
+                     block_tables, slots, offs, qpos, q_start, kv_live,
+                     adapter_slots, last_idx, spec_lens, temps, top_ks,
+                     top_ps, key):
                 # per-row live width for the ragged kernel: chunk tokens
                 # through last_idx plus the drafted candidates
                 q_lens = last_idx + 1 + spec_lens
                 logits, state = forward(params, buffers, k_arena, v_arena,
-                                        ids, block_tables, slots, offs,
-                                        qpos, q_start, kv_live, q_lens)
+                                        lora_tables, adapter_slots, ids,
+                                        block_tables, slots, offs, qpos,
+                                        q_start, kv_live, q_lens)
                 packed = _decide(logits, state, ids, last_idx, spec_lens,
                                  temps, top_ks, top_ps, key)
                 return packed, state.k, state.v
@@ -1120,11 +1285,12 @@ class LLMEngine:
             rep = smesh.replicated()
             arena = smesh.arena_sharding()
             n_arena = len(arena_args)
-            # ids..top_ps marshalling + PRNG key (+ touched/touch_idx
-            # when quantized)
-            host_in = (rep,) * (15 if quantized else 13)
+            # ids..top_ps marshalling + adapter_slots + PRNG key
+            # (+ touched/touch_idx when quantized)
+            host_in = (rep,) * (16 if quantized else 14)
             in_sh = (self._param_shardings, self._buffer_shardings,
-                     ) + (arena,) * n_arena + host_in
+                     ) + (arena,) * n_arena + (self._lora_shardings,
+                     ) + host_in
             out_sh = (rep,) + (arena,) * n_arena
             fn = jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                          donate_argnums=mesh_donate_argnums(arena_args))
@@ -1211,8 +1377,10 @@ class LLMEngine:
                            h((B, W)))                       # touch_idx
                 lowered[name] = fn.lower(
                     self._params, self._buffers, *arenas,
+                    self._lora_tables,
                     h((B, W)), h((B, self.max_blocks)), h((B, W)), h((B, W)),
                     h((B, W)), h((B,)), h((B,)), *mid,
+                    h((B,)),                      # adapter_slots
                     h((B,)),                      # last_idx
                     h((B,)),                      # spec_lens
                     h((B,), jnp.float32), h((B,)), h((B,), jnp.float32),
@@ -1358,11 +1526,12 @@ class LLMEngine:
             arenas += (pool.k_scale, pool.v_scale)
             mid = (jnp.asarray(a["touched"]), jnp.asarray(a["touch_idx"]))
         args = (
-            self._params, self._buffers, *arenas,
+            self._params, self._buffers, *arenas, self._lora_tables,
             jnp.asarray(a["ids"]), jnp.asarray(a["tables"]),
             jnp.asarray(a["slots"]), jnp.asarray(a["offs"]),
             jnp.asarray(a["qpos"]), jnp.asarray(a["q_start"]),
-            jnp.asarray(a["kv_live"]), *mid, jnp.asarray(last_idx),
+            jnp.asarray(a["kv_live"]), *mid,
+            jnp.asarray(a["adapter_slots"]), jnp.asarray(last_idx),
             jnp.asarray(spec_lens), jnp.asarray(a["temps"]),
             jnp.asarray(a["top_ks"]), jnp.asarray(a["top_ps"]), sub,
         )
@@ -1467,6 +1636,19 @@ class LLMEngine:
         # catch-up-flipping bystanders
         self.last_planned = []
         rows = self.scheduler.schedule(only=only)
+        if self.policy is not None:
+            # deadline early-rejects decided during admission: surface
+            # each as an aborted request on the step_faults channel (the
+            # supervisor relays faults as failures, so frontend streams
+            # get a terminal "error" event with the policy reason) —
+            # drained BEFORE the empty-plan early return so a step whose
+            # only outcome was rejection still finalizes its victims
+            for req, reason in self.scheduler.drain_policy_rejects():
+                self.metrics.inc("policy_early_rejections")
+                self.metrics.inc_labeled("policy_early_rejections",
+                                         self.policy.class_labels(req))
+                self.step_faults.append((req.request_id, reason))
+                self.abort(req.request_id, reason=reason)
         if self.tier is not None:
             # arena-write ordering (kv_tier.py rule 1): demotions buffered
             # by this plan's evictions must gather their bytes before the
@@ -1492,8 +1674,11 @@ class LLMEngine:
         step_id = tr.next_step_id() if tr is not None else 0
         if tr is not None:
             self._phases = {"plan": (t_plan0, time.monotonic())}
+        t_step0 = time.monotonic()
         with self.metrics.timed(f"{kind}_step"):
             outs = self._run_rows(rows, W, step_id)
+        if self.policy is not None:
+            self.policy.observe_step(time.monotonic() - t_step0)
         if tr is not None:
             tr.record_step(step_id, kind, self._phases, {
                 "rows": len(rows),
@@ -1517,6 +1702,21 @@ class LLMEngine:
         )
         self.metrics.set_gauge("num_running", len(self.scheduler.running))
         self.metrics.set_gauge("num_waiting", len(self.scheduler.waiting))
+        if self.policy is not None:
+            # whole-family replacement: classes whose queue drained (or
+            # tenants whose window emptied) drop off the scrape instead
+            # of freezing at their last value
+            depth = {}
+            for req in self.scheduler.waiting:
+                lbl = tuple(sorted(self.policy.class_labels(req).items()))
+                depth[lbl] = depth.get(lbl, 0) + 1
+            self.metrics.set_labeled_gauges(
+                "policy_queue_depth",
+                [(dict(lbl), n) for lbl, n in depth.items()])
+            self.metrics.set_labeled_gauges(
+                "policy_served_share",
+                [({"tenant": t}, s)
+                 for t, s in self.policy.served_shares().items()])
         c = self.metrics.counters
         # recompile sentinel: steady state means jit_traces == compiled
         # programs (each width bucket's program traces exactly once, and
@@ -1587,6 +1787,8 @@ class LLMEngine:
             "q_start": np.zeros(B, np.int32),
             # idle lanes walk just the null block
             "kv_live": np.ones(B, np.int32),
+            # idle/pad lanes read the all-zeros base slot 0
+            "adapter_slots": np.zeros(B, np.int32),
             **({
                 # int8 arena: per-row touched-block list (slot 0 = the
                 # null block, so zeroed rows are inert) + each token's
@@ -1612,6 +1814,7 @@ class LLMEngine:
         a["top_ps"][i] = 1.0 if req.top_p is None else req.top_p
         a["q_start"][i] = start
         a["kv_live"][i] = (start + w - 1) // self.block_size + 1
+        a["adapter_slots"][i] = req.adapter_slot
         if self.pool.quantized:
             # unique non-null blocks this row's scatter writes, listed
             # after the null slot; invalid/pad tokens keep touch_idx 0
@@ -1689,6 +1892,10 @@ class LLMEngine:
             # request, and release publishes full prompt blocks off
             # num_cached)
             req.num_cached += row.count + n_acc
+            if self.policy is not None:
+                # fairness accounting charges device work actually
+                # consumed: fed chunk tokens + accepted drafts
+                self.policy.note_served(req, row.count + n_acc)
             if tr is not None and req.traced:
                 tr.row_span(
                     req,
@@ -1750,6 +1957,12 @@ class LLMEngine:
         clock (rollups + histograms), and emit the one-line JSON summary
         log / feed the flight recorder's tail ring. All no-ops in the
         default configuration."""
+        if req.adapter is not None and self._adapter_inflight:
+            # adapter pin released on ANY terminal path (finish, abort,
+            # policy reject) — unload/LRU only evicts zero-inflight slots
+            n = self._adapter_inflight.get(req.adapter, 0)
+            if n > 0:
+                self._adapter_inflight[req.adapter] = n - 1
         if req.traced:
             self.tracer.end_request(req, reason)
         if self.slo is None:
@@ -1765,6 +1978,9 @@ class LLMEngine:
             "reason": reason,
             "tenant": req.tenant,
             "priority": req.priority,
+            "adapter": req.adapter,
+            "policy_reject": (reason if reason.startswith("policy_reject")
+                              else None),
             "deadline_s": req.deadline_s,
             "deadline": summary["deadline"],
             "prompt_tokens": len(req.prompt_ids),
@@ -1807,6 +2023,20 @@ class LLMEngine:
             # dict, so /healthz "pool" and the /metrics pool_* gauges can
             # never disagree (they both render exactly this)
             stats.update(self.tier.stats())
+        if self.policy is not None:
+            # dict-valued: the server's numeric-only pool_* gauge filter
+            # skips it, /healthz renders it verbatim
+            stats["policy"] = self.policy.snapshot(
+                waiting=self.scheduler.waiting,
+                running=self.scheduler.running)
+        if self.lora_slots:
+            stats["lora"] = {
+                "slots": self.lora_slots,
+                "rank": self.lora_rank,
+                "loaded": sorted(self._adapters),
+                "inflight": {k: v for k, v in
+                             self._adapter_inflight.items() if v},
+            }
         return stats
 
     # -- host-tier migration (serving/router.py drain/eject hooks) ---------

@@ -405,7 +405,7 @@ class AsyncLLMEngine:
                eos_token_id=None, timeout_s=None, request_id=None,
                top_k=None, top_p=None, spec_decoding=None,
                num_spec_tokens=None, trace=None, tenant=None,
-               priority=None):
+               priority=None, adapter=None):
         """Admit one request; returns its RequestStream. Raises
         EngineClosedError when draining/stopped, EngineOverloadedError when
         the bounded wait queue is full, ValueError on a bad request —
@@ -416,7 +416,8 @@ class AsyncLLMEngine:
         engine's lifecycle tracer regardless of its sampling fraction;
         `tenant`/`priority` label the request's SLO accounting class
         (serving/slo.py) and the effective ``timeout_s`` becomes its
-        deadline-attainment target."""
+        deadline-attainment target; `adapter` names a loaded LoRA
+        adapter to decode through (engine.load_adapter)."""
         from .scheduler import Request
 
         if not self.health.healthy:
@@ -457,7 +458,7 @@ class AsyncLLMEngine:
                       request_id=request_id, top_k=top_k, top_p=top_p,
                       spec_decoding=spec_decoding,
                       num_spec_tokens=num_spec_tokens, trace=trace,
-                      tenant=tenant, priority=priority,
+                      tenant=tenant, priority=priority, adapter=adapter,
                       # the enforced timeout IS the SLO deadline: the
                       # ledger judges met/missed against what the serve
                       # actually promised
@@ -487,7 +488,7 @@ class AsyncLLMEngine:
             from .block_pool import chain_block_hashes
 
             req.block_hashes = chain_block_hashes(
-                req.prompt_ids, self.engine.block_size
+                req.prompt_ids, self.engine.block_size, salt=req.adapter
             )
         if req.request_id in self._streams:
             raise ValueError(f"duplicate request id {req.request_id}")

@@ -11,9 +11,11 @@ read them without adapters:
 - `prometheus_text()` — Prometheus text exposition for the HTTP frontend's
   `/metrics` endpoint (serving/server.py): counters, gauges, duration
   summaries with p50/p95 quantiles, plus LABELED families — `inc_labeled`
-  counters and `observe_hist` true cumulative histograms (ordered ``le``
-  buckets ending ``+Inf`` with ``_sum``/``_count``), which the SLO ledger
-  (serving/slo.py) uses for its per-tenant/priority-class series;
+  counters, `set_labeled_gauges` gauge families (the scheduling policy's
+  per-class queue depths and tenant shares), and `observe_hist` true
+  cumulative histograms (ordered ``le`` buckets ending ``+Inf`` with
+  ``_sum``/``_count``), which the SLO ledger (serving/slo.py) uses for
+  its per-tenant/priority-class series;
 - direct attribute access for tests (`metrics.counters["preemptions"]`).
 
 Counters and gauges are open-ended (a `defaultdict` — every series any
@@ -261,6 +263,23 @@ _HELP = {
     "router_inflight": "Requests in flight across the whole fleet",
     "router_prefix_cache_hit_rate": "Fleet-aggregate prefix-cache "
                                     "hit/lookup ratio across replicas",
+    "policy_queue_depth": "Requests waiting for a lane, by tenant/"
+                          "priority class (scheduling policy)",
+    "policy_served_share": "Windowed served-token share, by tenant "
+                           "(scheduling policy fairness window)",
+    "policy_preemptions": "Sequences preempted by the scheduling "
+                          "policy's fairness victim rule, by the "
+                          "victim's tenant/priority class",
+    "policy_early_rejections": "Requests rejected at lane admission "
+                               "because their predicted completion "
+                               "overshot the remaining deadline, by "
+                               "tenant/priority class",
+    "lora_adapters_loaded": "LoRA adapters resident in the engine's "
+                            "slot table",
+    "lora_adapter_evictions": "LoRA adapters LRU-evicted to make room "
+                              "for a load_adapter",
+    "lora_requests": "Requests served with a non-base LoRA adapter, "
+                     "by adapter",
 }
 
 
@@ -290,6 +309,11 @@ class ServingMetrics:
         self._hist = {}
         # name -> {label_tuple: float}
         self._labeled = defaultdict(lambda: defaultdict(float))
+        # labeled GAUGE families (the scheduling policy's per-class
+        # queue depths / shares): name -> {label_tuple: float},
+        # replaced wholesale per update so vanished classes drop out
+        # instead of lingering at their last value
+        self._labeled_gauges = {}
         # serializes family writes against scrape/snapshot copies: a
         # histogram's bucket counts and _sum must come from ONE moment
         # (unlike the plain counters, where a torn read is a benign
@@ -333,6 +357,16 @@ class ServingMetrics:
 
     def set_gauge(self, name, value):
         self.gauges[name] = value
+
+    def set_labeled_gauges(self, name, series):
+        """Replace one LABELED gauge family atomically: `series` is an
+        iterable of ``(labels_dict, value)``. Whole-family replacement
+        (not per-series set) so a class that emptied since the last
+        update disappears from the scrape instead of reporting its
+        stale depth forever. Callers own label cardinality."""
+        fam = {_label_tuple(labels): float(v) for labels, v in series}
+        with self._families_lock:
+            self._labeled_gauges[name] = fam
 
     def set_info(self, name, labels):
         """Record an info-style series: constant value 1 with string
@@ -401,6 +435,12 @@ class ServingMetrics:
                            for lt, v in sorted(series.items())]
                     for name, series in self._labeled.items()
                 }
+            if self._labeled_gauges:
+                out["labeled_gauges"] = {
+                    name: [{"labels": dict(lt), "value": v}
+                           for lt, v in sorted(series.items())]
+                    for name, series in self._labeled_gauges.items()
+                }
             if self._hist:
                 out["histograms"] = {
                     name: {
@@ -443,6 +483,8 @@ class ServingMetrics:
         counters = dict(self.counters)
         with self._families_lock:
             labeled = {n: dict(v) for n, v in self._labeled.items()}
+            labeled_g = {n: dict(v)
+                         for n, v in self._labeled_gauges.items()}
             hists = {n: {"buckets": h["buckets"],
                          "series": {lt: {"counts": list(s["counts"]),
                                          "sum": s["sum"]}
@@ -464,6 +506,12 @@ class ServingMetrics:
             m = _n(name)
             _header(m, name, "gauge")
             lines.append(f"{m} {float(gauges[name]):g}")
+        for name in sorted(labeled_g):
+            m = _n(name)
+            _header(m, name, "gauge")
+            for lt in sorted(labeled_g[name]):
+                lines.append(f"{m}{{{_label_body(lt)}}} "
+                             f"{labeled_g[name][lt]:g}")
         for name in sorted(dict(self.infos)):
             labels = self.infos[name]
             m = _n(name) + "_info"

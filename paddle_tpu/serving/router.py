@@ -317,23 +317,27 @@ class ReplicaRouter:
 
     # -- routing -----------------------------------------------------------
 
-    def affinity_key(self, prompt_ids):
-        """This prompt's affinity key (a chained block hash,
+    def affinity_key(self, prompt_ids, adapter=None):
+        """This request's affinity key (a chained block hash,
         ``affinity_prefix_blocks`` deep) or None for cache-cold prompts
-        shorter than one full block."""
-        hashes = chain_block_hashes(prompt_ids, self._block_size)
+        shorter than one full block. Keyed by ``(adapter, prefix)`` via
+        the same hash salt the replicas' prefix caches use: the same
+        prompt under two adapters caches DIFFERENT KV blocks, so homing
+        them together would warm nothing."""
+        hashes = chain_block_hashes(prompt_ids, self._block_size,
+                                    salt=adapter)
         if not hashes:
             return None
         return hashes[min(self.affinity_prefix_blocks, len(hashes)) - 1]
 
-    def home_replica(self, prompt_ids):
-        """The replica name this prompt would route to right now (None
+    def home_replica(self, prompt_ids, adapter=None):
+        """The replica name this request would route to right now (None
         when nothing is eligible) — debugging/test surface."""
         now = time.monotonic()
         elig = self._eligible(set(), now)
         if not elig:
             return None
-        key = self.affinity_key(prompt_ids)
+        key = self.affinity_key(prompt_ids, adapter)
         if self.affinity and key is not None:
             return self._rendezvous(key, elig).name
         return self._least_loaded(elig).name
@@ -489,7 +493,7 @@ class ReplicaRouter:
                      eos_token_id=None, deadline_s=None, timeout_s=None,
                      request_id=None, top_k=None, top_p=None,
                      spec_decoding=None, num_spec_tokens=None, trace=None,
-                     tenant=None, priority=None):
+                     tenant=None, priority=None, adapter=None):
         """Route one request; returns its `RoutedStream` after the first
         successful replica admission. Raises `EngineOverloadedError`
         (all replicas overloaded past the retry budget, or
@@ -499,7 +503,9 @@ class ReplicaRouter:
         HTTP layer maps errors identically. ``deadline_s`` (alias
         ``timeout_s``) is end-to-end across hops: a replayed request
         carries only its REMAINING deadline. ``tenant``/``priority``
-        stamp through to the serving replica unchanged."""
+        stamp through to the serving replica unchanged; ``adapter``
+        names a LoRA adapter loaded on the replicas and keys prefix
+        affinity alongside the prompt."""
         if not self._started:
             raise RuntimeError("ReplicaRouter.start() has not been awaited")
         if self._closed:
@@ -516,9 +522,10 @@ class ReplicaRouter:
                  eos_token_id=eos_token_id, request_id=request_id,
                  top_k=top_k, top_p=top_p, spec_decoding=spec_decoding,
                  num_spec_tokens=num_spec_tokens, trace=trace,
-                 tenant=tenant, priority=priority),
+                 tenant=tenant, priority=priority, adapter=adapter),
             deadline_s,
-            self.affinity_key(prompt_ids) if self.affinity else None,
+            (self.affinity_key(prompt_ids, adapter)
+             if self.affinity else None),
             time.monotonic(),
         )
         self.metrics.inc("router_requests")

@@ -28,6 +28,13 @@ later chunks rebuild the KV. A row with no younger victim defers a step;
 the OLDEST sequence failing to grow means the pool cannot hold even one
 sequence, which fails loudly as a config error.
 
+A **scheduling policy** (serving/policy.py, ``policy=``) replaces all
+three FCFS derivations — admission order, planning order, preemption
+victim — with its (priority class, tenant fairness, arrival) precedence,
+and may early-reject a deadline-doomed request at lane admission. With no
+policy (the default) every code path above is byte-identical to the FCFS
+scheduler.
+
 **Prefix caching** hooks in at exactly three seams:
 
 - at admission, a request's precomputed ``block_hashes`` (engine-computed,
@@ -93,7 +100,8 @@ class Request:
     def __init__(self, prompt_ids, max_new_tokens=16, temperature=0.0,
                  eos_token_id=None, request_id=None, top_k=None, top_p=None,
                  spec_decoding=None, num_spec_tokens=None, trace=None,
-                 tenant=None, priority=None, deadline_s=None):
+                 tenant=None, priority=None, deadline_s=None,
+                 adapter=None):
         self.request_id = (
             request_id if request_id is not None else next(_rid_counter)
         )
@@ -147,6 +155,12 @@ class Request:
         # is bounded by the ledger's max_classes fold).
         self.tenant = None if tenant is None else str(tenant)[:64]
         self.priority = None if priority is None else str(priority)[:64]
+        # LoRA adapter name (models/lora.py): None = the shared base
+        # model. The engine resolves it to a device slot at add();
+        # truncated like the class labels (it rides metrics/log lines).
+        self.adapter = None if adapter is None else str(adapter)[:64]
+        # device table row the engine resolved `adapter` to (0 = base)
+        self.adapter_slot = 0
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         if self.deadline_s is not None and self.deadline_s <= 0:
             raise ValueError("deadline_s must be > 0 (or None)")
@@ -201,7 +215,7 @@ class Scheduler:
     def __init__(self, pool, max_batch=8, token_budget=2048,
                  prefill_chunk=None, prefill_interval=None, metrics=None,
                  prefix_cache=True, drafter=None, tracer=None, slo=None,
-                 width_buckets=None):
+                 width_buckets=None, policy=None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.token_budget = int(token_budget)
@@ -239,8 +253,32 @@ class Scheduler:
         # value.
         self.width_buckets = (sorted(int(w) for w in width_buckets)
                               if width_buckets else None)
+        # scheduling policy (serving/policy.py SchedulingPolicy) or None.
+        # None keeps the FCFS scheduler byte-identical; a policy replaces
+        # the admission order, the planning order, and the preemption
+        # victim rule with its precedence/fairness derivations, and may
+        # early-reject deadline-doomed requests at lane admission
+        # (collected in `policy_rejects`; the engine drains and aborts
+        # them with a structured reason after each plan).
+        self.policy = policy
+        self.policy_rejects = []
         self.waiting = deque()
         self.running = []
+
+    def _precedence(self, req):
+        """The planning/preemption total order: the policy's
+        (priority rank, arrival age) when one is installed, raw FCFS
+        arrival age otherwise. Smaller is stronger."""
+        if self.policy is not None:
+            return self.policy.precedence(req)
+        return (0, req.arrival_seq)
+
+    def drain_policy_rejects(self):
+        """The (req, reason) pairs the last `schedule()` early-rejected
+        at lane admission — the engine aborts each with the structured
+        reason so consumers get a terminal event."""
+        out, self.policy_rejects = self.policy_rejects, []
+        return out
 
     def _bucket(self, w):
         """Smallest ragged width bucket covering `w` (identity with no
@@ -402,24 +440,38 @@ class Scheduler:
         return blocks
 
     def _take_block(self, req):
-        """One block for `req`, preempting arrival-YOUNGER sequences (FCFS
+        """One block for `req`, preempting strictly WEAKER sequences when
+        the pool is dry. Without a policy, weaker = arrival-younger (FCFS
         priority: an older request may reclaim a younger one's blocks,
         never the reverse — age survives preemption/re-admission via
-        `arrival_seq`) when the pool is dry. Returns the block id, or None
-        if the row must be deferred a step instead."""
+        `arrival_seq`). With a policy, weaker = strictly lower
+        (priority rank, arrival) precedence, and the victim among the
+        eligible is the one whose tenant consumed the most windowed
+        tokens (serving/policy.py `select_victim`) instead of the blind
+        youngest. Returns the block id, or None if the row must be
+        deferred a step instead."""
         while True:
             got = self.pool.allocate(1)
             if got is not None:
                 return got[0]
-            victim = max(
-                (r for r in self.running
-                 if r.arrival_seq > req.arrival_seq and r.blocks),
-                key=lambda r: r.arrival_seq, default=None,
-            )
+            if self.policy is not None:
+                victim = self.policy.select_victim(self.running, req)
+                if victim is not None:
+                    self.policy.policy_preemptions += 1
+                    if self.metrics is not None:
+                        self.metrics.inc_labeled(
+                            "policy_preemptions",
+                            self.policy.class_labels(victim))
+            else:
+                victim = max(
+                    (r for r in self.running
+                     if r.arrival_seq > req.arrival_seq and r.blocks),
+                    key=lambda r: r.arrival_seq, default=None,
+                )
             if victim is not None:
                 self._preempt(victim)
                 continue
-            if not any(r.arrival_seq < req.arrival_seq
+            if not any(self._precedence(r) < self._precedence(req)
                        for r in self.running):
                 # the oldest sequence holds every allocated block and still
                 # cannot grow: the pool cannot hold even one sequence — a
@@ -508,8 +560,28 @@ class Scheduler:
         the supervisor's bisection probes step a suspect subset while
         every other sequence holds its state untouched."""
         if only is None:
-            while self.waiting and len(self.running) < self.max_batch:
-                self._admit(self.waiting.popleft())
+            if self.policy is None:
+                while self.waiting and len(self.running) < self.max_batch:
+                    self._admit(self.waiting.popleft())
+            else:
+                # policy admission: the next lane goes to the strongest
+                # class, least-consuming tenant within it, oldest within
+                # that (serving/policy.py admission_key) — and a request
+                # whose deadline is already unattainable is rejected
+                # HERE, before it occupies the lane (the engine drains
+                # `policy_rejects` and aborts each with the structured
+                # reason)
+                now = time.monotonic()
+                while self.waiting and len(self.running) < self.max_batch:
+                    req = min(self.waiting,
+                              key=lambda r: self.policy.admission_key(r, now))
+                    self.waiting.remove(req)
+                    reason = self.policy.early_reject(
+                        req, self.prefill_chunk, now)
+                    if reason is not None:
+                        self.policy_rejects.append((req, reason))
+                        continue
+                    self._admit(req)
         else:
             # probe admission: pull ONLY the probed ids out of the queue,
             # preserving everyone else's position and FCFS order
@@ -521,10 +593,11 @@ class Scheduler:
 
         budget = self.token_budget
         rows = []
-        # plan in arrival order: the oldest request gets first claim on the
-        # budget and on pool blocks (it can preempt any younger holder, so
-        # it always schedules or fails loudly — the no-livelock guarantee)
-        for req in sorted(self.running, key=lambda r: r.arrival_seq):
+        # plan in precedence order (arrival order without a policy): the
+        # strongest request gets first claim on the budget and on pool
+        # blocks (it can preempt any weaker holder, so it always
+        # schedules or fails loudly — the no-livelock guarantee)
+        for req in sorted(self.running, key=self._precedence):
             if req not in self.running:
                 continue  # preempted while an earlier row grew its blocks
             if only is not None and req.request_id not in only:

@@ -166,6 +166,11 @@ def _parse_completion_spec(body):
     kw["tenant"] = None if tenant is None else str(tenant)
     priority = spec.get("priority")
     kw["priority"] = None if priority is None else str(priority)
+    # LoRA adapter selector: the request decodes through this loaded
+    # adapter (engine.load_adapter); unknown names are 400s via
+    # validate()'s ValueError before the request reaches the engine
+    adapter = spec.get("adapter")
+    kw["adapter"] = None if adapter is None else str(adapter)
     return kw, bool(spec.get("stream", False))
 
 

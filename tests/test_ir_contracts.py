@@ -68,6 +68,8 @@ def test_program_set_covers_the_registry(artifacts):
     want |= {f"serve_int8/tp{tp}/w1" for tp in (1, 2)}
     want |= {f"serve_int8/tp{tp}/{name}"
              for tp in (1, 2) for name in eng.swap_program_shapes()}
+    # the LoRA family: w1 decode with 2 adapter slots gathered in-step
+    want |= {f"serve_lora/tp{tp}/w1" for tp in (1, 2)}
     # the train/* family: legacy dp2 x mp2, the locked zs2-legacy
     # 'before', and the explicit weight-update matrix on dp4
     train_names = {"train/dp2_mp2", "train/dp2_mp2/zs2-legacy",
@@ -75,10 +77,11 @@ def test_program_set_covers_the_registry(artifacts):
                    "train/dp4/zs2_gm2", "train/dp4/zs2_q8"}
     want |= train_names
     # one artifact per ragged width bucket plus the host-tier swap pair
-    # (x2 for the int8 family's w1 + swaps) — the engine helpers are the
-    # ONE place the program-count contract lives
+    # (x2 for the int8 family's w1 + swaps, +2 for serve_lora's w1) —
+    # the engine helpers are the ONE place the program-count contract
+    # lives
     assert len(want) == (2 * eng.expected_program_count()
-                         + 4 * len(eng.swap_program_shapes()) + 2
+                         + 4 * len(eng.swap_program_shapes()) + 2 + 2
                          + len(train_names))
     assert names == want, names
 
@@ -123,6 +126,29 @@ def test_int8_tp2_collectives_match_the_quantized_budget(artifacts):
     assert q.collectives["all-gather"] == 2 * 2 * 2 + 1
     # single-chip int8: no collectives at all, like the f32 family
     assert not any(by_name["serve_int8/tp1/w1"].collectives.values())
+
+
+def test_lora_family_adds_zero_collectives(artifacts):
+    """The serve_lora IR001 pin: the in-step adapter gather must add NO
+    collectives at any tp degree — A tables replicate, B tables shard on
+    the already-tp-sharded output axis, and the per-row gather + two
+    rank-r matmuls are chip-local. The budget is therefore the SAME
+    arithmetic `serving_collective_budget` as the base family; a LoRA
+    refactor that starts re-gathering adapter shards (or all-reducing
+    the delta separately from the base projection) busts IR001 here."""
+    by_name = {a.name: a for a in artifacts}
+    for tp in (1, 2):
+        base = by_name[f"serve/tp{tp}/w1"]
+        lora = by_name[f"serve_lora/tp{tp}/w1"]
+        assert lora.collectives == base.collectives, (tp, lora.collectives)
+        # the adapter gather is REAL work, not a no-op: IR004 locks the
+        # flops/bytes delta via serve_lora's own baseline entries
+        assert lora.facts["flops"] > base.facts["flops"], tp
+        assert (lora.facts["bytes_accessed"]
+                > base.facts["bytes_accessed"]), tp
+    assert not any(by_name["serve_lora/tp1/w1"].collectives.values())
+    assert by_name["serve_lora/tp2/w1"].collectives == (
+        serving_collective_budget(ir.tiny_gpt_config(), 2))
 
 
 def test_int8_step_reads_fewer_bytes(artifacts):
@@ -239,6 +265,49 @@ def test_silently_disabled_equarx_gate_trips_the_quantized_budget(
     assert violations, "a disabled EQuARX gate must blow the budget"
     msg = violations[0].format()
     assert "IR001" in msg and "collective-budget" in msg, msg
+
+
+def test_hoisted_adapter_gather_trips_host_sync_hygiene(monkeypatch):
+    """The serve_lora seeded regression: an adapter gather hoisted out
+    of the compiled step onto the host (here: `gather_adapter_rows`
+    patched to a `jax.pure_callback` row lookup — the shape of a
+    refactor that 'simplifies' the per-row gather into a host-side
+    table read) reintroduces a per-step device→host round trip. The
+    callback's custom-call lands at its use site, upstream of the
+    LM-head matmul, so IR003's whole-program hygiene flags it (IR005's
+    sampler-tail check is the backstop had it landed after the head);
+    the message must name the callback target so the diff author sees
+    WHAT synced."""
+    import jax
+
+    from paddle_tpu.models import lora as lora_mod
+
+    def hoisted_gather(tables, slots):
+        if not tables:
+            return None
+        out = {}
+        for name, (A, B) in tables.items():
+            out[name] = tuple(
+                jax.pure_callback(
+                    lambda t, s: np.asarray(t)[np.asarray(s)],
+                    jax.ShapeDtypeStruct(
+                        (slots.shape[0],) + tab.shape[1:], tab.dtype),
+                    tab, slots, vmap_method="sequential")
+                for tab in (A, B))
+        return out
+
+    monkeypatch.setattr(lora_mod, "gather_adapter_rows", hoisted_gather)
+    arts = ir.serving_artifacts(tp_degrees=(1,), kinds=["w1"],
+                                lora_slots=2, prefix="serve_lora")
+    (art,) = arts
+    assert any(op.custom_call_target == "xla_python_cpu_callback"
+               for op in art.ops
+               if op.opcode.startswith("custom-call")), art.name
+    violations = contracts.evaluate(arts, select=["IR003", "IR005"])
+    assert violations, "a host-hoisted adapter gather must trip hygiene"
+    msg = violations[0].format()
+    assert "IR003" in msg and "host-sync-hygiene" in msg, msg
+    assert "xla_python_cpu_callback" in msg, msg
 
 
 # ---------------------------------------------------------------------------

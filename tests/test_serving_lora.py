@@ -1,0 +1,333 @@
+"""Many-adapter LoRA serving (models/lora.py + the engine registry):
+N per-request adapters over ONE shared base model, one compiled program
+per ragged width bucket.
+
+Contract pinned here:
+
+- TOKEN IDENTITY: a request on adapter X emits exactly what a dedicated
+  engine whose base weights have X merged in (``W + A@B``) emits — with
+  chunked prefill, speculative drafts, and prefix caching live — while
+  base requests on the SAME engine match a plain engine exactly;
+- ZERO retraces: which adapters a step mixes never keys a program —
+  ``jit_traces <= expected_program_count()`` and the count formula is
+  unchanged by ``lora_slots``;
+- bounded slots: load past capacity LRU-evicts only IDLE adapters,
+  unload refuses while requests are in flight, every slot transition
+  shows on /metrics (`lora_adapters_loaded`, `lora_adapter_evictions`);
+- KV is adapter-dependent: the prefix cache never shares blocks across
+  adapters (the chain-hash salt), and the router's affinity key is
+  ``(adapter, prefix)``;
+- the full stack threads ``adapter=``: engine, async frontend, the HTTP
+  body parser, and the fleet router.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import lora as lora_mod
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.serving import AsyncLLMEngine, LLMEngine
+from paddle_tpu.serving.block_pool import chain_block_hashes
+from paddle_tpu.serving.router import ReplicaRouter
+from paddle_tpu.serving.server import _parse_completion_spec
+
+CFG = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+           max_seq_len=64, attn_impl="xla", dropout=0.0)
+# spec decoding + prefix caching ON: adapter identity must survive the
+# full decode machinery, not just plain greedy steps
+ENG = dict(block_size=8, num_blocks=48, max_batch=4, spec_decoding=True,
+           prefix_cache=True)
+PROMPT = list(range(1, 11))
+
+
+def make_model():
+    """A fresh, bit-identical base model (merge_adapter_into mutates
+    weights in place, so reference engines each need their own copy)."""
+    paddle.seed(0)
+    return GPT(GPTConfig(**CFG)).eval()
+
+
+def _adapter(cfg, seed, rank=4, scale=0.5):
+    return lora_mod.random_adapter(cfg, rank, lora_mod.LORA_TARGETS,
+                                   seed=seed, scale=scale)
+
+
+def _drain(eng, max_steps=400):
+    toks = {}
+    for _ in range(max_steps):
+        for o in eng.step():
+            toks.setdefault(o.request_id, []).append(o.token)
+        if not eng.scheduler.running and not eng.scheduler.waiting:
+            break
+    assert not eng.scheduler.running and not eng.scheduler.waiting
+    return toks
+
+
+def _serve_one(eng, prompt=PROMPT, n=12, adapter=None):
+    rid = eng.add_request(prompt, max_new_tokens=n, adapter=adapter)
+    _drain(eng)
+    return eng.get_request(rid).output_ids
+
+
+# -- table/pack unit behavior ----------------------------------------------
+
+
+def test_adapter_tables_layout():
+    cfg = make_model().cfg
+    tables = lora_mod.init_adapter_tables(cfg, 3, 4)
+    assert set(tables) == set(lora_mod.LORA_TARGETS)
+    a, b = tables["attn_qkv"]
+    assert a.shape == (3, cfg.num_layers, cfg.hidden_size, 4)
+    assert b.shape == (3, cfg.num_layers, 4, 3 * cfg.hidden_size)
+    assert not np.asarray(a).any() and not np.asarray(b).any()
+
+    w = _adapter(cfg, seed=1, rank=2)     # narrower than the table rank
+    packed = lora_mod.pack_adapter(cfg, w, 4, lora_mod.LORA_TARGETS,
+                                   alpha=8)
+    pa, pb = packed["attn_qkv"]
+    # zero-padded up to rank 4; alpha/r' folded into B (8 / 2 == 4x)
+    assert pa.shape[-1] == 4 and pb.shape[1] == 4
+    assert not pa[..., 2:].any() and not pb[:, 2:].any()
+    np.testing.assert_allclose(pb[:, :2], w["attn_qkv"][1] * 4.0,
+                               rtol=1e-6)
+
+    tables = lora_mod.write_slot(tables, 1, packed)
+    a1 = np.asarray(tables["attn_qkv"][0][1])
+    assert a1.any()
+    # slot 0 (base) stays zero; zero_slot scrubs slot 1 again
+    assert not np.asarray(tables["attn_qkv"][0][0]).any()
+    tables = lora_mod.zero_slot(tables, 1)
+    assert not np.asarray(tables["attn_qkv"][0][1]).any()
+
+
+def test_pack_adapter_validation():
+    cfg = make_model().cfg
+    good = _adapter(cfg, seed=1)
+    targets = lora_mod.LORA_TARGETS
+    with pytest.raises(ValueError, match="not enabled"):
+        lora_mod.pack_adapter(cfg, {"attn_proj": good["attn_qkv"]}, 4,
+                              targets)
+    bad_a = {"attn_qkv": (good["attn_qkv"][0][:, :-1], good["attn_qkv"][1])}
+    with pytest.raises(ValueError, match="A shape"):
+        lora_mod.pack_adapter(cfg, bad_a, 4, targets)
+    with pytest.raises(ValueError, match="exceeds"):
+        lora_mod.pack_adapter(cfg, _adapter(cfg, seed=1, rank=8), 4,
+                              targets)
+    with pytest.raises(ValueError, match="no target weights"):
+        lora_mod.pack_adapter(cfg, {}, 4, targets)
+
+
+# -- token identity ---------------------------------------------------------
+
+
+def test_adapters_token_identical_to_merged_engines():
+    """THE acceptance test: three classes of traffic interleaved on one
+    multi-adapter engine — base, adapter alpha (rank 4), adapter beta
+    (rank 2, zero-padded) — each stream token-identical to its dedicated
+    reference engine, with 0 retraces beyond the program-count
+    contract."""
+    base = make_model()
+    w_a = _adapter(base.cfg, seed=7, rank=4)
+    w_b = _adapter(base.cfg, seed=11, rank=2)
+
+    eng = LLMEngine(base, lora_slots=3, lora_rank=4, **ENG)
+    eng.load_adapter("alpha", w_a, alpha=8)
+    eng.load_adapter("beta", w_b, alpha=4)
+
+    plain = LLMEngine(make_model(), **ENG)
+    ref_a = LLMEngine(lora_mod.merge_adapter_into(make_model(), w_a,
+                                                  alpha=8), **ENG)
+    ref_b = LLMEngine(lora_mod.merge_adapter_into(make_model(), w_b,
+                                                  alpha=4), **ENG)
+    # adapter-enabled engines keep the exact program-count formula
+    assert eng.expected_program_count() == plain.expected_program_count()
+
+    # one mixed wave: every kind shares steps with every other kind
+    rids = {}
+    for i, ad in enumerate([None, "alpha", "beta", None, "beta", "alpha"]):
+        prompt = PROMPT + [20 + i]
+        rids[(ad, i)] = (eng.add_request(prompt, max_new_tokens=10,
+                                         adapter=ad), prompt)
+    _drain(eng)
+    refs = {None: plain, "alpha": ref_a, "beta": ref_b}
+    for (ad, _i), (rid, prompt) in rids.items():
+        got = eng.get_request(rid).output_ids
+        want = _serve_one(refs[ad], prompt=prompt, n=10)
+        assert got == want, f"adapter {ad}: {got} != {want}"
+
+    # adapters actually steer decoding (the test would pass vacuously on
+    # a model whose argmax never moves)
+    (r_base, p0) = rids[(None, 0)]
+    (r_alpha, _) = rids[("alpha", 1)]
+    assert (eng.get_request(r_base).output_ids
+            != eng.get_request(r_alpha).output_ids)
+
+    assert (eng.metrics.counters.get("jit_traces")
+            <= eng.expected_program_count())
+    # registry surfaces
+    stats = eng.pool_stats()["lora"]
+    assert stats["slots"] == 3 and stats["rank"] == 4
+    assert stats["loaded"] == ["alpha", "beta"]
+    assert stats["inflight"] == {}     # all drained
+    assert eng.metrics.counters.get("lora_requests") == 4.0
+
+
+def test_lora_off_engine_is_untouched():
+    eng = LLMEngine(make_model(), **ENG)
+    assert eng._lora_tables == {} and eng.lora_targets == ()
+    with pytest.raises(ValueError, match="lora_slots=0"):
+        eng.add_request(PROMPT, adapter="alpha")
+    with pytest.raises(RuntimeError, match="lora_slots=0"):
+        eng.load_adapter("alpha", {})
+
+
+# -- registry lifecycle -----------------------------------------------------
+
+
+def test_unknown_adapter_rejected_at_admission():
+    eng = LLMEngine(make_model(), lora_slots=2, lora_rank=4, **ENG)
+    with pytest.raises(ValueError, match="unknown adapter"):
+        eng.add_request(PROMPT, adapter="nope")
+    assert not eng.scheduler.waiting     # nothing half-admitted
+
+
+def test_lru_eviction_and_slot_reuse():
+    base = make_model()
+    eng = LLMEngine(base, lora_slots=2, lora_rank=4, **ENG)
+    s_a = eng.load_adapter("a", _adapter(base.cfg, seed=1))
+    s_b = eng.load_adapter("b", _adapter(base.cfg, seed=2))
+    assert {s_a, s_b} == {1, 2}
+    assert eng.metrics.gauges.get("lora_adapters_loaded") == 2.0
+
+    # serving on "a" makes it most-recently-used, so a third load evicts
+    # the idle "b" and reuses ITS slot
+    _serve_one(eng, adapter="a")
+    s_c = eng.load_adapter("c", _adapter(base.cfg, seed=3))
+    assert s_c == s_b
+    stats = eng.pool_stats()["lora"]
+    assert stats["loaded"] == ["a", "c"]
+    assert eng.metrics.counters.get("lora_adapter_evictions") == 1.0
+    # reloading a live name overwrites in place — no eviction, same slot
+    assert eng.load_adapter("a", _adapter(base.cfg, seed=4)) == s_a
+    assert eng.metrics.counters.get("lora_adapter_evictions") == 1.0
+
+
+def test_unload_refuses_while_inflight():
+    base = make_model()
+    eng = LLMEngine(base, lora_slots=1, lora_rank=4, **ENG)
+    eng.load_adapter("a", _adapter(base.cfg, seed=1))
+    rid = eng.add_request(PROMPT, max_new_tokens=16, adapter="a")
+    eng.step()
+    assert not eng.get_request(rid).finished
+    with pytest.raises(RuntimeError, match="in flight"):
+        eng.unload_adapter("a")
+    # the single slot is also pinned against eviction-by-load
+    with pytest.raises(RuntimeError, match="slots hold adapters"):
+        eng.load_adapter("b", _adapter(base.cfg, seed=2))
+    _drain(eng)
+    eng.unload_adapter("a")
+    assert eng.metrics.gauges.get("lora_adapters_loaded") == 0.0
+    # freed slot is scrubbed — no stale weights for a future tenant
+    assert not np.asarray(eng._lora_tables["attn_qkv"][0][1]).any()
+    with pytest.raises(ValueError, match="unknown adapter"):
+        eng.unload_adapter("a")
+
+
+def test_abort_releases_adapter_pin():
+    base = make_model()
+    eng = LLMEngine(base, lora_slots=1, lora_rank=4, **ENG)
+    eng.load_adapter("a", _adapter(base.cfg, seed=1))
+    rid = eng.add_request(PROMPT, max_new_tokens=16, adapter="a")
+    eng.step()
+    eng.abort(rid)
+    eng.unload_adapter("a")      # no longer pinned
+
+
+# -- KV/prefix-cache isolation ---------------------------------------------
+
+
+def test_prefix_cache_never_shared_across_adapters():
+    """Same prompt, different adapter => different chained block hashes,
+    so the warm base-model prefix is NOT reused for an adapter request
+    (its KV was computed through different weights) — but the same
+    adapter's own re-serve hits."""
+    assert (chain_block_hashes(PROMPT, 8)
+            != chain_block_hashes(PROMPT, 8, salt="a"))
+    assert (chain_block_hashes(PROMPT, 8, salt="a")
+            != chain_block_hashes(PROMPT, 8, salt="b"))
+
+    base = make_model()
+    eng = LLMEngine(base, lora_slots=1, lora_rank=4, **ENG)
+    eng.load_adapter("a", _adapter(base.cfg, seed=7))
+    prompt = list(range(1, 17))          # two full cacheable blocks
+
+    _serve_one(eng, prompt=prompt, n=4)              # warm: base
+    hits0 = eng.metrics.counters.get("prefix_cache_hit_tokens", 0)
+    _serve_one(eng, prompt=prompt, n=4, adapter="a")  # cold: adapter
+    assert eng.metrics.counters.get("prefix_cache_hit_tokens", 0) == hits0
+    _serve_one(eng, prompt=prompt, n=4, adapter="a")  # warm: same adapter
+    assert eng.metrics.counters.get("prefix_cache_hit_tokens", 0) > hits0
+
+
+# -- stack threading: parser, frontend, router ------------------------------
+
+
+def test_completion_parser_accepts_adapter():
+    kw, _stream = _parse_completion_spec(
+        b'{"prompt": [1, 2, 3], "adapter": "alpha"}')
+    assert kw["adapter"] == "alpha"
+    kw, _stream = _parse_completion_spec(b'{"prompt": [1, 2, 3]}')
+    assert kw["adapter"] is None
+
+
+def test_async_frontend_threads_adapter():
+    base = make_model()
+    eng = LLMEngine(base, lora_slots=1, lora_rank=4, **ENG)
+    w = _adapter(base.cfg, seed=7)
+    eng.load_adapter("a", w, alpha=8)
+    want = _serve_one(LLMEngine(lora_mod.merge_adapter_into(
+        make_model(), w, alpha=8), **ENG), n=8)
+
+    async def main():
+        fe = await AsyncLLMEngine(eng).start()
+        toks, reason = await fe.generate(PROMPT, max_new_tokens=8,
+                                         adapter="a")
+        # unknown adapters bounce at submit, BEFORE the engine thread
+        with pytest.raises(ValueError, match="unknown adapter"):
+            fe.submit(PROMPT, adapter="nope")
+        await fe.shutdown()
+        return toks, reason
+
+    toks, reason = asyncio.run(main())
+    assert reason == "length" and toks == want
+
+
+def test_router_affinity_keys_on_adapter():
+    """The router homes (adapter, prefix) pairs: the same prompt under
+    different adapters may land on different replicas, and adapter
+    requests route end to end token-identically."""
+    base = make_model()
+    w = _adapter(base.cfg, seed=7)
+    want = _serve_one(LLMEngine(lora_mod.merge_adapter_into(
+        make_model(), w, alpha=8), **ENG), n=6)
+
+    def engine():
+        e = LLMEngine(make_model(), lora_slots=1, lora_rank=4, **ENG)
+        e.load_adapter("a", w, alpha=8)
+        return e
+
+    async def main():
+        router = ReplicaRouter([AsyncLLMEngine(engine()) for _ in range(2)],
+                               sweep_interval_s=0.02)
+        await router.start()
+        assert (router.affinity_key(PROMPT)
+                != router.affinity_key(PROMPT, "a"))
+        rs = await router.submit(PROMPT, max_new_tokens=6, adapter="a")
+        toks, reason = await rs.collect()
+        await router.shutdown()
+        return toks, reason
+
+    toks, reason = asyncio.run(main())
+    assert reason == "length" and toks == want

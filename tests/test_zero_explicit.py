@@ -106,6 +106,24 @@ def test_quantized_grads_within_tolerance_and_converging():
     assert got[-1] < got[0] - 0.5, got
 
 
+@pytest.mark.parametrize("opt", ["Lamb", "Lars"])
+@pytest.mark.parametrize("zs", [2, 3])
+def test_trust_ratio_optimizers_on_explicit_path(zs, opt):
+    """ROADMAP 5(b): Lars/Lamb per-tensor trust ratios on the explicit
+    shard-local update. Each norm is a psum of shard-local partial
+    squared sums (`optimizer.optimizers.sharded_norms`), so the 1/dp
+    flat shards see FULL-tensor norms: the trajectory tracks the
+    stage-0 GSPMD reference to reduction-order noise (Lars lands bit-
+    identical; Lamb's moment normalization amplifies 1-ulp sum-order
+    differences) and actually descends."""
+    ref, _, _, _ = _run(0, optimizer=opt)
+    got, step, _, _ = _run(zs, optimizer=opt)
+    assert step.explicit_update
+    drift = max(abs(a - b) for a, b in zip(got, ref))
+    assert drift < 1e-5, (drift, got, ref)
+    assert got[-1] < got[0], got
+
+
 def test_optimizer_state_shards_dp_fold_on_placed_arrays():
     """The placed init_state arrays, not specs: every param-shaped AdamW
     slot holds 1/dp of its elements per chip, scalars replicate, and the
@@ -155,7 +173,9 @@ def test_stage3_params_stay_sharded_and_gather_round_trips():
 def test_explicit_path_guards():
     """Misconfigurations fail loudly at construction: quant_grads off the
     explicit path, explicit_update on a dp x mp mesh, grad_clip and
-    per-tensor-reduction optimizers (Lamb) on the shard-local update."""
+    per-tensor-reduction optimizers without the sharded-norm bridge
+    (DGC's top-k) on the shard-local update — Lars/Lamb are ADMITTED
+    now (their norms psum via `sharded_norms`)."""
     from paddle_tpu.analysis.ir import tiny_gpt_config
     from paddle_tpu.distributed.mesh import init_mesh
     from paddle_tpu.models.gpt import GPT, gpt_loss_fn
@@ -172,10 +192,17 @@ def test_explicit_path_guards():
         mk(sgd(), zero_stage=0, quant_grads=True)
     with pytest.raises(ValueError, match="grad_clip"):
         mk(sgd(grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0)), zero_stage=2)
+    from paddle_tpu.optimizer.optimizers import DGCMomentum
+
     with pytest.raises(ValueError, match="per-tensor"):
-        mk(paddle.optimizer.Lamb(learning_rate=0.01,
-                                 parameters=model.parameters()),
+        mk(DGCMomentum(learning_rate=0.01,
+                       parameters=model.parameters()),
            zero_stage=2)
+    # Lamb/Lars declare _sharded_norm_ready: construction succeeds and
+    # takes the explicit path (trajectory parity is its own test)
+    assert mk(paddle.optimizer.Lamb(
+        learning_rate=0.01, parameters=model.parameters()),
+        zero_stage=2).explicit_update
     with pytest.raises(ValueError, match="pure-dp"):
         make_sharded_train_step(
             model, gpt_loss_fn, sgd(), init_mesh({"dp": 2, "mp": 2}),
